@@ -1,0 +1,56 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Public API mirrors the capability surface of the reference framework
+(python/ray/__init__.py): ``init/shutdown``, ``@remote``, ``get/put/wait``,
+actors, placement groups — plus TPU-first libraries: ``ray_tpu.train``,
+``ray_tpu.collective``, ``ray_tpu.parallel``, ``ray_tpu.ops``,
+``ray_tpu.models``, ``ray_tpu.rl``, ``ray_tpu.serve``, ``ray_tpu.data``.
+
+Core symbols resolve lazily so that ``import ray_tpu.common`` (or any other
+submodule) never drags in the whole runtime, and heavy libraries (jax) load
+only when actually used.
+"""
+
+import importlib
+
+from ray_tpu._version import __version__  # noqa: F401
+
+_API_SYMBOLS = {
+    "ObjectRef",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+}
+_PG_SYMBOLS = {"placement_group", "remove_placement_group", "placement_group_table"}
+_SUBMODULES = {
+    "common", "rpc", "gcs", "raylet", "object_store", "core_worker",
+    "collective", "parallel", "ops", "models", "train", "rl", "serve",
+    "data", "tune", "util", "api", "cluster_utils",
+}
+
+
+def __getattr__(name):
+    if name in _API_SYMBOLS:
+        return getattr(importlib.import_module("ray_tpu.api"), name)
+    if name in _PG_SYMBOLS:
+        return getattr(importlib.import_module("ray_tpu.core_worker.placement_group"), name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(_API_SYMBOLS | _PG_SYMBOLS | _SUBMODULES | {"__version__"})

@@ -1,0 +1,2 @@
+from . import ids, resources, status  # noqa: F401
+from .config import GLOBAL_CONFIG  # noqa: F401

@@ -1,0 +1,153 @@
+"""Central config/flag registry.
+
+Mirrors the reference's ``RAY_CONFIG(type, name, default)`` system
+(src/ray/common/ray_config_def.h:18): every flag is declared once with a type
+and default, can be overridden by the ``RT_<name>`` environment variable, and a
+cluster-wide ``system_config`` dict (propagated through the GCS at startup)
+takes precedence over defaults but not env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RT_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str = ""
+
+
+class Config:
+    """Flag registry with env > system_config > default precedence."""
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._system_config: Dict[str, Any] = {}
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, type_: type, default: Any, doc: str = "") -> None:
+        if name in self._flags:
+            raise ValueError(f"flag {name!r} declared twice")
+        self._flags[name] = _Flag(name, type_, default, doc)
+
+    def initialize(self, system_config: Dict[str, Any] | str | None) -> None:
+        """Apply a cluster-wide system_config (dict or JSON string)."""
+        if system_config is None:
+            system_config = {}
+        if isinstance(system_config, str):
+            system_config = json.loads(system_config) if system_config else {}
+        with self._lock:
+            for key in system_config:
+                if key not in self._flags:
+                    raise ValueError(f"unknown system_config key {key!r}")
+            self._system_config = dict(system_config)
+            self._cache.clear()
+
+    def system_config_json(self) -> str:
+        return json.dumps(self._system_config)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name in self._cache:
+                return self._cache[name]
+            flag = self._flags.get(name)
+            if flag is None:
+                raise KeyError(f"unknown flag {name!r}")
+            env_val = os.environ.get(_ENV_PREFIX + name)
+            if env_val is not None:
+                value = _PARSERS[flag.type](env_val)
+            elif name in self._system_config:
+                value = flag.type(self._system_config[name])
+            else:
+                value = flag.default
+            self._cache[name] = value
+            return value
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def reset_cache(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+    def all_flags(self) -> Dict[str, _Flag]:
+        return dict(self._flags)
+
+
+GLOBAL_CONFIG = Config()
+_D = GLOBAL_CONFIG.declare
+
+# --- core timeouts / intervals (ms unless noted) -----------------------------
+_D("health_check_initial_delay_ms", int, 5000, "delay before first node health probe")
+_D("health_check_period_ms", int, 1000, "interval between node health probes")
+_D("health_check_timeout_ms", int, 5000, "single probe timeout")
+_D("health_check_failure_threshold", int, 5, "probes missed before node marked dead")
+_D("raylet_report_resources_period_ms", int, 100, "resource gossip interval")
+_D("gcs_rpc_server_reconnect_timeout_s", int, 60, "client retry window on GCS restart")
+_D("rpc_retry_base_ms", int, 100, "retryable client initial backoff")
+_D("rpc_retry_max_ms", int, 5000, "retryable client max backoff")
+_D("rpc_connect_timeout_s", float, 10.0, "client connect timeout")
+
+# --- scheduling --------------------------------------------------------------
+_D("scheduler_top_k_fraction", float, 0.2, "hybrid policy: top-k fraction of nodes")
+_D("scheduler_top_k_absolute", int, 1, "hybrid policy: min top-k")
+_D("scheduler_spread_threshold", float, 0.5, "utilization below which packing wins")
+_D("max_pending_lease_requests_per_scheduling_category", int, 10, "")
+_D("worker_lease_timeout_ms", int, 30000, "")
+_D("lease_request_batch_size", int, 10, "leases requested per shape at once")
+
+# --- workers -----------------------------------------------------------------
+_D("num_prestart_workers", int, 0, "workers forked at raylet boot")
+_D("worker_register_timeout_s", int, 60, "")
+_D("idle_worker_killing_time_threshold_ms", int, 1000, "idle reap threshold")
+_D("maximum_startup_concurrency", int, 4, "concurrent worker forks")
+
+# --- object store ------------------------------------------------------------
+_D("object_store_memory_bytes", int, 256 * 1024 * 1024, "default shm arena size")
+_D("object_store_chunk_size_bytes", int, 5 * 1024 * 1024, "transfer chunk size")
+_D("object_spilling_threshold", float, 0.8, "fullness ratio that triggers spill")
+_D("object_spilling_dir", str, "", "external storage dir ('' = session dir)")
+_D("max_direct_call_object_size", int, 100 * 1024, "inline-in-RPC threshold bytes")
+_D("memory_store_max_bytes", int, 512 * 1024 * 1024, "in-process store cap")
+
+# --- retries / lineage -------------------------------------------------------
+_D("max_task_retries", int, 3, "default retries for normal tasks")
+_D("actor_max_restarts", int, 0, "default actor restarts")
+_D("lineage_pinning_enabled", bool, True, "")
+_D("max_lineage_bytes", int, 64 * 1024 * 1024, "lineage buffer cap per worker")
+
+# --- chaos / testing ---------------------------------------------------------
+_D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep")
+_D("testing_rpc_failure_seed", int, 0, "deterministic chaos seed")
+
+# --- TPU ---------------------------------------------------------------------
+_D("tpu_chips_per_host", int, 4, "chips exposed per raylet when unprobed")
+_D("tpu_topology", str, "", "slice topology label, e.g. v5e-32")
+
+# --- train -------------------------------------------------------------------
+_D("train_health_check_interval_s", float, 2.0, "controller poll interval")
+_D("train_worker_group_start_timeout_s", float, 120.0, "")

@@ -1,0 +1,66 @@
+"""Framework exceptions (reference: src/ray/common/status.h + python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RtError(Exception):
+    """Base class for all framework errors."""
+
+
+class RtTimeoutError(RtError, TimeoutError):
+    pass
+
+
+class RtConnectionError(RtError, ConnectionError):
+    pass
+
+
+class RtSystemError(RtError):
+    """Internal invariant violation."""
+
+
+class TaskError(RtError):
+    """A task raised an exception; re-raised at `get` on the caller."""
+
+    def __init__(self, task_id=None, cause: BaseException | None = None, traceback_str: str = ""):
+        self.task_id = task_id
+        self.cause = cause
+        self.traceback_str = traceback_str
+        super().__init__(f"task {task_id} failed: {cause!r}\n{traceback_str}")
+
+
+class WorkerCrashedError(RtError):
+    """The worker executing the task died unexpectedly."""
+
+
+class ActorDiedError(RtError):
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(RtError):
+    """Actor temporarily unreachable (restarting); call may be retried."""
+
+
+class ObjectLostError(RtError):
+    def __init__(self, object_id=None, reason: str = ""):
+        self.object_id = object_id
+        super().__init__(f"object {object_id} lost: {reason}")
+
+
+class ObjectStoreFullError(RtError):
+    pass
+
+
+class PlacementGroupError(RtError):
+    pass
+
+
+class RuntimeEnvSetupError(RtError):
+    pass
+
+
+class PendingCallsLimitExceeded(RtError):
+    pass

@@ -1,0 +1,130 @@
+"""Task specification — the unit shipped from submitter to executor.
+
+Equivalent of the reference's ``TaskSpecification``
+(src/ray/common/task/task_spec.h:258): function descriptor, serialized args
+(inline values or ObjectID references), resource demand, scheduling strategy,
+and retry policy.  Serialized with cloudpickle for function payloads and plain
+pickle-able dataclasses for metadata.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .resources import ResourceRequest
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+    DRIVER_TASK = 3
+
+
+@dataclass
+class FunctionDescriptor:
+    """Language-agnostic function identity (module, qualname, payload hash)."""
+
+    module: str
+    qualname: str
+    function_hash: bytes = b""
+
+    def key(self) -> Tuple[str, str, bytes]:
+        return (self.module, self.qualname, self.function_hash)
+
+
+class SchedulingStrategy:
+    """Base scheduling strategy (reference: scheduling_strategy proto)."""
+
+
+@dataclass
+class DefaultStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinityStrategy(SchedulingStrategy):
+    node_id: NodeID
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelStrategy(SchedulingStrategy):
+    hard: Dict[str, object] = field(default_factory=dict)
+    soft: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class PlacementGroupStrategy(SchedulingStrategy):
+    placement_group_id: PlacementGroupID
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskArg:
+    """Either an inline value (bytes) or a reference to an object."""
+
+    is_inline: bool
+    value: Optional[bytes] = None
+    object_id: Optional[ObjectID] = None
+    owner: Optional[WorkerID] = None
+
+    @classmethod
+    def inline(cls, value: bytes) -> "TaskArg":
+        return cls(is_inline=True, value=value)
+
+    @classmethod
+    def by_ref(cls, object_id: ObjectID, owner: Optional[WorkerID] = None) -> "TaskArg":
+        return cls(is_inline=False, object_id=object_id, owner=owner)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function: FunctionDescriptor
+    serialized_func: Optional[bytes]  # cloudpickled callable (None => registry lookup)
+    args: List[TaskArg]
+    num_returns: int
+    required_resources: ResourceRequest
+    scheduling_strategy: SchedulingStrategy = field(default_factory=DefaultStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    parent_task_id: Optional[TaskID] = None
+    caller_worker_id: Optional[WorkerID] = None
+    caller_address: Optional[Tuple[str, int]] = None
+    # actor fields
+    actor_id: Optional[ActorID] = None
+    actor_method_name: Optional[str] = None
+    sequence_number: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # runtime env / misc
+    runtime_env: Optional[dict] = None
+    name: str = ""
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.from_index(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == TaskType.ACTOR_TASK
+
+    def is_actor_creation(self) -> bool:
+        return self.task_type == TaskType.ACTOR_CREATION_TASK
+
+    def dependencies(self) -> List[ObjectID]:
+        return [a.object_id for a in self.args if not a.is_inline and a.object_id is not None]
+
+    def shape_key(self) -> tuple:
+        """Lease-pooling key: tasks with the same shape can share leases."""
+        return (self.required_resources.shape_key(), type(self.scheduling_strategy).__name__)

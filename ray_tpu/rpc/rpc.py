@@ -1,0 +1,353 @@
+"""Asyncio RPC layer: framed-message server + multiplexed retryable client.
+
+Fills the role of the reference's gRPC wrappers (src/ray/rpc/grpc_server.h,
+grpc_client.h, retryable_grpc_client.h).  Design notes:
+
+- Transport is a length-prefixed pickle envelope over TCP.  We deliberately do
+  not use gRPC: the control plane is low-rate, the data plane goes through the
+  shared-memory object store, and a single-runtime asyncio stack keeps every
+  per-node daemon on one event loop (this box schedules everything on few
+  cores; the reference's dedicated poller threads would only add contention).
+- Every process runs at most one IO event loop in a background thread
+  (:class:`IoContext`), mirroring the reference's instrumented io_context per
+  component (src/ray/common/asio/instrumented_io_context.h).  Handler timings
+  are recorded for debug dumps.
+- ``RetryableRpcClient`` reconnects with exponential backoff until a deadline,
+  like retryable_grpc_client.cc, and consults the chaos hooks
+  (:mod:`ray_tpu.rpc.chaos`) on every call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+import threading
+import time
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.status import RtConnectionError, RtTimeoutError
+from . import chaos
+
+_HEADER = struct.Struct("<IB")  # payload length, frame type
+_FRAME_REQ = 1
+_FRAME_RESP = 2
+
+Address = Tuple[str, int]
+
+
+class RpcError(RtConnectionError):
+    pass
+
+
+class RemoteMethodError(Exception):
+    """Handler raised; carries the remote traceback."""
+
+    def __init__(self, method: str, cause: BaseException, tb: str):
+        self.method = method
+        self.cause = cause
+        self.tb = tb
+        super().__init__(f"RPC handler {method!r} raised {cause!r}\n--- remote ---\n{tb}")
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(_HEADER.size)
+    length, ftype = _HEADER.unpack(header)
+    body = await reader.readexactly(length)
+    return ftype, pickle.loads(body)
+
+
+def _write_frame(writer: asyncio.StreamWriter, ftype: int, msg: Any):
+    body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    writer.write(_HEADER.pack(len(body), ftype) + body)
+
+
+class IoContext:
+    """One background asyncio loop per process, shared by all clients/servers.
+
+    Sync code submits coroutines with :meth:`run`; async code just uses the
+    loop directly.  Named-handler timing stats mimic the reference's
+    event_stats.cc so `debug_state` dumps show where loop time goes.
+    """
+
+    _singleton: Optional["IoContext"] = None
+    _singleton_lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name="rt-io", daemon=True)
+        self.stats: Dict[str, Tuple[int, float]] = {}
+        self._stats_lock = threading.Lock()
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def current(cls) -> "IoContext":
+        with cls._singleton_lock:
+            if cls._singleton is None or not cls._singleton._thread.is_alive():
+                cls._singleton = cls()
+            return cls._singleton
+
+    def run(self, coro: Awaitable, timeout: Optional[float] = None):
+        """Block the calling (non-loop) thread on a coroutine."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            fut.cancel()
+            raise RtTimeoutError(f"rpc timed out after {timeout}s")
+
+    def record(self, name: str, elapsed: float):
+        with self._stats_lock:
+            count, total = self.stats.get(name, (0, 0.0))
+            self.stats[name] = (count + 1, total + elapsed)
+
+
+class RpcServer:
+    """Registers async handlers by method name; serves framed requests.
+
+    Handlers: ``async def handler(**kwargs) -> result``.  Results/exceptions
+    are pickled back.  One connection carries many concurrent requests.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._io = IoContext.current()
+        self._conns: set = set()
+
+    def register(self, method: str, handler: Callable[..., Awaitable[Any]]):
+        self._handlers[method] = handler
+
+    def register_service(self, service: object, prefix: str = ""):
+        """Register every public async method of `service`."""
+        for name in dir(service):
+            if name.startswith("_"):
+                continue
+            fn = getattr(service, name)
+            if callable(fn) and asyncio.iscoroutinefunction(fn):
+                self.register(prefix + name, fn)
+
+    @property
+    def address(self) -> Address:
+        return (self.host, self.port)
+
+    def start(self):
+        self._io.run(self._start())
+
+    async def _start(self):
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conns.add(writer)
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                ftype, msg = await _read_frame(reader)
+                if ftype != _FRAME_REQ:
+                    continue
+                asyncio.ensure_future(self._dispatch(msg, writer, write_lock))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock):
+        req_id, method, kwargs = msg["id"], msg["method"], msg["kwargs"]
+        start = time.monotonic()
+        handler = self._handlers.get(method)
+        if handler is None:
+            reply = {"id": req_id, "error": ("nomethod", f"unknown method {method!r}", "")}
+        else:
+            try:
+                result = await handler(**kwargs)
+                reply = {"id": req_id, "result": result}
+            except Exception as e:  # noqa: BLE001 - handler errors go to caller
+                reply = {"id": req_id, "error": ("raised", e, traceback.format_exc())}
+        self._io.record(f"rpc.{method}", time.monotonic() - start)
+        async with write_lock:
+            try:
+                _write_frame(writer, _FRAME_RESP, reply)
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            except Exception:  # unpicklable result/exception: degrade to string
+                try:
+                    detail = repr(reply.get("result", reply.get("error")))
+                    _write_frame(
+                        writer,
+                        _FRAME_RESP,
+                        {"id": req_id, "error": ("unserializable", detail, "")},
+                    )
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+
+    def stop(self):
+        if self._server is not None:
+            self._io.run(self._stop())
+
+    async def _stop(self):
+        assert self._server is not None
+        self._server.close()
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:
+                pass
+        await self._server.wait_closed()
+        self._server = None
+
+
+class RpcClient:
+    """Single-connection multiplexed client. Not retryable; see RetryableRpcClient."""
+
+    def __init__(self, address: Address):
+        self.address = tuple(address)
+        self._io = IoContext.current()
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._write_lock: Optional[asyncio.Lock] = None
+
+    async def _ensure_connected(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+            self._write_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None:
+                return
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*self.address),
+                    GLOBAL_CONFIG.get("rpc_connect_timeout_s"),
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                raise RpcError(f"connect to {self.address} failed: {e}") from e
+            self._writer = writer
+            asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                _, msg = await _read_frame(reader)
+                fut = self._pending.pop(msg["id"], None)
+                if fut is not None and not fut.done():
+                    if "error" in msg:
+                        kind, cause, tb = msg["error"]
+                        if kind == "raised" and isinstance(cause, BaseException):
+                            fut.set_exception(RemoteMethodError(msg.get("method", "?"), cause, tb))
+                        else:
+                            fut.set_exception(RpcError(f"{kind}: {cause}"))
+                    else:
+                        fut.set_result(msg.get("result"))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self._fail_all(RpcError(f"connection to {self.address} lost: {e}"))
+
+    def _fail_all(self, exc: Exception):
+        self._writer = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def call_async(self, method: str, timeout: Optional[float] = None, **kwargs):
+        fail_req, fail_resp = chaos.maybe_inject_failure(method)
+        if fail_req:
+            raise chaos.RpcChaosError(f"injected request failure for {method}")
+        await self._ensure_connected()
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._write_lock:
+            writer = self._writer
+            if writer is None:  # connection died while we awaited the lock
+                self._pending.pop(req_id, None)
+                raise RpcError(f"connection to {self.address} lost before write")
+            try:
+                _write_frame(writer, _FRAME_REQ, {"id": req_id, "method": method, "kwargs": kwargs})
+                await writer.drain()
+            except (ConnectionError, OSError) as e:
+                self._pending.pop(req_id, None)
+                self._fail_all(RpcError(f"write to {self.address} failed: {e}"))
+                raise RpcError(f"write to {self.address} failed: {e}") from e
+        try:
+            result = await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise RtTimeoutError(f"rpc {method} to {self.address} timed out")
+        except BaseException:  # incl. outer cancellation: don't leak the pending slot
+            self._pending.pop(req_id, None)
+            raise
+        if fail_resp:
+            raise chaos.RpcChaosError(f"injected response failure for {method}")
+        return result
+
+    def call(self, method: str, timeout: Optional[float] = None, **kwargs):
+        return self._io.run(self.call_async(method, timeout=timeout, **kwargs), timeout)
+
+    def close(self):
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            def _close():
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            self._io.loop.call_soon_threadsafe(_close)
+
+
+class RetryableRpcClient:
+    """Retries connection-level failures with exponential backoff until a
+    deadline (reference: retryable_grpc_client.cc).  Handler-raised exceptions
+    are NOT retried — they are application errors."""
+
+    def __init__(self, address: Address, max_attempts: int = 1 << 30, deadline_s: Optional[float] = None):
+        self.address = tuple(address)
+        self._client = RpcClient(address)
+        self._max_attempts = max_attempts
+        # Bounded by default: without a deadline, a dead peer would otherwise
+        # be retried forever (reference bounds this with
+        # gcs_rpc_server_reconnect_timeout_s).
+        if deadline_s is None:
+            deadline_s = float(GLOBAL_CONFIG.get("gcs_rpc_server_reconnect_timeout_s"))
+        self._deadline_s = deadline_s
+
+    async def call_async(self, method: str, timeout: Optional[float] = None, **kwargs):
+        base = GLOBAL_CONFIG.get("rpc_retry_base_ms") / 1000.0
+        cap = GLOBAL_CONFIG.get("rpc_retry_max_ms") / 1000.0
+        deadline = None if self._deadline_s is None else time.monotonic() + self._deadline_s
+        attempt = 0
+        while True:
+            try:
+                return await self._client.call_async(method, timeout=timeout, **kwargs)
+            except (RpcError, chaos.RpcChaosError) as e:
+                attempt += 1
+                if attempt >= self._max_attempts:
+                    raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise RtTimeoutError(f"rpc {method} retries exhausted: {e}") from e
+                await asyncio.sleep(min(cap, base * (2 ** (attempt - 1))))
+                self._client.close()
+                self._client = RpcClient(self.address)
+
+    def call(self, method: str, timeout: Optional[float] = None, **kwargs):
+        return IoContext.current().run(self.call_async(method, timeout=timeout, **kwargs))
+
+    def close(self):
+        self._client.close()

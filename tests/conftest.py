@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device mesh (the reference tests distributed
+behavior on one machine with multi-raylet localhost clusters, SURVEY.md §4; we
+do the same and additionally virtualize chips for sharding tests).
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_cluster():
+    """A started single-node framework instance, shut down after the test."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, resources={"TPU": 0})
+    yield ray_tpu
+    ray_tpu.shutdown()

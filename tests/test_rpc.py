@@ -1,0 +1,182 @@
+"""Tests for the RPC layer, pubsub, and chaos injection."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.rpc import chaos
+from ray_tpu.rpc.pubsub import Publisher, Subscriber
+from ray_tpu.rpc.rpc import (
+    RemoteMethodError,
+    RetryableRpcClient,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+
+
+@pytest.fixture
+def server():
+    s = RpcServer()
+
+    async def echo(x):
+        return x
+
+    async def boom():
+        raise ValueError("kapow")
+
+    async def add(a, b):
+        return a + b
+
+    s.register("echo", echo)
+    s.register("boom", boom)
+    s.register("add", add)
+    s.start()
+    yield s
+    s.stop()
+
+
+class TestRpc:
+    def test_roundtrip(self, server):
+        c = RpcClient(server.address)
+        assert c.call("echo", x={"k": [1, 2, 3]}) == {"k": [1, 2, 3]}
+        assert c.call("add", a=2, b=3) == 5
+        c.close()
+
+    def test_remote_exception_propagates(self, server):
+        c = RpcClient(server.address)
+        with pytest.raises(RemoteMethodError) as ei:
+            c.call("boom")
+        assert isinstance(ei.value.cause, ValueError)
+        c.close()
+
+    def test_unknown_method(self, server):
+        c = RpcClient(server.address)
+        with pytest.raises(RpcError):
+            c.call("nope")
+        c.close()
+
+    def test_concurrent_calls_multiplexed(self, server):
+        c = RpcClient(server.address)
+        results = []
+        errs = []
+
+        def worker(i):
+            try:
+                results.append(c.call("add", a=i, b=i))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert sorted(results) == [2 * i for i in range(20)]
+        c.close()
+
+    def test_connect_refused(self):
+        c = RpcClient(("127.0.0.1", 1))  # nothing listens on port 1
+        with pytest.raises(RpcError):
+            c.call("echo", x=1)
+
+    def test_retryable_client_survives_server_restart(self):
+        s = RpcServer()
+
+        async def echo(x):
+            return x
+
+        s.register("echo", echo)
+        s.start()
+        addr = s.address
+        c = RetryableRpcClient(addr)
+        assert c.call("echo", x=1) == 1
+        s.stop()
+        # restart on the same port while a call retries in the background
+        result = {}
+
+        def late_call():
+            result["v"] = c.call("echo", x=42)
+
+        t = threading.Thread(target=late_call)
+        t.start()
+        time.sleep(0.3)
+        s2 = RpcServer(port=addr[1])
+        s2.register("echo", echo)
+        s2.start()
+        t.join(timeout=10)
+        assert result.get("v") == 42
+        s2.stop()
+
+
+class TestChaos:
+    def test_injected_failures(self, server):
+        GLOBAL_CONFIG.initialize({"testing_rpc_failure": "echo=1.0", "testing_rpc_failure_seed": 42})
+        GLOBAL_CONFIG.reset_cache()
+        chaos.reset()
+        try:
+            c = RpcClient(server.address)
+            with pytest.raises(chaos.RpcChaosError):
+                c.call("echo", x=1)
+            # other methods unaffected
+            assert c.call("add", a=1, b=1) == 2
+            c.close()
+        finally:
+            GLOBAL_CONFIG.initialize({})
+            GLOBAL_CONFIG.reset_cache()
+            chaos.reset()
+
+    def test_retryable_client_rides_through_chaos(self, server):
+        GLOBAL_CONFIG.initialize({"testing_rpc_failure": "add=0.5", "testing_rpc_failure_seed": 7})
+        GLOBAL_CONFIG.reset_cache()
+        chaos.reset()
+        try:
+            c = RetryableRpcClient(server.address, max_attempts=50)
+            for i in range(10):
+                assert c.call("add", a=i, b=1) == i + 1
+            c.close()
+        finally:
+            GLOBAL_CONFIG.initialize({})
+            GLOBAL_CONFIG.reset_cache()
+            chaos.reset()
+
+
+class TestPubsub:
+    def test_publish_and_longpoll(self):
+        s = RpcServer()
+        pub = Publisher()
+        pub.attach(s)
+        s.start()
+        got = []
+        sub = Subscriber("sub1", s.address)
+        sub.subscribe("actors", lambda key, msg: got.append((key, msg)))
+        time.sleep(0.2)
+        pub.publish("actors", "a1", {"state": "ALIVE"})
+        pub.publish("other", "x", "ignored")
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.05)
+        assert got == [("a1", {"state": "ALIVE"})]
+        sub.close()
+        s.stop()
+
+    def test_key_filter(self):
+        s = RpcServer()
+        pub = Publisher()
+        pub.attach(s)
+        s.start()
+        got = []
+        sub = Subscriber("sub2", s.address)
+        sub.subscribe("objects", lambda key, msg: got.append(key), key="obj-A")
+        time.sleep(0.2)
+        pub.publish("objects", "obj-B", 1)
+        pub.publish("objects", "obj-A", 2)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.05)
+        assert got == ["obj-A"]
+        sub.close()
+        s.stop()
