@@ -1,0 +1,342 @@
+"""Public API (reference surface: python/ray/_private/worker.py:1331 ray.init,
+:2726 get, :2879 put, :2944 wait; remote_function.py:314 @ray.remote).
+
+``init()`` with no address starts a single-node cluster in-process (GCS +
+raylet on the shared IO loop; workers are real child processes).
+``init(address="host:port")`` connects to an existing cluster's GCS.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import ActorID, NodeID
+from ray_tpu.core_worker.actor import (
+    ActorClass,
+    ActorHandle,
+    _resources_from_options,
+    _strategy_from_options,
+)
+from ray_tpu.core_worker.reference import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+_global_lock = threading.RLock()
+_head: Optional[dict] = None  # {"gcs": GcsServer, "raylet": Raylet} when we started them
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    system_config: Optional[dict] = None,
+    job_name: str = "",
+) -> dict:
+    """Start or connect. Returns {"gcs_address": (host, port), "node_id": hex}."""
+    global _head
+    from ray_tpu.core_worker.worker import MODE_DRIVER, CoreWorker
+
+    with _global_lock:
+        if CoreWorker._current is not None:
+            raise RuntimeError("ray_tpu.init() already called; call shutdown() first")
+        if system_config:
+            GLOBAL_CONFIG.initialize(system_config)
+            GLOBAL_CONFIG.reset_cache()
+        if address is None:
+            from ray_tpu.gcs.server import GcsServer
+            from ray_tpu.raylet.raylet import Raylet
+
+            gcs = GcsServer()
+            gcs.start()
+            node_resources = dict(resources or {})
+            if num_cpus is not None:
+                node_resources["CPU"] = num_cpus
+            if num_tpus is not None:
+                node_resources["TPU"] = num_tpus
+            elif "TPU" not in node_resources:
+                node_resources["TPU"] = _autodetect_tpu_chips()
+            raylet = Raylet(gcs.address, resources=node_resources, labels=labels)
+            raylet.start()
+            _head = {"gcs": gcs, "raylet": raylet}
+            gcs_address = gcs.address
+            raylet_address = raylet.server.address
+            node_id = raylet.node_id
+        else:
+            host, _, port = address.partition(":")
+            gcs_address = (host, int(port))
+            from ray_tpu.gcs.client import GcsClient
+
+            probe = GcsClient(gcs_address)
+            nodes_info = probe.get_all_nodes()
+            probe.close()
+            alive = [n for n in nodes_info if n["alive"]]
+            if not alive:
+                raise ConnectionError(f"no alive nodes in cluster at {address}")
+            raylet_address = tuple(alive[0]["address"])
+            node_id = NodeID(alive[0]["node_id"])
+
+        cw = CoreWorker(
+            mode=MODE_DRIVER,
+            gcs_address=gcs_address,
+            raylet_address=raylet_address,
+            node_id=node_id,
+        )
+        atexit.register(_shutdown_atexit)
+        return {"gcs_address": gcs_address, "node_id": node_id.hex()}
+
+
+def _autodetect_tpu_chips() -> float:
+    """Count local TPU chips without initializing jax (env heuristics)."""
+    import os
+
+    if os.environ.get("TPU_VISIBLE_CHIPS"):
+        return float(len(os.environ["TPU_VISIBLE_CHIPS"].split(",")))
+    # defer to jax only if it's already imported (avoid hijacking the chip)
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return float(len([d for d in jax.devices() if d.platform != "cpu"]))
+        except Exception:  # noqa: BLE001
+            return 0.0
+    return 0.0
+
+
+def _shutdown_atexit():
+    try:
+        shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def shutdown() -> None:
+    global _head
+    from ray_tpu.core_worker.worker import CoreWorker
+
+    with _global_lock:
+        cw = CoreWorker._current
+        if cw is not None:
+            try:
+                cw.gcs.finish_job(cw.job_id)
+            except Exception:  # noqa: BLE001
+                pass
+            cw.shutdown()
+        if _head is not None:
+            _head["raylet"].stop()
+            _head["gcs"].stop()
+            _head = None
+
+
+def is_initialized() -> bool:
+    from ray_tpu.core_worker.worker import CoreWorker
+
+    return CoreWorker._current is not None
+
+
+def _core_worker():
+    from ray_tpu.core_worker.worker import CoreWorker
+
+    return CoreWorker.current_or_raise()
+
+
+# ----------------------------------------------------------------- remote API
+
+class RemoteFunction:
+    def __init__(self, fn, default_options: Optional[dict] = None):
+        self._fn = fn
+        self._options = default_options or {}
+        functools.update_wrapper(self, fn)
+        self._serialized = None
+
+    def remote(self, *args, **kwargs):
+        return self._invoke(args, kwargs, self._options)
+
+    def options(self, **opts):
+        merged = dict(self._options)
+        merged.update(opts)
+        return _RemoteFunctionOptions(self, merged)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.graph.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs, self._options)
+
+    def _invoke(self, args, kwargs, opts):
+        import cloudpickle
+
+        cw = _core_worker()
+        if self._serialized is None:
+            self._serialized = cloudpickle.dumps(self._fn)
+        num_returns = opts.get("num_returns", 1)
+        refs = cw.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            resources=_resources_from_options(opts),
+            label_selector=opts.get("label_selector"),
+            scheduling_strategy=_strategy_from_options(opts),
+            max_retries=opts.get("max_retries"),
+            name=opts.get("name", self._fn.__name__),
+            serialized_func=self._serialized,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use .remote()")
+
+
+class _RemoteFunctionOptions:
+    def __init__(self, rf: RemoteFunction, opts: dict):
+        self._rf = rf
+        self._opts = opts
+
+    def remote(self, *args, **kwargs):
+        return self._rf._invoke(args, kwargs, self._opts)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.graph.dag import FunctionNode
+
+        return FunctionNode(self._rf, args, kwargs, self._opts)
+
+
+def remote(*args, **options):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)`` on functions
+    and classes."""
+    if len(args) == 1 and callable(args[0]) and not options:
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only")
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return wrap
+
+
+def method(**opts):
+    """Decorator for actor methods (num_returns)."""
+
+    def wrap(fn):
+        fn.__rt_method_opts__ = opts
+        return fn
+
+    return wrap
+
+
+# -------------------------------------------------------------------- core ops
+
+def put(value: Any) -> ObjectRef:
+    return _core_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = _core_worker().get(ref_list, timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _core_worker().wait(list(refs), num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _core_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    logger.warning("cancel() is best-effort: not yet propagated to executors")
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = _core_worker().gcs.get_actor_by_name(name, namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no alive actor named {name!r}")
+    return ActorHandle(ActorID.from_hex(info["actor_id"]))
+
+
+# ----------------------------------------------------------------- inspection
+
+def nodes() -> List[dict]:
+    out = []
+    for n in _core_worker().gcs.get_all_nodes():
+        out.append({
+            "NodeID": NodeID(n["node_id"]).hex(),
+            "Alive": n["alive"],
+            "Address": n["address"],
+            "Resources": n["resources"]["total"],
+            "Available": n["resources"]["available"],
+            "Labels": n["resources"]["labels"],
+        })
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _core_worker().gcs.cluster_resources()["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return _core_worker().gcs.cluster_resources()["available"]
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace events for completed tasks (reference: ray.timeline)."""
+    return _core_worker().gcs.call("get_task_events")
+
+
+class RuntimeContext:
+    def __init__(self, cw):
+        self._cw = cw
+
+    @property
+    def job_id(self):
+        return self._cw.job_id
+
+    @property
+    def node_id(self):
+        return self._cw.node_id
+
+    @property
+    def worker_id(self):
+        return self._cw.worker_id
+
+    def get_task_id(self):
+        return self._cw.current_task_id()
+
+    def get_actor_id(self):
+        return self._cw._actor_id
+
+    @property
+    def gcs_address(self):
+        return self._cw.gcs_address
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_core_worker())

@@ -1,0 +1,79 @@
+"""Multi-node test cluster on one machine (reference:
+python/ray/cluster_utils.py:135 Cluster / add_node:202 / remove_node:286).
+
+Runs one GCS plus N raylets in the current process (each raylet still forks
+real worker subprocesses), which is how the reference tests multi-node
+behavior on localhost.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.gcs.server import GcsServer
+from ray_tpu.raylet.raylet import Raylet
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.gcs = GcsServer()
+        self.gcs.start()
+        self.raylets: List[Raylet] = []
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self) -> str:
+        return f"{self.gcs.address[0]}:{self.gcs.address[1]}"
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> Raylet:
+        node_resources = dict(resources or {})
+        node_resources.setdefault("CPU", num_cpus)
+        if num_tpus:
+            node_resources["TPU"] = num_tpus
+        raylet = Raylet(self.gcs.address, resources=node_resources, labels=labels)
+        raylet.start()
+        self.raylets.append(raylet)
+        return raylet
+
+    def remove_node(self, raylet: Raylet, graceful: bool = False):
+        """Kill a node (ungraceful = simulate crash: workers die, GCS finds out
+        via health checks)."""
+        raylet.stop()
+        self.raylets.remove(raylet)
+        if graceful:
+            try:
+                self.gcs.server and None
+                from ray_tpu.gcs.client import GcsClient
+
+                c = GcsClient(self.gcs.address)
+                c.call("unregister_node", node_id=raylet.node_id.binary())
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def wait_for_nodes(self, count: Optional[int] = None, timeout: float = 30.0):
+        from ray_tpu.gcs.client import GcsClient
+
+        want = count if count is not None else len(self.raylets)
+        c = GcsClient(self.gcs.address)
+        deadline = time.monotonic() + timeout
+        try:
+            while time.monotonic() < deadline:
+                alive = [n for n in c.get_all_nodes() if n["alive"]]
+                if len(alive) >= want:
+                    return True
+                time.sleep(0.1)
+            return False
+        finally:
+            c.close()
+
+    def shutdown(self):
+        for r in list(self.raylets):
+            r.stop()
+        self.raylets.clear()
+        self.gcs.stop()

@@ -77,6 +77,7 @@ class TaskArg:
     value: Optional[bytes] = None
     object_id: Optional[ObjectID] = None
     owner: Optional[WorkerID] = None
+    owner_address: Optional[Tuple[str, int]] = None
 
     @classmethod
     def inline(cls, value: bytes) -> "TaskArg":

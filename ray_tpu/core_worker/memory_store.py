@@ -1,0 +1,101 @@
+"""In-process object store (reference: core_worker/store_provider/memory_store/).
+
+Holds serialized objects owned by or cached in this worker: task returns,
+``put()`` values, and fetched remote objects.  Entries are either concrete
+(bytes or an error) or *pending* (a future a ``get`` can block on).  Large
+objects additionally live in the node's shared-memory store once the native
+object plane is attached (see ray_tpu.object_store).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import ObjectID
+from ray_tpu.common.status import ObjectStoreFullError, RtTimeoutError
+
+
+@dataclass
+class Entry:
+    value: Optional[bytes] = None  # serialized value
+    error: Optional[bytes] = None  # serialized exception
+    location: Optional[Tuple[str, int]] = None  # remote holder (large objects)
+    is_ready: bool = False
+    size: int = 0
+
+
+class MemoryStore:
+    def __init__(self):
+        self._entries: Dict[ObjectID, Entry] = {}
+        self._cv = threading.Condition()
+        self._bytes_used = 0
+
+    def put(self, object_id: ObjectID, value: Optional[bytes] = None,
+            error: Optional[bytes] = None,
+            location: Optional[Tuple[str, int]] = None) -> None:
+        size = len(value) if value else 0
+        with self._cv:
+            cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
+            existing = self._entries.get(object_id)
+            if existing is not None and existing.is_ready:
+                return  # idempotent: first write wins (retries may re-store)
+            if self._bytes_used + size > cap:
+                raise ObjectStoreFullError(
+                    f"memory store full: {self._bytes_used + size} > {cap}")
+            self._entries[object_id] = Entry(
+                value=value, error=error, location=location, is_ready=True, size=size)
+            self._bytes_used += size
+            self._cv.notify_all()
+
+    def mark_pending(self, object_id: ObjectID) -> None:
+        with self._cv:
+            self._entries.setdefault(object_id, Entry())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and e.is_ready
+
+    def get_if_ready(self, object_id: ObjectID) -> Optional[Entry]:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e if e is not None and e.is_ready else None
+
+    def wait_ready(self, object_ids: List[ObjectID], num_ready: int,
+                   timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
+        """Block until `num_ready` of `object_ids` are ready. Returns (ready, not_ready)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in object_ids if (e := self._entries.get(o)) and e.is_ready]
+                if len(ready) >= num_ready:
+                    break
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cv.wait(remaining if remaining is not None else 1.0)
+            ready_set = set(ready)
+            return ready, [o for o in object_ids if o not in ready_set]
+
+    def get_blocking(self, object_id: ObjectID, timeout: Optional[float]) -> Entry:
+        ready, _ = self.wait_ready([object_id], 1, timeout)
+        if not ready:
+            raise RtTimeoutError(f"timed out waiting for {object_id}")
+        with self._cv:
+            return self._entries[object_id]
+
+    def free(self, object_ids: List[ObjectID]) -> None:
+        with self._cv:
+            for oid in object_ids:
+                e = self._entries.pop(oid, None)
+                if e is not None:
+                    self._bytes_used -= e.size
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"num_objects": len(self._entries), "bytes_used": self._bytes_used}

@@ -1,0 +1,74 @@
+"""ObjectRef — a distributed future carrying its owner's identity.
+
+As in the reference (ownership model, core_worker/reference_count.h:73), the
+*owner* of an object is the worker that created it; the ref carries the
+owner's RPC address so any holder can resolve value/location/lineage by asking
+the owner directly — no central object directory.
+
+Deleting the last local ObjectRef notifies the owner (distributed refcount,
+batched, fire-and-forget), which frees the value and any remote copies once
+all borrowers are gone.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ray_tpu.common.ids import ObjectID, WorkerID
+
+# process-global release sink, installed by the CoreWorker at startup
+_release_sink = None
+_release_lock = threading.Lock()
+
+
+def install_release_sink(fn):
+    global _release_sink
+    with _release_lock:
+        _release_sink = fn
+
+
+class ObjectRef:
+    __slots__ = ("object_id", "owner_id", "owner_address", "_borrowed", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_id: Optional[WorkerID] = None,
+                 owner_address: Optional[Tuple[str, int]] = None, _borrowed: bool = False):
+        self.object_id = object_id
+        self.owner_id = owner_id
+        self.owner_address = tuple(owner_address) if owner_address else None
+        self._borrowed = _borrowed
+
+    def binary(self) -> bytes:
+        return self.object_id.binary()
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __hash__(self) -> int:
+        return hash(self.object_id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.object_id.hex()[:16]}…)"
+
+    def __reduce__(self):
+        # Deserialized copies are *borrowed* references.
+        return (_rebuild_borrowed_ref, (self.object_id, self.owner_id, self.owner_address))
+
+    def __del__(self):
+        sink = _release_sink
+        if sink is not None:
+            try:
+                sink(self)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
+
+    # convenience: obj_ref.get() / await-ability can come later
+    def future(self):
+        raise NotImplementedError("use ray_tpu.get / ray_tpu.wait")
+
+
+def _rebuild_borrowed_ref(object_id, owner_id, owner_address):
+    return ObjectRef(object_id, owner_id, owner_address, _borrowed=True)

@@ -1,0 +1,303 @@
+"""Task submitters: lease-pooled normal tasks + sequenced actor calls.
+
+Mirrors the reference's transport layer (core_worker/transport/
+normal_task_submitter.cc — lease request/reuse keyed by task shape;
+actor_task_submitter.cc — per-actor ordered queues with restart handling).
+
+All submitter state lives on the shared IO loop; public entry points are
+thread-safe wrappers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import ActorID, ObjectID
+from ray_tpu.common.status import (
+    ActorDiedError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.common.task_spec import PlacementGroupStrategy, TaskSpec
+from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcError
+
+logger = logging.getLogger(__name__)
+
+
+class NormalTaskSubmitter:
+    """Per-shape lease pools; pushes tasks directly to leased workers."""
+
+    def __init__(self, core_worker):
+        self._cw = core_worker
+        self._io = IoContext.current()
+        self._queues: Dict[tuple, List[TaskSpec]] = {}
+        self._leases_in_flight: Dict[tuple, int] = {}
+        self._lease_counter = 0
+
+    def submit(self, spec: TaskSpec):
+        self._io.loop.call_soon_threadsafe(self._enqueue, spec)
+
+    def _enqueue(self, spec: TaskSpec):
+        key = spec.shape_key()
+        self._queues.setdefault(key, []).append(spec)
+        in_flight = self._leases_in_flight.get(key, 0)
+        max_leases = GLOBAL_CONFIG.get("lease_request_batch_size")
+        if in_flight < min(len(self._queues[key]), max_leases):
+            self._leases_in_flight[key] = in_flight + 1
+            self._io.spawn(self._lease_and_run(key, spec))
+
+    async def _lease_and_run(self, key: tuple, sample: TaskSpec):
+        """Obtain one lease, drain queue tasks through it, return the lease."""
+        try:
+            while self._queues.get(key):
+                grant = await self._request_lease(sample)
+                if grant is None:
+                    # infeasible right now — fail queued tasks of this shape
+                    for spec in self._queues.pop(key, []):
+                        self._store_error(
+                            spec,
+                            WorkerCrashedError(
+                                "task is infeasible: no node can ever satisfy "
+                                f"{sample.required_resources.resources.to_dict()}"),
+                        )
+                    return
+                raylet_addr, lease_id, worker_addr = grant
+                try:
+                    await self._run_on_lease(key, lease_id, worker_addr)
+                finally:
+                    try:
+                        c = RetryableRpcClient(raylet_addr, deadline_s=5.0)
+                        await c.call_async("return_worker", lease_id=lease_id)
+                        c.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        finally:
+            self._leases_in_flight[key] = max(0, self._leases_in_flight.get(key, 1) - 1)
+
+    async def _request_lease(self, spec: TaskSpec):
+        """Lease protocol with spillback: follow redirects up to a few hops."""
+        self._lease_counter += 1
+        lease_id = self._lease_counter.to_bytes(8, "little") + self._cw.worker_id.binary()
+        raylet_addr = self._cw.raylet_address
+        strategy = pickle.dumps(spec.scheduling_strategy)
+        pg = None
+        if isinstance(spec.scheduling_strategy, PlacementGroupStrategy):
+            pg = (spec.scheduling_strategy.placement_group_id.binary(),
+                  spec.scheduling_strategy.bundle_index)
+        for _hop in range(8):
+            client = RetryableRpcClient(raylet_addr, deadline_s=30.0)
+            try:
+                # No client-side timeout: a queued lease legitimately blocks
+                # until resources free up; truly impossible demands come back
+                # as an explicit "infeasible" status from the raylet.
+                reply = await client.call_async(
+                    "request_worker_lease",
+                    lease_id=lease_id,
+                    resources=spec.required_resources.to_dict(),
+                    strategy=strategy,
+                    pg=pg,
+                    timeout=None,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("lease request to %s failed: %s", raylet_addr, e)
+                return None
+            finally:
+                client.close()
+            status = reply.get("status")
+            if status == "granted":
+                logger.debug("lease granted: worker %s", reply["worker_address"])
+                return raylet_addr, lease_id, tuple(reply["worker_address"])
+            if status == "spill":
+                raylet_addr = tuple(reply["address"])
+                continue
+            if status == "infeasible":
+                return None
+        return None
+
+    async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr):
+        client = RpcClient(worker_addr)
+        try:
+            while True:
+                queue = self._queues.get(key)
+                if not queue:
+                    return
+                spec = queue.pop(0)
+                logger.debug("pushing task %s to %s", spec.task_id.hex()[:8], worker_addr)
+                try:
+                    reply = await client.call_async(
+                        "push_task", spec=pickle.dumps(spec), timeout=None,
+                    )
+                except Exception as e:  # noqa: BLE001 - leased worker died
+                    await self._handle_push_failure(spec, e)
+                    return
+                logger.debug("task %s replied", spec.task_id.hex()[:8])
+                self._cw.store_task_reply(spec, reply, worker_addr)
+        finally:
+            client.close()
+
+    async def _handle_push_failure(self, spec: TaskSpec, exc: Exception):
+        if spec.max_retries > 0:
+            spec.max_retries -= 1
+            logger.info("retrying task %s after push failure: %s",
+                        spec.task_id.hex()[:8], exc)
+            # brief backoff: give the raylet time to reap dead workers so the
+            # retry isn't granted the same dying worker again
+            await asyncio.sleep(0.3)
+            self._enqueue(spec)
+        else:
+            self._store_error(spec, WorkerCrashedError(
+                f"worker died executing task {spec.name or spec.function.qualname}: {exc}"))
+
+    def _store_error(self, spec: TaskSpec, error: Exception):
+        blob = pickle.dumps(error)
+        for oid in spec.return_ids():
+            self._cw.memory_store.put(oid, error=blob)
+
+
+class ActorTaskSubmitter:
+    """One per (caller, actor): ordered submission with restart-aware resend."""
+
+    def __init__(self, core_worker, actor_id: ActorID):
+        self._cw = core_worker
+        self.actor_id = actor_id
+        self._io = IoContext.current()
+        self._seq = 0
+        self._queue: List[TaskSpec] = []
+        self._inflight: Dict[int, TaskSpec] = {}
+        self._client: Optional[RpcClient] = None
+        self._address: Optional[Tuple[str, int]] = None
+        self._state = "RESOLVING"  # RESOLVING | CONNECTED | DEAD
+        self._death_error: Optional[Exception] = None
+        self._pump_scheduled = False
+        self._resolving = False
+
+    def next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def submit(self, spec: TaskSpec):
+        self._io.loop.call_soon_threadsafe(self._enqueue, spec)
+
+    def _enqueue(self, spec: TaskSpec):
+        if self._state == "DEAD":
+            self._fail_spec(spec, self._death_error or ActorDiedError(self.actor_id))
+            return
+        self._queue.append(spec)
+        self._schedule_pump()
+
+    def _schedule_pump(self):
+        if not self._pump_scheduled:
+            self._pump_scheduled = True
+            self._io.spawn(self._pump())
+
+    async def _pump(self):
+        self._pump_scheduled = False
+        if self._state == "RESOLVING":
+            await self._resolve_address()
+        if self._state != "CONNECTED":
+            return
+        while self._queue:
+            spec = self._queue.pop(0)
+            self._inflight[spec.sequence_number] = spec
+            self._io.spawn(self._push(spec))
+
+    async def _resolve_address(self):
+        if self._resolving:  # single resolver; others wait for its outcome
+            while self._resolving:
+                await asyncio.sleep(0.05)
+            return
+        self._resolving = True
+        try:
+            await self._resolve_address_inner()
+        finally:
+            self._resolving = False
+
+    async def _resolve_address_inner(self):
+        prev_addr = self._address
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while asyncio.get_running_loop().time() < deadline:
+            try:
+                info = await self._cw.gcs.call_async("get_actor", actor_id=self.actor_id.binary())
+            except Exception:  # noqa: BLE001
+                await asyncio.sleep(0.5)
+                continue
+            if info is None:
+                self._mark_dead(ActorDiedError(self.actor_id, "actor not found"))
+                return
+            state = info["state"]
+            if state == "ALIVE" and info.get("address"):
+                self._address = tuple(info["address"])
+                self._client = RpcClient(self._address)
+                # Everything unacked goes back to the front of the queue.  A
+                # NEW incarnation (address changed) starts a fresh sequence
+                # space, so renumber from 1 — the restarted actor's ordering
+                # state is empty and would otherwise wait forever for the old
+                # sequence numbers (reference: actor_task_submitter resend
+                # protocol).
+                pending = sorted(self._inflight.values(),
+                                 key=lambda s: s.sequence_number) + self._queue
+                self._inflight.clear()
+                if pending and prev_addr is not None and self._address != prev_addr:
+                    self._seq = 0
+                    for spec in pending:
+                        spec.sequence_number = self.next_seq()
+                    logger.info("actor %s restarted; resending %d calls",
+                                self.actor_id.hex()[:8], len(pending))
+                self._queue = pending
+                self._state = "CONNECTED"
+                return
+            if state == "DEAD":
+                self._mark_dead(ActorDiedError(self.actor_id, info.get("death_cause", "")))
+                return
+            await asyncio.sleep(0.2)
+        self._mark_dead(ActorDiedError(self.actor_id, "timed out resolving actor address"))
+
+    async def _push(self, spec: TaskSpec):
+        client = self._client
+        try:
+            reply = await client.call_async("push_task", spec=pickle.dumps(spec), timeout=None)
+        except Exception as e:  # noqa: BLE001 - actor worker died / restarting
+            await self._on_connection_failure(e)
+            return
+        self._inflight.pop(spec.sequence_number, None)
+        self._cw.store_task_reply(spec, reply, self._address)
+
+    async def _on_connection_failure(self, exc: Exception):
+        if self._state != "CONNECTED":
+            return
+        self._state = "RESOLVING"
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        # Actor may be restarting: re-resolve.  _resolve_address requeues all
+        # unacked calls and renumbers them if this is a new incarnation.
+        await self._resolve_address()
+        if self._state == "CONNECTED":
+            self._schedule_pump()
+
+    def _mark_dead(self, error: Exception):
+        self._state = "DEAD"
+        self._death_error = error
+        for spec in list(self._inflight.values()) + self._queue:
+            self._fail_spec(spec, error)
+        self._inflight.clear()
+        self._queue.clear()
+
+    def _fail_spec(self, spec: TaskSpec, error: Exception):
+        blob = pickle.dumps(error)
+        for oid in spec.return_ids():
+            self._cw.memory_store.put(oid, error=blob)
+
+    def notify_actor_state(self, view: dict):
+        """Pubsub-driven: DEAD → fail; ALIVE after restart → reconnect."""
+        state = view.get("state")
+        if state == "DEAD" and self._state != "DEAD":
+            self._io.loop.call_soon_threadsafe(
+                self._mark_dead, ActorDiedError(self.actor_id, view.get("death_cause", "")))
+        elif state == "ALIVE" and self._state == "RESOLVING":
+            self._io.loop.call_soon_threadsafe(self._schedule_pump)

@@ -1,0 +1,753 @@
+"""CoreWorker — the runtime embedded in every driver and worker process.
+
+Equivalent of the reference's core worker library (src/ray/core_worker/
+core_worker.cc + the Cython bridge _raylet.pyx): task submission and
+execution, object put/get/wait, ownership (each object's owner is the worker
+that created it; the owner holds value/location/lineage and drives recovery),
+actor creation/calls, and the worker-side RPC service (PushTask equivalent).
+
+Failure semantics implemented here:
+- push failure → retry with fresh lease while ``max_retries`` remains;
+- fetch-from-holder failure → owner reconstructs the object by re-executing
+  the creating task from lineage (reference: object_recovery_manager.h:43);
+- actor restart → unacked calls resent in order (actor_task_submitter.cc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    _Counter,
+)
+from ray_tpu.common.status import (
+    ObjectLostError,
+    RtError,
+    RtTimeoutError,
+    TaskError,
+)
+from ray_tpu.common.task_spec import (
+    DefaultStrategy,
+    FunctionDescriptor,
+    TaskArg,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.gcs.client import GcsClient
+from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcServer
+from .memory_store import MemoryStore
+from .reference import ObjectRef, install_release_sink
+from .submitter import ActorTaskSubmitter, NormalTaskSubmitter
+
+logger = logging.getLogger(__name__)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+
+class _TaskContext(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.task_index = 0
+        self.put_index = 0
+
+
+class CoreWorker:
+    """One per process. Thread-safe public API; internals on the IO loop."""
+
+    _current: Optional["CoreWorker"] = None
+
+    @classmethod
+    def current_or_raise(cls) -> "CoreWorker":
+        if cls._current is None:
+            raise RuntimeError("ray_tpu.init() must be called first")
+        return cls._current
+
+    def __init__(
+        self,
+        mode: str,
+        gcs_address: Tuple[str, int],
+        raylet_address: Tuple[str, int],
+        node_id: NodeID,
+        job_id: Optional[JobID] = None,
+        worker_id: Optional[WorkerID] = None,
+        port: int = 0,
+    ):
+        self.mode = mode
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self.gcs_address = tuple(gcs_address)
+        self.raylet_address = tuple(raylet_address)
+        self._io = IoContext.current()
+
+        self.server = RpcServer(port=port)
+        for name in (
+            "push_task", "create_actor", "get_object", "free_object",
+            "reconstruct_object", "set_visible_devices", "ping", "exit_worker",
+            "actor_method_metadata",
+        ):
+            self.server.register(name, getattr(self, f"h_{name}"))
+        self.server.start()
+
+        self.gcs = GcsClient(self.gcs_address, client_id=f"worker-{self.worker_id.hex()[:8]}")
+        self.memory_store = MemoryStore()
+        self.submitter = NormalTaskSubmitter(self)
+        self._actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
+        self._actor_sub_lock = threading.Lock()
+        self._actor_events_subscribed = False
+
+        if mode == MODE_DRIVER:
+            self.job_id = job_id or JobID(self.gcs.call("get_next_job_id"))
+            self.gcs.register_job(self.job_id, self.server.address)
+        else:
+            self.job_id = job_id or JobID.nil()
+
+        self._ctx = _TaskContext()
+        self._driver_task_id = TaskID.for_driver(self.job_id)
+        self._actor_counter = _Counter()
+
+        # ownership state (owner side)
+        self.lineage: Dict[ObjectID, TaskSpec] = {}
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: Dict[ObjectID, float] = {}
+
+        # execution state (executee side)
+        self._executor = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rt-exec")
+        self._actor_instance: Any = None
+        self._actor_max_concurrency = 1
+        self._actor_id: Optional[ActorID] = None
+        self._actor_lock = threading.Lock()
+        self._actor_seq_cv = threading.Condition()
+        # per-caller ordering state (reference: one scheduling queue per caller,
+        # core_worker/transport/actor_scheduling_queue.cc)
+        self._actor_seq_state: Dict[bytes, dict] = {}
+        self._actor_concurrency: Optional[threading.Semaphore] = None
+        self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
+
+        install_release_sink(self._on_ref_deleted)
+        CoreWorker._current = self
+
+    # ------------------------------------------------------------- contexts
+    def current_task_id(self) -> TaskID:
+        return self._ctx.task_id or self._driver_task_id
+
+    def next_task_index(self) -> int:
+        self._ctx.task_index += 1
+        return self._ctx.task_index
+
+    def next_put_index(self) -> int:
+        self._ctx.put_index += 1
+        return self._ctx.put_index
+
+    # ---------------------------------------------------------- serialization
+    @staticmethod
+    def serialize(value: Any) -> bytes:
+        return cloudpickle.dumps(value)
+
+    @staticmethod
+    def deserialize(blob: bytes) -> Any:
+        return pickle.loads(blob)
+
+    # ----------------------------------------------------------------- put/get
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
+        blob = self.serialize(value)
+        self.memory_store.put(oid, value=blob)
+        return ObjectRef(oid, self.worker_id, self.server.address)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        for ref in refs:
+            self._ensure_local(ref, timeout)
+        out = []
+        for ref in refs:
+            entry = self.memory_store.get_blocking(ref.object_id, timeout)
+            if entry.error is not None:
+                raise self.deserialize(entry.error)
+            if entry.value is not None:
+                out.append(self.deserialize(entry.value))
+            elif entry.location is not None:
+                # large object held remotely: fetch (blocking, off-loop)
+                blob = self._fetch_from_location(ref, entry.location, timeout)
+                out.append(self.deserialize(blob))
+            else:
+                raise ObjectLostError(ref.object_id, "entry has no value")
+        return out
+
+    def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if fetch_local:
+            for ref in refs:
+                self._ensure_local(ref, timeout)
+        ready_ids, rest_ids = self.memory_store.wait_ready(
+            [r.object_id for r in refs], num_returns, timeout)
+        by_id = {r.object_id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in rest_ids]
+
+    def _ensure_local(self, ref: ObjectRef, timeout: Optional[float]):
+        """If we don't own `ref` and don't hold it, start an async fetch."""
+        if self.memory_store.contains(ref.object_id):
+            return
+        if ref.owner_address in (None, self.server.address):
+            return  # we own it: value arrives via task reply
+        self.memory_store.mark_pending(ref.object_id)
+
+        async def fetch():
+            oid = ref.object_id
+            if oid in self._fetch_inflight:
+                return
+            fut = asyncio.get_running_loop().create_future()
+            self._fetch_inflight[oid] = fut
+            try:
+                blob = await self._fetch_async(ref)
+                if isinstance(blob, _RemoteError):
+                    self.memory_store.put(oid, error=blob.blob)
+                else:
+                    self.memory_store.put(oid, value=blob)
+            except Exception as e:  # noqa: BLE001
+                self.memory_store.put(oid, error=pickle.dumps(
+                    ObjectLostError(oid, f"fetch failed: {e}")))
+            finally:
+                self._fetch_inflight.pop(oid, None)
+                fut.set_result(None)
+
+        self._io.spawn_threadsafe(fetch())
+
+    async def _fetch_async(self, ref: ObjectRef, allow_reconstruct: bool = True) -> bytes:
+        """Ask the owner for value-or-location; chase the location; on holder
+        death ask the owner to reconstruct from lineage."""
+        owner = RetryableRpcClient(ref.owner_address, deadline_s=30.0)
+        try:
+            reply = await owner.call_async(
+                "get_object", object_id=ref.object_id.binary(), timeout=None)
+            if reply.get("error") is not None:
+                return _RemoteError(reply["error"])
+            if reply.get("value") is not None:
+                return reply["value"]
+            location = reply.get("location")
+            if location is None:
+                raise ObjectLostError(ref.object_id, "owner has no value or location")
+            holder = RpcClient(tuple(location))
+            try:
+                r2 = await holder.call_async(
+                    "get_object", object_id=ref.object_id.binary(), timeout=30.0)
+                if r2.get("value") is not None:
+                    return r2["value"]
+                raise ObjectLostError(ref.object_id, "holder lost the value")
+            except (Exception,) as e:  # noqa: BLE001 - holder died
+                holder.close()
+                if not allow_reconstruct:
+                    raise
+                await owner.call_async(
+                    "reconstruct_object", object_id=ref.object_id.binary(), timeout=None)
+                return await self._fetch_async(ref, allow_reconstruct=False)
+        finally:
+            owner.close()
+
+    def _fetch_from_location(self, ref: ObjectRef, location, timeout) -> bytes:
+        """Owner-side blocking fetch of a large result held by the executor."""
+        async def go():
+            holder = RpcClient(tuple(location))
+            try:
+                r = await holder.call_async(
+                    "get_object", object_id=ref.object_id.binary(), timeout=30.0)
+                return r.get("value")
+            finally:
+                holder.close()
+
+        try:
+            value = self._io.run(go(), timeout)
+            if value is None:
+                raise ObjectLostError(ref.object_id, "holder lost the value")
+            return value
+        except (RtError, Exception) as e:  # holder dead → reconstruct
+            if self._try_reconstruct(ref.object_id):
+                entry = self.memory_store.get_blocking(ref.object_id, timeout)
+                if entry.error is not None:
+                    raise self.deserialize(entry.error)
+                if entry.value is not None:
+                    return entry.value
+                if entry.location is not None:
+                    return self._fetch_from_location(ref, entry.location, timeout)
+            raise ObjectLostError(ref.object_id, f"fetch failed: {e}") from e
+
+    # ------------------------------------------------------- task submission
+    def submit_task(
+        self,
+        func,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        resources: Optional[dict] = None,
+        label_selector: Optional[dict] = None,
+        scheduling_strategy=None,
+        max_retries: Optional[int] = None,
+        name: str = "",
+        serialized_func: Optional[bytes] = None,
+    ) -> List[ObjectRef]:
+        from ray_tpu.common.resources import ResourceRequest
+
+        task_id = TaskID.for_normal_task(
+            self.job_id, self.current_task_id(), self.next_task_index())
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function=FunctionDescriptor(
+                getattr(func, "__module__", "?"), getattr(func, "__qualname__", str(func))),
+            serialized_func=serialized_func or cloudpickle.dumps(func),
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            required_resources=ResourceRequest(
+                {"CPU": 1} if resources is None else resources, label_selector),
+            scheduling_strategy=scheduling_strategy or DefaultStrategy(),
+            max_retries=GLOBAL_CONFIG.get("max_task_retries") if max_retries is None else max_retries,
+            parent_task_id=self.current_task_id(),
+            caller_worker_id=self.worker_id,
+            caller_address=self.server.address,
+            name=name,
+        )
+        return self._register_and_submit(spec)
+
+    def _register_and_submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = []
+        with self._lineage_lock:
+            for oid in spec.return_ids():
+                self.memory_store.mark_pending(oid)
+                if GLOBAL_CONFIG.get("lineage_pinning_enabled"):
+                    self.lineage[oid] = spec
+                refs.append(ObjectRef(oid, self.worker_id, self.server.address))
+        if spec.is_actor_task():
+            self._actor_submitter(spec.actor_id).submit(spec)
+        else:
+            self.submitter.submit(spec)
+        return refs
+
+    def _serialize_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
+        """Inline small values; pass ObjectRefs by reference."""
+        out: List[TaskArg] = []
+        plain_args = list(args)
+        if kwargs:
+            plain_args.append(_KwArgsMarker(kwargs))
+        for value in plain_args:
+            if isinstance(value, ObjectRef):
+                arg = TaskArg.by_ref(value.object_id, value.owner_id)
+                arg.owner_address = value.owner_address
+                out.append(arg)
+            else:
+                out.append(TaskArg.inline(self.serialize(value)))
+        return out
+
+    # --------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, *, resources=None, label_selector=None,
+                     scheduling_strategy=None, max_restarts=0, max_concurrency=1,
+                     name=None, namespace="default") -> "ActorID":
+        from ray_tpu.common.resources import ResourceRequest
+
+        actor_id = ActorID.of(self.job_id, self.current_task_id(), self._actor_counter.next())
+        creation_task_id = TaskID.for_actor_creation_task(actor_id)
+        spec = TaskSpec(
+            task_id=creation_task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function=FunctionDescriptor(
+                getattr(cls, "__module__", "?"), getattr(cls, "__qualname__", str(cls))),
+            serialized_func=cloudpickle.dumps(cls),
+            args=self._serialize_args(args, kwargs),
+            num_returns=0,
+            required_resources=ResourceRequest(resources or {}, label_selector),
+            scheduling_strategy=scheduling_strategy or DefaultStrategy(),
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            caller_worker_id=self.worker_id,
+            caller_address=self.server.address,
+            name=name or "",
+        )
+        reply = self.gcs.register_actor(
+            pickle.dumps(spec), actor_id, self.job_id, name=name,
+            namespace=namespace, max_restarts=max_restarts)
+        if not reply.get("ok"):
+            raise RtError(reply.get("error", "actor registration failed"))
+        return actor_id
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
+                          *, num_returns: int = 1, name: str = "") -> List[ObjectRef]:
+        from ray_tpu.common.resources import ResourceRequest
+
+        sub = self._actor_submitter(actor_id)
+        seq = sub.next_seq()
+        task_id = TaskID.for_actor_task(actor_id, self.current_task_id(), self.next_task_index())
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            function=FunctionDescriptor("", method_name),
+            serialized_func=None,
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns,
+            required_resources=ResourceRequest({}),
+            actor_id=actor_id,
+            actor_method_name=method_name,
+            sequence_number=seq,
+            caller_worker_id=self.worker_id,
+            caller_address=self.server.address,
+            name=name or method_name,
+        )
+        return self._register_and_submit(spec)
+
+    def _actor_submitter(self, actor_id: ActorID) -> ActorTaskSubmitter:
+        with self._actor_sub_lock:
+            sub = self._actor_submitters.get(actor_id)
+            if sub is None:
+                sub = ActorTaskSubmitter(self, actor_id)
+                self._actor_submitters[actor_id] = sub
+                if not self._actor_events_subscribed:
+                    self._actor_events_subscribed = True
+                    self.gcs.subscriber.subscribe("actor", self._on_actor_event)
+            return sub
+
+    def _on_actor_event(self, actor_hex: str, view: dict):
+        with self._actor_sub_lock:
+            for aid, sub in self._actor_submitters.items():
+                if aid.hex() == actor_hex:
+                    sub.notify_actor_state(view)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.gcs.kill_actor(actor_id, no_restart)
+
+    # -------------------------------------------------------- reply handling
+    def store_task_reply(self, spec: TaskSpec, reply: dict, executor_addr):
+        """Owner side: record results (values inline, or locations for large)."""
+        results = reply.get("results", {})
+        for oid_bytes, payload in results.items():
+            oid = ObjectID(oid_bytes)
+            if "value" in payload:
+                self.memory_store.put(oid, value=payload["value"])
+            elif "error" in payload:
+                self.memory_store.put(oid, error=payload["error"])
+            elif "location" in payload:
+                self.memory_store.put(oid, location=tuple(payload["location"]))
+
+    # ----------------------------------------------------------- lineage/GC
+    def _try_reconstruct(self, object_id: ObjectID) -> bool:
+        with self._lineage_lock:
+            spec = self.lineage.get(object_id)
+            now = time.monotonic()
+            if spec is None:
+                return False
+            last = self._reconstructing.get(object_id, 0)
+            if now - last < 1.0:
+                return True  # already resubmitted very recently
+            self._reconstructing[object_id] = now
+        logger.info("reconstructing %s via lineage re-execution", object_id.hex()[:12])
+        respec = pickle.loads(pickle.dumps(spec))  # fresh copy
+        self.memory_store.free(respec.return_ids())
+        for oid in respec.return_ids():
+            self.memory_store.mark_pending(oid)
+        if respec.is_actor_task():
+            self._actor_submitter(respec.actor_id).submit(respec)
+        else:
+            self.submitter.submit(respec)
+        return True
+
+    def _on_ref_deleted(self, ref: ObjectRef):
+        """Owner-local GC: drop value + lineage when our ref count is gone.
+        Borrowed refs notify the owner (best effort)."""
+        if ref.owner_address == self.server.address:
+            with self._lineage_lock:
+                self.lineage.pop(ref.object_id, None)
+            self.memory_store.free([ref.object_id])
+        elif getattr(ref, "_borrowed", False) and ref.owner_address is not None:
+            # fire-and-forget decref to owner
+            async def dec():
+                try:
+                    c = RpcClient(ref.owner_address)
+                    await c.call_async("free_object", object_id=ref.object_id.binary(),
+                                       borrowed=True, timeout=5.0)
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                self._io.spawn_threadsafe(dec())
+            except Exception:  # noqa: BLE001 - shutdown
+                pass
+
+    # ---------------------------------------------------------- rpc handlers
+    async def h_ping(self):
+        return True
+
+    async def h_set_visible_devices(self, tpu_chips: Optional[List[int]] = None,
+                                    gpu_ids: Optional[List[int]] = None):
+        """Must run before jax initializes in this process (reference mirrors
+        tpu.py:32 set_current_process_visible_accelerator_ids)."""
+        if tpu_chips is not None:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in tpu_chips)
+            os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(tpu_chips)},1"
+        if gpu_ids is not None:
+            os.environ["CUDA_VISIBLE_DEVICES"] = ",".join(str(i) for i in gpu_ids)
+        return True
+
+    async def h_exit_worker(self):
+        def die():
+            time.sleep(0.1)
+            os._exit(0)
+        threading.Thread(target=die, daemon=True).start()
+        return True
+
+    async def h_get_object(self, object_id: bytes, timeout: float = 60.0):
+        oid = ObjectID(object_id)
+        loop = asyncio.get_running_loop()
+        entry = await loop.run_in_executor(
+            self._executor, lambda: self._blocking_entry(oid, timeout))
+        if entry is None:
+            return {"error": pickle.dumps(ObjectLostError(oid, "unknown object"))}
+        if entry.error is not None:
+            return {"error": entry.error}
+        if entry.value is not None:
+            return {"value": entry.value}
+        if entry.location is not None:
+            return {"location": entry.location}
+        return {"error": pickle.dumps(ObjectLostError(oid, "empty entry"))}
+
+    def _blocking_entry(self, oid: ObjectID, timeout: float):
+        try:
+            return self.memory_store.get_blocking(oid, timeout)
+        except RtTimeoutError:
+            return None
+
+    async def h_free_object(self, object_id: bytes, borrowed: bool = False):
+        # borrowed decrefs are advisory in phase 1 (owner-local GC governs)
+        return True
+
+    async def h_reconstruct_object(self, object_id: bytes):
+        oid = ObjectID(object_id)
+        ok = self._try_reconstruct(oid)
+        if not ok:
+            return {"ok": False}
+        # wait until the reconstructed value lands
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self._executor, lambda: self._blocking_entry(oid, 120.0))
+        return {"ok": True}
+
+    async def h_actor_method_metadata(self):
+        with self._actor_lock:
+            inst = self._actor_instance
+        if inst is None:
+            return None
+        return [m for m in dir(inst) if not m.startswith("_")]
+
+    # ------------------------------------------------------------- execution
+    async def h_push_task(self, spec: bytes):
+        task: TaskSpec = pickle.loads(spec)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, self._execute_task, task)
+
+    async def h_create_actor(self, creation_spec: bytes, node_id: bytes):
+        task: TaskSpec = pickle.loads(creation_spec)
+        loop = asyncio.get_running_loop()
+
+        def create():
+            try:
+                cls = cloudpickle.loads(task.serialized_func)
+                args, kwargs = self._resolve_args(task.args)
+                self._ctx.task_id = task.task_id
+                inst = cls(*args, **kwargs)
+                with self._actor_lock:
+                    self._actor_instance = inst
+                    self._actor_id = task.actor_id
+                    self._actor_max_concurrency = max(1, task.max_concurrency)
+                    self._actor_concurrency = threading.Semaphore(
+                        self._actor_max_concurrency)
+                return None
+            except Exception as e:  # noqa: BLE001
+                return (e, traceback.format_exc())
+
+        err = await loop.run_in_executor(self._executor, create)
+        if err is not None:
+            await self.gcs.call_async(
+                "report_actor_state", actor_id=task.actor_id.binary(), state="DEAD",
+                worker_id=self.worker_id.binary(),
+                death_cause=f"creation failed: {err[0]!r}\n{err[1]}")
+            return {"ok": False}
+        await self.gcs.call_async(
+            "report_actor_state", actor_id=task.actor_id.binary(), state="ALIVE",
+            worker_id=self.worker_id.binary(), address=self.server.address,
+            node_id=node_id)
+        return {"ok": True}
+
+    def _execute_task(self, task: TaskSpec) -> dict:
+        """Runs on an executor thread."""
+        if task.is_actor_task():
+            return self._execute_actor_task(task)
+        return self._execute_fn_task(task)
+
+    def _execute_fn_task(self, task: TaskSpec) -> dict:
+        self._ctx.task_id = task.task_id
+        self._ctx.task_index = 0
+        self._ctx.put_index = 0
+        try:
+            fn = cloudpickle.loads(task.serialized_func)
+            args, kwargs = self._resolve_args(task.args)
+            result = fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - user task error
+            return self._error_reply(task, e)
+        finally:
+            self._ctx.task_id = None
+        return self._result_reply(task, result)
+
+    _REPLY_CACHE_CAP = 2048  # per caller; bounds memory on long-lived actors
+
+    def _execute_actor_task(self, task: TaskSpec) -> dict:
+        # In-order execution per caller (unless concurrency > 1).  Completed
+        # replies are cached per (caller, seq) so a duplicate resend — the
+        # connection died before the reply was delivered — replays the
+        # original reply instead of leaving the caller's refs unresolved.
+        concurrency = self._actor_concurrency or threading.Semaphore(1)
+        ordered = self._actor_max_concurrency <= 1
+        caller = (task.caller_worker_id.binary()
+                  if task.caller_worker_id is not None else b"?")
+        seq = task.sequence_number
+        with self._actor_seq_cv:
+            st = self._actor_seq_state.setdefault(
+                caller, {"next": 1, "replies": {}})
+            if seq in st["replies"]:
+                return st["replies"][seq]  # duplicate: replay
+            if seq < st["next"]:
+                # executed long ago and pruned: the reply must have been
+                # delivered (resends only happen for unacked calls)
+                return {"results": {}}
+            while ordered and seq > st["next"]:
+                self._actor_seq_cv.wait(timeout=60.0)
+        concurrency.acquire()
+        reply: dict
+        try:
+            self._ctx.task_id = task.task_id
+            with self._actor_lock:
+                inst = self._actor_instance
+            if inst is None:
+                reply = self._error_reply(task, RtError("actor instance not initialized"))
+            else:
+                try:
+                    method = getattr(inst, task.actor_method_name)
+                    args, kwargs = self._resolve_args(task.args)
+                    result = method(*args, **kwargs)
+                    reply = self._result_reply(task, result)
+                except Exception as e:  # noqa: BLE001 - user method error
+                    reply = self._error_reply(task, e)
+            return reply
+        finally:
+            concurrency.release()
+            self._ctx.task_id = None
+            with self._actor_seq_cv:
+                st = self._actor_seq_state.setdefault(
+                    caller, {"next": 1, "replies": {}})
+                st["replies"][seq] = reply
+                if seq == st["next"]:
+                    st["next"] += 1
+                    while st["next"] in st["replies"]:  # out-of-order completions
+                        st["next"] += 1
+                if len(st["replies"]) > self._REPLY_CACHE_CAP:
+                    for s in sorted(st["replies"])[: self._REPLY_CACHE_CAP // 2]:
+                        del st["replies"][s]
+                self._actor_seq_cv.notify_all()
+
+    def _resolve_args(self, task_args: List[TaskArg]):
+        args: List[Any] = []
+        kwargs: Dict[str, Any] = {}
+        for arg in task_args:
+            if arg.is_inline:
+                value = self.deserialize(arg.value)
+            else:
+                value = self._get_dependency(arg)
+            if isinstance(value, _KwArgsMarker):
+                kwargs = value.kwargs
+            else:
+                args.append(value)
+        return args, kwargs
+
+    def _get_dependency(self, arg: TaskArg) -> Any:
+        oid = arg.object_id
+        entry = self.memory_store.get_if_ready(oid)
+        if entry is None:
+            owner_address = getattr(arg, "owner_address", None)
+            ref = ObjectRef(oid, arg.owner, owner_address)
+            self._ensure_local(ref, None)
+            entry = self.memory_store.get_blocking(oid, 120.0)
+        if entry.error is not None:
+            raise self.deserialize(entry.error)
+        if entry.value is not None:
+            return self.deserialize(entry.value)
+        if entry.location is not None:
+            ref = ObjectRef(oid, arg.owner, getattr(arg, "owner_address", None))
+            blob = self._fetch_from_location(ref, entry.location, 120.0)
+            return self.deserialize(blob)
+        raise ObjectLostError(oid, "dependency unavailable")
+
+    def _result_reply(self, task: TaskSpec, result: Any) -> dict:
+        values = (
+            [result] if task.num_returns == 1
+            else (list(result) if task.num_returns > 1 else [])
+        )
+        if task.num_returns > 1 and len(values) != task.num_returns:
+            return self._error_reply(task, ValueError(
+                f"task declared num_returns={task.num_returns} but returned "
+                f"{len(values)} values"))
+        results = {}
+        threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
+        for oid, value in zip(task.return_ids(), values):
+            blob = self.serialize(value)
+            if len(blob) <= threshold:
+                results[oid.binary()] = {"value": blob}
+            else:
+                self.memory_store.put(oid, value=blob)
+                results[oid.binary()] = {"location": self.server.address}
+        return {"results": results}
+
+    def _error_reply(self, task: TaskSpec, exc: Exception) -> dict:
+        tb = traceback.format_exc()
+        err = TaskError(task.task_id, exc, tb) if not isinstance(exc, RtError) else exc
+        blob = pickle.dumps(err)
+        return {"results": {oid.binary(): {"error": blob} for oid in task.return_ids()}}
+
+    # ---------------------------------------------------------------- misc
+    def cluster_resources(self) -> dict:
+        return self.gcs.cluster_resources()
+
+    def shutdown(self):
+        CoreWorker._current = None
+        install_release_sink(None)
+        try:
+            self.gcs.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self.server.stop()
+        self._executor.shutdown(wait=False)
+
+
+class _KwArgsMarker:
+    def __init__(self, kwargs: dict):
+        self.kwargs = kwargs
+
+
+class _RemoteError:
+    def __init__(self, blob: bytes):
+        self.blob = blob

@@ -1,0 +1,46 @@
+"""Worker process entrypoint, forked by the raylet.
+
+Reference analogue: python/ray/_private/workers/default_worker.py — connects
+back to its raylet, registers, then serves tasks until told to exit.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from ray_tpu.common.ids import NodeID, WorkerID
+from ray_tpu.rpc.rpc import RetryableRpcClient
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RT_LOG_LEVEL", "INFO"),
+        format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+    worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
+    raylet_host, _, raylet_port = os.environ["RT_RAYLET_ADDR"].partition(":")
+    gcs_host, _, gcs_port = os.environ["RT_GCS_ADDR"].partition(":")
+    node_id = NodeID.from_hex(os.environ["RT_NODE_ID"])
+
+    from .worker import MODE_WORKER, CoreWorker
+
+    cw = CoreWorker(
+        mode=MODE_WORKER,
+        gcs_address=(gcs_host, int(gcs_port)),
+        raylet_address=(raylet_host, int(raylet_port)),
+        node_id=node_id,
+        worker_id=worker_id,
+    )
+    raylet = RetryableRpcClient((raylet_host, int(raylet_port)))
+    reply = raylet.call(
+        "register_worker", worker_id=worker_id.binary(), address=cw.server.address)
+    if not reply.get("ok"):
+        return  # raylet doesn't know us: die quietly
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Internal key-value store (reference: gcs/gcs_server/gcs_kv_manager.h).
+
+Namespaced binary KV used for: collective group rendezvous, named actors,
+function table, cluster metadata.  In-memory with an optional JSON-lines
+append log for GCS restart recovery (the reference's Redis-backed fault
+tolerance, store_client/redis_store_client.h, is modeled as a flush/replay
+file since Redis isn't part of this image).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+
+class InternalKV:
+    def __init__(self, persist_path: Optional[str] = None):
+        self._data: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self._persist_path = persist_path
+        self._log = None
+        if persist_path:
+            if os.path.exists(persist_path):
+                self._replay(persist_path)
+            self._log = open(persist_path, "ab")
+
+    def _replay(self, path: str):
+        with open(path, "rb") as f:
+            while True:
+                try:
+                    op, key, value = pickle.load(f)
+                except EOFError:
+                    break
+                if op == "put":
+                    self._data[key] = value
+                elif op == "del":
+                    self._data.pop(key, None)
+
+    def _append(self, op: str, key: bytes, value: Optional[bytes]):
+        if self._log is not None:
+            pickle.dump((op, key, value), self._log)
+            self._log.flush()
+
+    @staticmethod
+    def _k(namespace: str, key: bytes | str) -> bytes:
+        if isinstance(key, str):
+            key = key.encode()
+        return namespace.encode() + b"\x00" + key
+
+    def put(self, namespace: str, key, value: bytes, overwrite: bool = True) -> bool:
+        k = self._k(namespace, key)
+        with self._lock:
+            if not overwrite and k in self._data:
+                return False
+            self._data[k] = value
+            self._append("put", k, value)
+            return True
+
+    def get(self, namespace: str, key) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(self._k(namespace, key))
+
+    def exists(self, namespace: str, key) -> bool:
+        with self._lock:
+            return self._k(namespace, key) in self._data
+
+    def delete(self, namespace: str, key) -> bool:
+        k = self._k(namespace, key)
+        with self._lock:
+            existed = self._data.pop(k, None) is not None
+            if existed:
+                self._append("del", k, None)
+            return existed
+
+    def keys(self, namespace: str, prefix: bytes | str = b"") -> List[bytes]:
+        p = self._k(namespace, prefix)
+        ns_len = len(namespace.encode()) + 1
+        with self._lock:
+            return [k[ns_len:] for k in self._data if k.startswith(p)]
+
+    def close(self):
+        if self._log is not None:
+            self._log.close()
+            self._log = None

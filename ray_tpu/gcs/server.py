@@ -1,0 +1,633 @@
+"""GCS — the cluster control plane.
+
+Equivalent of the reference's GCS server (src/ray/gcs/gcs_server/gcs_server.cc
+and its managers): node registry + health checking, aggregated resource view,
+job table, actor lifecycle management with restart-on-failure, placement
+groups with two-phase commit across raylets, internal KV, and a task-event
+store.  Data-plane state (objects) is deliberately NOT here — ownership lives
+with workers, as in the reference.
+
+State changes are published on pubsub channels: "node", "actor", "pg", "job",
+"resources".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu.common.resources import NodeResources, ResourceRequest
+from ray_tpu.rpc.pubsub import Publisher
+from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcServer
+from ray_tpu.scheduling import ClusterView, NodeEntry, policies
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference protocol: gcs_actor_manager.h:300-332)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+PG_RESCHEDULING = "RESCHEDULING"
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    job_id: JobID
+    name: Optional[str]
+    creation_spec: bytes  # pickled TaskSpec for the creation task
+    max_restarts: int
+    state: str = ACTOR_PENDING
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    address: Optional[Tuple[str, int]] = None
+    num_restarts: int = 0
+    death_cause: str = ""
+    handled_deaths: set = field(default_factory=set)
+
+    def public_view(self) -> dict:
+        return {
+            "actor_id": self.actor_id.hex(),
+            "job_id": self.job_id.hex(),
+            "name": self.name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+        }
+
+
+@dataclass
+class PgRecord:
+    pg_id: PlacementGroupID
+    name: Optional[str]
+    bundles: List[ResourceRequest]
+    strategy: str
+    state: str = PG_PENDING
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    creator_job: Optional[JobID] = None
+
+    def public_view(self) -> dict:
+        return {
+            "pg_id": self.pg_id.hex(),
+            "name": self.name,
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundles": [b.to_dict() for b in self.bundles],
+            "bundle_nodes": [n.hex() if n else None for n in self.bundle_nodes],
+        }
+
+
+@dataclass
+class JobRecord:
+    job_id: JobID
+    driver_address: Optional[Tuple[str, int]]
+    start_time: float
+    state: str = "RUNNING"
+    entrypoint: str = ""
+
+
+class RayletHandle:
+    """GCS-side client to one raylet."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self.address = address
+        self.client = RetryableRpcClient(address, deadline_s=10.0)
+
+    def close(self):
+        self.client.close()
+
+
+class GcsServer:
+    """All managers in one process, handlers on one event loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: Optional[str] = None):
+        from .kv import InternalKV
+
+        self.server = RpcServer(host, port)
+        self.publisher = Publisher()
+        self.publisher.attach(self.server)
+        self.view = ClusterView()
+        self.kv = InternalKV(persist_dir and f"{persist_dir}/gcs_kv.log")
+        self._raylets: Dict[NodeID, RayletHandle] = {}
+        self._actors: Dict[ActorID, ActorRecord] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace,name)
+        self._pgs: Dict[PlacementGroupID, PgRecord] = {}
+        self._jobs: Dict[JobID, JobRecord] = {}
+        self._job_counter = 0
+        self._task_events: List[dict] = []  # ring buffer
+        self._stopped = False
+        self._pending_actor_queue: List[ActorID] = []
+        self._pending_pg_queue: List[PlacementGroupID] = []
+        self._io = IoContext.current()
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ setup
+    def _register_handlers(self):
+        s = self.server
+        for name in (
+            "register_node", "unregister_node", "report_resources", "get_all_nodes",
+            "get_cluster_resources", "check_alive",
+            "register_job", "finish_job", "get_all_jobs", "get_next_job_id",
+            "register_actor", "report_actor_state", "get_actor", "get_actor_by_name",
+            "list_actors", "kill_actor",
+            "create_placement_group", "remove_placement_group", "get_placement_group",
+            "wait_placement_group_ready", "list_placement_groups",
+            "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
+            "add_task_events", "get_task_events",
+            "get_system_config", "health_check",
+        ):
+            s.register(name, getattr(self, f"h_{name}"))
+
+    def start(self):
+        self.server.start()
+        self._io.spawn_threadsafe(self._health_loop())
+
+    def stop(self):
+        self._stopped = True
+        for h in self._raylets.values():
+            h.close()
+        self.server.stop()
+        self.kv.close()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------- node mgmt
+    async def h_register_node(self, node_id: bytes, address, resources: dict, labels: dict,
+                              object_store_address: Optional[str] = None):
+        nid = NodeID(node_id)
+        entry = NodeEntry(
+            node_id=nid,
+            address=tuple(address),
+            resources=NodeResources(resources, labels),
+            object_store_address=object_store_address,
+        )
+        self.view.upsert(entry)
+        self._raylets[nid] = RayletHandle(tuple(address))
+        self.publisher.publish("node", nid.hex(), {"state": "ALIVE", "address": tuple(address)})
+        logger.info("node %s registered at %s", nid.hex()[:8], address)
+        self._kick_pending()
+        return {"ok": True, "system_config": GLOBAL_CONFIG.system_config_json()}
+
+    async def h_unregister_node(self, node_id: bytes):
+        nid = NodeID(node_id)
+        await self._on_node_dead(nid, "unregistered")
+        return True
+
+    async def h_report_resources(self, node_id: bytes, snapshot: dict, seq: int):
+        nid = NodeID(node_id)
+        entry = self.view.get(nid)
+        if entry is None:
+            return {"ok": False, "unknown": True}  # raylet should re-register
+        self.view.update_resources(nid, snapshot, seq)
+        self.publisher.publish("resources", nid.hex(), {"snapshot": snapshot, "seq": seq})
+        self._kick_pending()
+        return {"ok": True}
+
+    async def h_get_all_nodes(self):
+        return [
+            {
+                "node_id": e.node_id.binary(),
+                "address": e.address,
+                "alive": e.alive,
+                "resources": e.resources.snapshot(),
+                "object_store_address": e.object_store_address,
+            }
+            for e in self.view.all_nodes()
+        ]
+
+    async def h_get_cluster_resources(self):
+        return {
+            "total": self.view.total_resources(),
+            "available": self.view.available_resources(),
+        }
+
+    async def h_check_alive(self, node_ids: List[bytes]):
+        out = []
+        for raw in node_ids:
+            e = self.view.get(NodeID(raw))
+            out.append(bool(e and e.alive))
+        return out
+
+    async def _health_loop(self):
+        period = GLOBAL_CONFIG.get("health_check_period_ms") / 1000.0
+        threshold = GLOBAL_CONFIG.get("health_check_failure_threshold")
+        await asyncio.sleep(GLOBAL_CONFIG.get("health_check_initial_delay_ms") / 1000.0)
+        misses: Dict[NodeID, int] = {}
+        while not self._stopped:
+            for entry in list(self.view.alive_nodes()):
+                handle = self._raylets.get(entry.node_id)
+                if handle is None:
+                    continue
+                try:
+                    await handle.client._client.call_async(
+                        "health_check", timeout=GLOBAL_CONFIG.get("health_check_timeout_ms") / 1000.0
+                    )
+                    misses[entry.node_id] = 0
+                except Exception:  # noqa: BLE001
+                    misses[entry.node_id] = misses.get(entry.node_id, 0) + 1
+                    if misses[entry.node_id] >= threshold:
+                        await self._on_node_dead(entry.node_id, "health check failed")
+            await asyncio.sleep(period)
+
+    async def _on_node_dead(self, nid: NodeID, reason: str):
+        entry = self.view.mark_dead(nid)
+        if entry is None:
+            return
+        logger.warning("node %s dead: %s", nid.hex()[:8], reason)
+        handle = self._raylets.pop(nid, None)
+        if handle:
+            handle.close()
+        self.publisher.publish("node", nid.hex(), {"state": "DEAD", "reason": reason})
+        # fail over actors that lived there
+        for rec in list(self._actors.values()):
+            if rec.node_id == nid and rec.state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
+                await self._on_actor_failure(rec, f"node died: {reason}")
+        # reschedule PG bundles that lived there
+        for pg in list(self._pgs.values()):
+            if pg.state in (PG_CREATED, PG_PENDING) and any(b == nid for b in pg.bundle_nodes):
+                pg.state = PG_RESCHEDULING
+                pg.bundle_nodes = [None if b == nid else b for b in pg.bundle_nodes]
+                self.publisher.publish("pg", pg.pg_id.hex(), pg.public_view())
+                self._pending_pg_queue.append(pg.pg_id)
+        self._kick_pending()
+
+    # ------------------------------------------------------------------ jobs
+    async def h_get_next_job_id(self):
+        self._job_counter += 1
+        return JobID.from_int(self._job_counter).binary()
+
+    async def h_register_job(self, job_id: bytes, driver_address=None, entrypoint: str = ""):
+        jid = JobID(job_id)
+        self._jobs[jid] = JobRecord(jid, driver_address and tuple(driver_address), time.time(), entrypoint=entrypoint)
+        self.publisher.publish("job", jid.hex(), {"state": "RUNNING"})
+        return True
+
+    async def h_finish_job(self, job_id: bytes):
+        jid = JobID(job_id)
+        rec = self._jobs.get(jid)
+        if rec:
+            rec.state = "FINISHED"
+            self.publisher.publish("job", jid.hex(), {"state": "FINISHED"})
+        # tear down the job's detached=False actors
+        for actor in list(self._actors.values()):
+            if actor.job_id == jid and actor.state not in (ACTOR_DEAD,):
+                await self._kill_actor_internal(actor, "job finished")
+        return True
+
+    async def h_get_all_jobs(self):
+        return [
+            {"job_id": j.job_id.hex(), "state": j.state, "start_time": j.start_time,
+             "entrypoint": j.entrypoint}
+            for j in self._jobs.values()
+        ]
+
+    # ---------------------------------------------------------------- actors
+    async def h_register_actor(self, creation_spec: bytes, actor_id: bytes, job_id: bytes,
+                               name: Optional[str] = None, namespace: str = "default",
+                               max_restarts: int = 0):
+        aid = ActorID(actor_id)
+        if name is not None:
+            key = (namespace, name)
+            if key in self._named_actors:
+                existing = self._actors.get(self._named_actors[key])
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    return {"ok": False, "error": f"actor name {name!r} taken"}
+            self._named_actors[key] = aid
+        rec = ActorRecord(
+            actor_id=aid, job_id=JobID(job_id), name=name,
+            creation_spec=creation_spec, max_restarts=max_restarts,
+        )
+        self._actors[aid] = rec
+        await self._schedule_actor(rec)
+        return {"ok": True}
+
+    async def _schedule_actor(self, rec: ActorRecord):
+        """GcsActorScheduler equivalent: pick node, ask its raylet to start the
+        actor (raylet owns worker pool + resource accounting)."""
+        import pickle
+
+        spec = pickle.loads(rec.creation_spec)
+        node = policies.pick_node(self.view, spec.required_resources, spec.scheduling_strategy)
+        if node is None:
+            if rec.actor_id not in self._pending_actor_queue:
+                self._pending_actor_queue.append(rec.actor_id)
+            return
+        handle = self._raylets.get(node.node_id)
+        if handle is None:
+            return
+        rec.node_id = node.node_id
+        try:
+            reply = await handle.client.call_async(
+                "start_actor", creation_spec=rec.creation_spec, timeout=60.0
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("start_actor on %s failed: %s", node.node_id.hex()[:8], e)
+            self._pending_actor_queue.append(rec.actor_id)
+            return
+        if not reply.get("ok"):
+            if rec.actor_id not in self._pending_actor_queue:
+                self._pending_actor_queue.append(rec.actor_id)
+
+    async def h_report_actor_state(self, actor_id: bytes, state: str,
+                                   worker_id: Optional[bytes] = None,
+                                   address=None, node_id: Optional[bytes] = None,
+                                   death_cause: str = ""):
+        rec = self._actors.get(ActorID(actor_id))
+        if rec is None:
+            return False
+        if state == ACTOR_ALIVE:
+            rec.state = ACTOR_ALIVE
+            rec.worker_id = worker_id and WorkerID(worker_id)
+            rec.address = address and tuple(address)
+            if node_id:
+                rec.node_id = NodeID(node_id)
+        elif state == ACTOR_DEAD:
+            # Idempotency: a death report is only valid once per worker
+            # incarnation — RPC retries deliver duplicates, which must not
+            # burn the restart budget twice.
+            wid = worker_id and WorkerID(worker_id)
+            if wid is not None and wid in rec.handled_deaths:
+                return True
+            if rec.state == ACTOR_ALIVE:
+                if wid is not None and rec.worker_id is not None and wid != rec.worker_id:
+                    return True  # stale report about an older incarnation
+            elif rec.state != ACTOR_PENDING:  # RESTARTING/DEAD: stale
+                return True
+            if wid is not None:
+                rec.handled_deaths.add(wid)
+            await self._on_actor_failure(rec, death_cause or "worker died")
+            return True
+        self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+        return True
+
+    async def _on_actor_failure(self, rec: ActorRecord, cause: str):
+        if rec.state == ACTOR_DEAD:
+            return
+        if rec.num_restarts < rec.max_restarts or rec.max_restarts < 0:
+            rec.num_restarts += 1
+            rec.state = ACTOR_RESTARTING
+            rec.address = None
+            rec.worker_id = None
+            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+            await self._schedule_actor(rec)
+        else:
+            rec.state = ACTOR_DEAD
+            rec.death_cause = cause
+            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+
+    async def h_get_actor(self, actor_id: bytes):
+        rec = self._actors.get(ActorID(actor_id))
+        return rec and rec.public_view()
+
+    async def h_get_actor_by_name(self, name: str, namespace: str = "default"):
+        aid = self._named_actors.get((namespace, name))
+        if aid is None:
+            return None
+        rec = self._actors.get(aid)
+        return rec and rec.public_view()
+
+    async def h_list_actors(self):
+        return [r.public_view() for r in self._actors.values()]
+
+    async def h_kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        rec = self._actors.get(ActorID(actor_id))
+        if rec is None:
+            return False
+        await self._kill_actor_internal(rec, "killed via kill_actor", no_restart=no_restart)
+        return True
+
+    async def _kill_actor_internal(self, rec: ActorRecord, cause: str, no_restart: bool = True):
+        if no_restart:
+            rec.max_restarts = rec.num_restarts  # exhaust restart budget
+        node = rec.node_id and self._raylets.get(rec.node_id)
+        if node is not None and rec.worker_id is not None:
+            try:
+                await node.client.call_async(
+                    "kill_worker", worker_id=rec.worker_id.binary(), timeout=5.0
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        await self._on_actor_failure(rec, cause)
+
+    # --------------------------------------------------------------- PGs/2PC
+    async def h_create_placement_group(self, pg_id: bytes, bundles: List[dict], strategy: str,
+                                       name: Optional[str] = None, job_id: Optional[bytes] = None):
+        pgid = PlacementGroupID(pg_id)
+        rec = PgRecord(
+            pg_id=pgid, name=name,
+            bundles=[ResourceRequest.from_dict(b) for b in bundles],
+            strategy=strategy,
+            bundle_nodes=[None] * len(bundles),
+            creator_job=job_id and JobID(job_id),
+        )
+        self._pgs[pgid] = rec
+        await self._schedule_pg(rec)
+        return {"ok": True, "state": rec.state}
+
+    async def _schedule_pg(self, rec: PgRecord):
+        placement = policies.place_bundles(self.view, rec.bundles, rec.strategy)
+        if placement is None:
+            if rec.pg_id not in self._pending_pg_queue:
+                self._pending_pg_queue.append(rec.pg_id)
+            return
+        # 2PC (reference: gcs_placement_group_scheduler.h:122-124): prepare all,
+        # then commit all; any prepare failure returns the prepared ones.
+        by_node: Dict[NodeID, List[int]] = {}
+        for idx, nid in enumerate(placement):
+            by_node.setdefault(nid, []).append(idx)
+        prepared: List[NodeID] = []
+        ok = True
+        for nid, idxs in by_node.items():
+            handle = self._raylets.get(nid)
+            if handle is None:
+                ok = False
+                break
+            try:
+                res = await handle.client.call_async(
+                    "prepare_bundles",
+                    pg_id=rec.pg_id.binary(),
+                    bundles={i: rec.bundles[i].to_dict() for i in idxs},
+                    timeout=30.0,
+                )
+                if not res:
+                    ok = False
+                    break
+                prepared.append(nid)
+            except Exception:  # noqa: BLE001
+                ok = False
+                break
+        if not ok:
+            for nid in prepared:
+                handle = self._raylets.get(nid)
+                if handle:
+                    try:
+                        await handle.client.call_async(
+                            "return_bundles", pg_id=rec.pg_id.binary(), timeout=10.0
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+            if rec.pg_id not in self._pending_pg_queue:
+                self._pending_pg_queue.append(rec.pg_id)
+            return
+        commit_failed = False
+        for nid in by_node:
+            handle = self._raylets.get(nid)
+            if handle is None:
+                commit_failed = True
+                continue
+            try:
+                await handle.client.call_async(
+                    "commit_bundles", pg_id=rec.pg_id.binary(), timeout=30.0
+                )
+            except Exception:  # noqa: BLE001 - unreachable raylet
+                commit_failed = True
+        if commit_failed:
+            # Partial commit must not report CREATED — leases against the
+            # uncommitted bundle would queue forever.  Tear down and retry.
+            for nid in by_node:
+                handle = self._raylets.get(nid)
+                if handle:
+                    try:
+                        await handle.client.call_async(
+                            "return_bundles", pg_id=rec.pg_id.binary(), timeout=10.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+            rec.state = PG_RESCHEDULING
+            if rec.pg_id not in self._pending_pg_queue:
+                self._pending_pg_queue.append(rec.pg_id)
+            return
+        rec.bundle_nodes = list(placement)
+        rec.state = PG_CREATED
+        self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
+
+    async def h_remove_placement_group(self, pg_id: bytes):
+        rec = self._pgs.get(PlacementGroupID(pg_id))
+        if rec is None:
+            return False
+        for nid in set(n for n in rec.bundle_nodes if n is not None):
+            handle = self._raylets.get(nid)
+            if handle:
+                try:
+                    await handle.client.call_async(
+                        "return_bundles", pg_id=rec.pg_id.binary(), timeout=10.0
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        rec.state = PG_REMOVED
+        self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
+        return True
+
+    async def h_get_placement_group(self, pg_id: bytes):
+        rec = self._pgs.get(PlacementGroupID(pg_id))
+        return rec and rec.public_view()
+
+    async def h_wait_placement_group_ready(self, pg_id: bytes, timeout_s: float = 30.0):
+        pgid = PlacementGroupID(pg_id)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rec = self._pgs.get(pgid)
+            if rec is None:
+                return {"ok": False, "error": "no such placement group"}
+            if rec.state == PG_CREATED:
+                return {"ok": True}
+            if rec.state == PG_REMOVED:
+                return {"ok": False, "error": "placement group removed"}
+            await asyncio.sleep(0.05)
+        return {"ok": False, "error": "timeout"}
+
+    async def h_list_placement_groups(self):
+        return [r.public_view() for r in self._pgs.values()]
+
+    # ------------------------------------------------------------------- KV
+    async def h_kv_put(self, namespace: str, key, value: bytes, overwrite: bool = True):
+        return self.kv.put(namespace, key, value, overwrite)
+
+    async def h_kv_get(self, namespace: str, key):
+        return self.kv.get(namespace, key)
+
+    async def h_kv_del(self, namespace: str, key):
+        return self.kv.delete(namespace, key)
+
+    async def h_kv_keys(self, namespace: str, prefix=b""):
+        return self.kv.keys(namespace, prefix)
+
+    async def h_kv_exists(self, namespace: str, key):
+        return self.kv.exists(namespace, key)
+
+    # ----------------------------------------------------------- task events
+    async def h_add_task_events(self, events: List[dict]):
+        self._task_events.extend(events)
+        if len(self._task_events) > 100_000:
+            self._task_events = self._task_events[-50_000:]
+        return True
+
+    async def h_get_task_events(self, job_id: Optional[bytes] = None, limit: int = 10_000):
+        evs = self._task_events
+        if job_id is not None:
+            jid = JobID(job_id).hex()
+            evs = [e for e in evs if e.get("job_id") == jid]
+        return evs[-limit:]
+
+    # ------------------------------------------------------------------ misc
+    async def h_get_system_config(self):
+        return GLOBAL_CONFIG.system_config_json()
+
+    async def h_health_check(self):
+        return True
+
+    def _kick_pending(self):
+        """Retry pending actors/PGs (resources may have freed up)."""
+        if not self._pending_actor_queue and not self._pending_pg_queue:
+            return
+
+        async def kick():
+            actors, self._pending_actor_queue = self._pending_actor_queue, []
+            for aid in actors:
+                rec = self._actors.get(aid)
+                if rec is not None and rec.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                    await self._schedule_actor(rec)
+            pgs, self._pending_pg_queue = self._pending_pg_queue, []
+            for pgid in pgs:
+                rec = self._pgs.get(pgid)
+                if rec is not None and rec.state in (PG_PENDING, PG_RESCHEDULING):
+                    await self._schedule_pg(rec)
+
+        self._io.spawn_threadsafe(kick())
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--persist-dir", default=None)
+    args = parser.parse_args()
+    gcs = GcsServer(args.host, args.port, args.persist_dir)
+    gcs.start()
+    print(f"GCS_READY {gcs.address[0]}:{gcs.address[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gcs.stop()
+
+
+if __name__ == "__main__":
+    main()

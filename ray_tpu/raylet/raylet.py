@@ -1,0 +1,627 @@
+"""Raylet — the per-node data-plane daemon.
+
+Equivalent of the reference's raylet/NodeManager (src/ray/raylet/node_manager.cc,
+raylet/main.cc): owns the worker pool, runs the local half of the two-level
+lease scheduler (grant locally / spill to another node / queue), participates
+in placement-group 2PC (prepare/commit/return of bundle resources,
+raylet/placement_group_resource_manager.cc), reports resources to the GCS, and
+detects worker death.
+
+TPU specifics: leased TPU chips are exported to the worker via
+``TPU_VISIBLE_CHIPS`` (mirroring the reference's accelerator plugin behavior,
+python/ray/_private/accelerators/tpu.py:194-236) and node labels carry the
+slice topology so gang policies can target one ICI domain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.ids import NodeID, PlacementGroupID, WorkerID
+from ray_tpu.common.resources import (
+    CPU,
+    LABEL_NODE_ID,
+    LABEL_SLICE_NAME,
+    LABEL_SLICE_TOPOLOGY,
+    NodeResources,
+    ResourceRequest,
+    TPU,
+)
+from ray_tpu.gcs.client import GcsClient
+from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcServer
+from ray_tpu.scheduling import ClusterView, NodeEntry, policies
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: Optional[subprocess.Popen]
+    address: Optional[Tuple[str, int]] = None  # worker's RPC server
+    state: str = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
+    lease_id: Optional[bytes] = None
+    assignment: Optional[dict] = None  # unit-resource chip indices
+    request: Optional[ResourceRequest] = None
+    pg: Optional[Tuple[PlacementGroupID, int]] = None
+    actor_id: Optional[bytes] = None
+    idle_since: float = field(default_factory=time.monotonic)
+    registered: "asyncio.Event" = field(default_factory=asyncio.Event)
+
+
+@dataclass
+class Bundle:
+    request: ResourceRequest
+    assignment: Optional[dict]  # chip indices reserved for the bundle
+    committed: bool = False
+    # lease accounting *within* the bundle
+    available: ResourceRequest = None  # type: ignore[assignment]
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: Tuple[str, int],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        session_dir: Optional[str] = None,
+        fake_worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = tuple(gcs_address)
+        self.server = RpcServer(host, port)
+        self._io = IoContext.current()
+        self.session_dir = session_dir or f"/tmp/rt/session_{os.getpid()}"
+        os.makedirs(self.session_dir, exist_ok=True)
+
+        resources = dict(resources or {})
+        resources.setdefault(CPU, float(os.cpu_count() or 1))
+        labels = dict(labels or {})
+        labels[LABEL_NODE_ID] = self.node_id.hex()
+        if GLOBAL_CONFIG.get("tpu_topology") and LABEL_SLICE_TOPOLOGY not in labels:
+            labels[LABEL_SLICE_TOPOLOGY] = GLOBAL_CONFIG.get("tpu_topology")
+        self.resources = NodeResources(resources, labels)
+
+        self.view = ClusterView()  # replica of the cluster view
+        self.gcs = GcsClient(self.gcs_address, client_id=f"raylet-{self.node_id.hex()[:8]}")
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._leases: Dict[bytes, WorkerID] = {}
+        self._bundles: Dict[PlacementGroupID, Dict[int, Bundle]] = {}
+        self._pending_leases: List[dict] = []  # queued lease requests (waiters)
+        self._seq = 0
+        self._stopped = False
+        self._bg_tasks: List = []
+        self._fake_worker_env = fake_worker_env or {}
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ wiring
+    def _register_handlers(self):
+        s = self.server
+        for name in (
+            "health_check", "request_worker_lease", "return_worker", "start_actor",
+            "kill_worker", "register_worker", "prepare_bundles", "commit_bundles",
+            "return_bundles", "get_node_info", "debug_state", "notify_actor_dead",
+        ):
+            s.register(name, getattr(self, f"h_{name}"))
+
+    def start(self):
+        self.server.start()
+        reply = self.gcs.register_node(
+            self.node_id,
+            self.server.address,
+            self.resources.total.to_dict(),
+            self.resources.labels,
+        )
+        GLOBAL_CONFIG.initialize(reply.get("system_config") or "{}")
+        GLOBAL_CONFIG.reset_cache()
+        # seed the local cluster view, then keep it fresh via pubsub
+        for info in self.gcs.get_all_nodes():
+            if info["alive"]:
+                snap = info["resources"]
+                entry = NodeEntry(
+                    node_id=NodeID(info["node_id"]),
+                    address=tuple(info["address"]),
+                    resources=NodeResources.from_snapshot(snap),
+                )
+                self.view.upsert(entry)
+        self.gcs.subscriber.subscribe("resources", self._on_resources_update)
+        self.gcs.subscriber.subscribe("node", self._on_node_update)
+        self._io.spawn_threadsafe(self._report_loop())
+        self._io.spawn_threadsafe(self._reap_loop())
+        logger.info("raylet %s serving at %s", self.node_id.hex()[:8], self.server.address)
+
+    def stop(self):
+        self._stopped = True
+        for t in self._bg_tasks:
+            t.cancel()
+        for w in list(self._workers.values()):
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        for w in list(self._workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+        self.gcs.close()
+        self.server.stop()
+
+    # ------------------------------------------------------- cluster view sync
+    def _on_resources_update(self, node_hex: str, msg: dict):
+        nid = NodeID.from_hex(node_hex)
+        if nid == self.node_id:
+            return
+        entry = self.view.get(nid)
+        if entry is None:
+            return
+        self.view.update_resources(nid, msg["snapshot"], msg["seq"])
+        self._io.loop.call_soon_threadsafe(self._try_grant_pending)
+
+    def _on_node_update(self, node_hex: str, msg: dict):
+        nid = NodeID.from_hex(node_hex)
+        if msg.get("state") == "DEAD":
+            self.view.mark_dead(nid)
+        elif msg.get("state") == "ALIVE" and nid != self.node_id:
+            entry = self.view.get(nid)
+            if entry is None:
+                # fetch details lazily on next report; register placeholder
+                self.view.upsert(
+                    NodeEntry(node_id=nid, address=tuple(msg["address"]),
+                              resources=NodeResources({}))
+                )
+
+    async def _report_loop(self):
+        period = GLOBAL_CONFIG.get("raylet_report_resources_period_ms") / 1000.0
+        while not self._stopped:
+            self._seq += 1
+            try:
+                await self.gcs.call_async(
+                    "report_resources",
+                    node_id=self.node_id.binary(),
+                    snapshot=self.resources.snapshot(),
+                    seq=self._seq,
+                )
+            except Exception:  # noqa: BLE001 - GCS may be restarting
+                pass
+            # keep our own entry in the local view fresh for spillback scoring
+            self.view.upsert(
+                NodeEntry(
+                    node_id=self.node_id,
+                    address=self.server.address,
+                    resources=self.resources,
+                    seq=self._seq,
+                )
+            )
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self):
+        """Detect dead worker processes; free leases; reap idle workers."""
+        idle_ttl = GLOBAL_CONFIG.get("idle_worker_killing_time_threshold_ms") / 1000.0
+        while not self._stopped:
+            for w in list(self._workers.values()):
+                if w.proc is not None and w.proc.poll() is not None and w.state != "DEAD":
+                    await self._on_worker_dead(w, f"exit code {w.proc.returncode}")
+            # reap long-idle workers beyond a small cache
+            idle = [w for w in self._workers.values() if w.state == "IDLE"]
+            keep = max(2, GLOBAL_CONFIG.get("num_prestart_workers"))
+            if len(idle) > keep:
+                idle.sort(key=lambda w: w.idle_since)
+                now = time.monotonic()
+                for w in idle[: len(idle) - keep]:
+                    if now - w.idle_since > idle_ttl:
+                        self._kill_worker_proc(w)
+            await asyncio.sleep(0.2)
+
+    async def _on_worker_dead(self, w: WorkerHandle, reason: str):
+        if w.state == "DEAD":
+            return
+        prev_state = w.state
+        w.state = "DEAD"
+        logger.warning("worker %s dead (%s): %s", w.worker_id.hex()[:8], prev_state, reason)
+        if w.lease_id is not None:
+            self._free_lease(w)
+        if prev_state == "ACTOR":
+            self._free_worker_resources(w)
+            if w.actor_id is not None:
+                try:
+                    await self.gcs.call_async(
+                        "report_actor_state", actor_id=w.actor_id, state="DEAD",
+                        worker_id=w.worker_id.binary(),
+                        death_cause=f"worker died: {reason}",
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        self._workers.pop(w.worker_id, None)
+        self._try_grant_pending()
+
+    def _kill_worker_proc(self, w: WorkerHandle):
+        w.state = "DEAD"
+        self._workers.pop(w.worker_id, None)
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.terminate()
+
+    # ------------------------------------------------------------ worker pool
+    async def _start_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(self._fake_worker_env)
+        env["RT_WORKER_ID"] = worker_id.hex()
+        env["RT_RAYLET_ADDR"] = f"{self.server.address[0]}:{self.server.address[1]}"
+        env["RT_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+        env["RT_NODE_ID"] = self.node_id.hex()
+        env["RT_SESSION_DIR"] = self.session_dir
+        log_path = os.path.join(self.session_dir, f"worker-{worker_id.hex()[:8]}.log")
+        logfile = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core_worker.worker_main"],
+            env=env, stdout=logfile, stderr=subprocess.STDOUT,
+            cwd=os.getcwd(),
+        )
+        w = WorkerHandle(worker_id=worker_id, proc=proc)
+        self._workers[worker_id] = w
+        logger.debug("forked worker %s (pid %s)", worker_id.hex()[:8], proc.pid)
+        return w
+
+    async def h_register_worker(self, worker_id: bytes, address):
+        w = self._workers.get(WorkerID(worker_id))
+        if w is None:
+            # worker from a previous life / unknown: tell it to exit
+            return {"ok": False}
+        w.address = tuple(address)
+        if w.state == "STARTING":
+            w.state = "IDLE"
+            w.idle_since = time.monotonic()
+        w.registered.set()
+        logger.debug("worker %s registered at %s", WorkerID(worker_id).hex()[:8], address)
+        self._try_grant_pending()
+        return {"ok": True}
+
+    async def _pop_worker(self, timeout: float = None) -> Optional[WorkerHandle]:
+        """Get an idle registered worker, forking if needed."""
+        timeout = timeout or GLOBAL_CONFIG.get("worker_register_timeout_s")
+        for w in self._workers.values():
+            if w.state == "IDLE" and (w.proc is None or w.proc.poll() is None):
+                w.state = "LEASED"
+                return w
+        starting = [w for w in self._workers.values() if w.state == "STARTING"]
+        if len(starting) < GLOBAL_CONFIG.get("maximum_startup_concurrency"):
+            w = await self._start_worker()
+        else:
+            w = starting[0]
+        logger.debug("pop_worker: waiting registration of %s", w.worker_id.hex()[:8])
+        try:
+            await asyncio.wait_for(w.registered.wait(), timeout)
+        except asyncio.TimeoutError:
+            logger.warning("pop_worker: registration timeout for %s", w.worker_id.hex()[:8])
+            return None
+        if w.state != "IDLE":
+            logger.warning("pop_worker: %s not idle after registration (%s)",
+                           w.worker_id.hex()[:8], w.state)
+            return None
+        w.state = "LEASED"
+        return w
+
+    # ------------------------------------------------------------- scheduling
+    def _local_available(self, request: ResourceRequest,
+                         pg: Optional[Tuple[PlacementGroupID, int]]) -> bool:
+        if pg is not None:
+            pg_id, idx = pg
+            bundle = self._bundles.get(pg_id, {}).get(idx)
+            return bundle is not None and bundle.committed and \
+                request.resources.is_subset_of(bundle.available.resources)
+        return self.resources.is_available(request)
+
+    def _allocate_local(self, request: ResourceRequest,
+                        pg: Optional[Tuple[PlacementGroupID, int]]):
+        if pg is not None:
+            pg_id, idx = pg
+            bundle = self._bundles[pg_id][idx]
+            bundle.available = ResourceRequest(
+                (bundle.available.resources - request.resources).to_dict()
+            )
+            # chips come from the bundle's reservation
+            return {k: list(v) for k, v in (bundle.assignment or {}).items()}
+        return self.resources.allocate(request)
+
+    async def h_request_worker_lease(self, lease_id: bytes, resources: dict,
+                                     strategy=None, pg: Optional[tuple] = None,
+                                     grant_only_local: bool = False):
+        """Two-level scheduling (reference: node_manager.proto:413 +
+        cluster_task_manager.h): grant locally, spill, or queue."""
+        request = ResourceRequest.from_dict(resources) if isinstance(resources, dict) and "resources" in resources else ResourceRequest(resources)
+        pg_key = (PlacementGroupID(pg[0]), pg[1]) if pg else None
+        logger.debug("lease request %s res=%s", lease_id[:4].hex(), request.resources.to_dict())
+
+        if self._local_available(request, pg_key):
+            granted = await self._grant_lease(lease_id, request, pg_key)
+            if granted is not None:
+                return granted
+        if pg_key is not None or grant_only_local:
+            # PG leases are node-pinned; queue locally until bundle frees up
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_leases.append(
+                {"lease_id": lease_id, "request": request, "pg": pg_key, "future": fut}
+            )
+            return await fut
+        # consider spilling to another node
+        strategy_obj = pickle.loads(strategy) if isinstance(strategy, bytes) else None
+        node = policies.pick_node(self.view, request, strategy_obj, local_node=self.node_id)
+        if node is not None and node.node_id != self.node_id:
+            return {"status": "spill", "node_id": node.node_id.binary(),
+                    "address": node.address}
+        feasible_somewhere = any(
+            e.resources.is_feasible(request) for e in self.view.alive_nodes()
+        )
+        if not feasible_somewhere:
+            return {"status": "infeasible"}
+        fut = asyncio.get_running_loop().create_future()
+        self._pending_leases.append(
+            {"lease_id": lease_id, "request": request, "pg": None, "future": fut}
+        )
+        return await fut
+
+    async def _grant_lease(self, lease_id: bytes, request: ResourceRequest,
+                           pg_key) -> Optional[dict]:
+        assignment = self._allocate_local(request, pg_key)
+        if assignment is None:
+            return None
+        w = await self._pop_worker()
+        if w is None:
+            # couldn't start a worker: roll back
+            if pg_key is None:
+                self.resources.free(request, assignment)
+            else:
+                self._return_to_bundle(pg_key, request)
+            return None
+        w.lease_id = lease_id
+        w.request = request
+        w.assignment = assignment
+        w.pg = pg_key
+        self._leases[lease_id] = w.worker_id
+        # tell the worker its chip visibility before it runs anything
+        tpu_chips = (assignment or {}).get(TPU)
+        if w.address is not None and tpu_chips is not None:
+            try:
+                c = RetryableRpcClient(w.address, deadline_s=5.0)
+                await c.call_async("set_visible_devices", tpu_chips=tpu_chips)
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return {
+            "status": "granted",
+            "worker_id": w.worker_id.binary(),
+            "worker_address": w.address,
+            "node_id": self.node_id.binary(),
+        }
+
+    def _free_worker_resources(self, w: WorkerHandle):
+        """Return a worker's held resources to the right pool: its PG bundle
+        if it was leased inside one, the node pool otherwise."""
+        if w.request is None:
+            w.pg = None
+            return
+        if w.pg is not None:
+            self._return_to_bundle(w.pg, w.request)
+        else:
+            self.resources.free(w.request, w.assignment)
+        w.request = None
+        w.assignment = None
+        w.pg = None
+
+    def _return_to_bundle(self, pg_key, request: ResourceRequest):
+        pg_id, idx = pg_key
+        bundles = self._bundles.get(pg_id)
+        if bundles and idx in bundles:
+            b = bundles[idx]
+            b.available = ResourceRequest(
+                (b.available.resources + request.resources).to_dict()
+            )
+
+    def _free_lease(self, w: WorkerHandle):
+        if w.lease_id is None:
+            return
+        self._leases.pop(w.lease_id, None)
+        w.lease_id = None
+        self._free_worker_resources(w)
+
+    async def h_return_worker(self, lease_id: bytes, disconnect: bool = False):
+        wid = self._leases.get(lease_id)
+        if wid is None:
+            return False
+        w = self._workers.get(wid)
+        if w is None:
+            return False
+        self._free_lease(w)
+        if disconnect or w.proc is None or w.proc.poll() is not None:
+            self._kill_worker_proc(w)
+        else:
+            w.state = "IDLE"
+            w.idle_since = time.monotonic()
+        self._try_grant_pending()
+        return True
+
+    def _try_grant_pending(self):
+        if not self._pending_leases:
+            return
+
+        async def drain():
+            still: List[dict] = []
+            for item in self._pending_leases:
+                if item["future"].done():
+                    continue
+                if self._local_available(item["request"], item["pg"]):
+                    granted = await self._grant_lease(item["lease_id"], item["request"], item["pg"])
+                    if granted is not None:
+                        item["future"].set_result(granted)
+                        continue
+                if item["pg"] is None:
+                    # re-evaluate spilling: a REMOTE node may have freed up
+                    # while we were queued (its gossip triggers this drain)
+                    node = policies.pick_node(
+                        self.view, item["request"], None, local_node=self.node_id)
+                    if node is not None and node.node_id != self.node_id:
+                        item["future"].set_result(
+                            {"status": "spill", "node_id": node.node_id.binary(),
+                             "address": node.address})
+                        continue
+                still.append(item)
+            self._pending_leases[:] = still
+
+        self._io.spawn_threadsafe(drain())
+
+    # ---------------------------------------------------------------- actors
+    async def h_start_actor(self, creation_spec: bytes):
+        spec = pickle.loads(creation_spec)
+        request = spec.required_resources
+        pg_key = None
+        from ray_tpu.common.task_spec import PlacementGroupStrategy
+
+        if isinstance(spec.scheduling_strategy, PlacementGroupStrategy):
+            pg_key = (spec.scheduling_strategy.placement_group_id,
+                      spec.scheduling_strategy.bundle_index)
+        if not self._local_available(request, pg_key):
+            return {"ok": False, "reason": "resources unavailable"}
+        assignment = self._allocate_local(request, pg_key)
+        w = await self._pop_worker()
+        if w is None:
+            if pg_key is None:
+                self.resources.free(request, assignment)
+            else:
+                self._return_to_bundle(pg_key, request)
+            return {"ok": False, "reason": "no worker"}
+        w.state = "ACTOR"
+        w.pg = pg_key
+        w.request = request
+        w.assignment = assignment
+        w.actor_id = spec.actor_id.binary()
+        tpu_chips = (assignment or {}).get(TPU)
+        try:
+            c = RetryableRpcClient(w.address, deadline_s=30.0)
+            if tpu_chips is not None:
+                await c.call_async("set_visible_devices", tpu_chips=tpu_chips)
+            await c.call_async("create_actor", creation_spec=creation_spec,
+                               node_id=self.node_id.binary(), timeout=120.0)
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("create_actor push failed: %s", e)
+            await self._on_worker_dead(w, f"create_actor failed: {e}")
+            return {"ok": False, "reason": str(e)}
+        return {"ok": True, "worker_id": w.worker_id.binary(), "worker_address": w.address}
+
+    async def h_kill_worker(self, worker_id: bytes):
+        w = self._workers.get(WorkerID(worker_id))
+        if w is None:
+            return False
+        self._kill_worker_proc(w)
+        return True
+
+    async def h_notify_actor_dead(self, worker_id: bytes):
+        """Worker-side graceful actor exit (e.g. __rt_terminate__)."""
+        w = self._workers.get(WorkerID(worker_id))
+        if w is not None:
+            await self._on_worker_dead(w, "actor exited")
+        return True
+
+    # --------------------------------------------------------------- PG (2PC)
+    async def h_prepare_bundles(self, pg_id: bytes, bundles: Dict[int, dict]):
+        pgid = PlacementGroupID(pg_id)
+        prepared: Dict[int, Bundle] = {}
+        for idx, bdict in bundles.items():
+            request = ResourceRequest.from_dict(bdict)
+            assignment = self.resources.allocate(request)
+            if assignment is None:
+                # roll back everything prepared in this call
+                for b in prepared.values():
+                    self.resources.free(b.request, b.assignment)
+                return False
+            prepared[idx] = Bundle(request=request, assignment=assignment,
+                                   available=ResourceRequest(request.resources.to_dict()))
+        self._bundles.setdefault(pgid, {}).update(prepared)
+        return True
+
+    async def h_commit_bundles(self, pg_id: bytes):
+        for b in self._bundles.get(PlacementGroupID(pg_id), {}).values():
+            b.committed = True
+        self._try_grant_pending()
+        return True
+
+    async def h_return_bundles(self, pg_id: bytes):
+        bundles = self._bundles.pop(PlacementGroupID(pg_id), {})
+        for b in bundles.values():
+            self.resources.free(b.request, b.assignment)
+        # kill workers still leased inside the PG
+        for w in list(self._workers.values()):
+            if w.pg is not None and w.pg[0] == PlacementGroupID(pg_id):
+                self._kill_worker_proc(w)
+        self._try_grant_pending()
+        return True
+
+    # ------------------------------------------------------------------ misc
+    async def h_health_check(self):
+        return True
+
+    async def h_get_node_info(self):
+        return {
+            "node_id": self.node_id.binary(),
+            "address": self.server.address,
+            "resources": self.resources.snapshot(),
+            "num_workers": len(self._workers),
+            "session_dir": self.session_dir,
+        }
+
+    async def h_debug_state(self):
+        return {
+            "workers": {
+                w.worker_id.hex()[:8]: {"state": w.state, "addr": w.address}
+                for w in self._workers.values()
+            },
+            "pending_leases": len(self._pending_leases),
+            "bundles": {
+                pid.hex()[:8]: {i: b.committed for i, b in bs.items()}
+                for pid, bs in self._bundles.items()
+            },
+            "resources": self.resources.snapshot(),
+            "io_stats": dict(self._io.stats),
+        }
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs", required=True, help="host:port of the GCS")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--resources", default="{}", help="JSON resource dict")
+    p.add_argument("--labels", default="{}", help="JSON label dict")
+    args = p.parse_args()
+    import json
+
+    host, _, port = args.gcs.partition(":")
+    raylet = Raylet(
+        (host, int(port)), args.host, args.port,
+        resources=json.loads(args.resources), labels=json.loads(args.labels),
+    )
+    raylet.start()
+    print(f"RAYLET_READY {raylet.server.address[0]}:{raylet.server.address[1]}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        raylet.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -125,7 +125,8 @@ class Subscriber:
         self._callbacks[channel] = callback
         self._client.call(self._prefix + "subscribe", subscriber_id=self.subscriber_id, channel=channel, key=key)
         if self._task is None:
-            self._task = asyncio.run_coroutine_threadsafe(self._poll_loop(), self._io.loop)
+            self._task = True
+            self._io.spawn_threadsafe(self._poll_loop())
 
     async def _poll_loop(self):
         while not self._stopped.is_set():

@@ -96,12 +96,55 @@ class IoContext:
 
     def run(self, coro: Awaitable, timeout: Optional[float] = None):
         """Block the calling (non-loop) thread on a coroutine."""
-        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        import concurrent.futures as cf
+
+        cfut: cf.Future = cf.Future()
+        task_box: list = []
+
+        def _do():
+            task = self.spawn(coro)
+            task_box.append(task)
+
+            def _copy(t: asyncio.Task):
+                if t.cancelled():
+                    cfut.cancel()
+                elif t.exception() is not None:
+                    cfut.set_exception(t.exception())
+                else:
+                    cfut.set_result(t.result())
+
+            task.add_done_callback(_copy)
+
+        self.loop.call_soon_threadsafe(_do)
         try:
-            return fut.result(timeout)
-        except TimeoutError:
-            fut.cancel()
+            return cfut.result(timeout)
+        except cf.TimeoutError:
+            # don't leave the coroutine running (and its side effects live)
+            # after the caller has taken the timeout path
+            self.loop.call_soon_threadsafe(
+                lambda: task_box and task_box[0].cancel())
             raise RtTimeoutError(f"rpc timed out after {timeout}s")
+        except cf.CancelledError:
+            raise RtTimeoutError("operation cancelled")
+
+    # The event loop holds only WEAK references to tasks; any fire-and-forget
+    # task must be pinned here or the GC can destroy it mid-await ("Task was
+    # destroyed but it is pending!"), silently dropping RPCs.
+    _pinned_tasks: set = set()
+
+    def spawn(self, coro) -> "asyncio.Task":
+        """ensure_future with a strong reference for the task's lifetime.
+        Must be called from the loop thread."""
+        task = asyncio.ensure_future(coro)
+        IoContext._pinned_tasks.add(task)
+        task.add_done_callback(IoContext._pinned_tasks.discard)
+        return task
+
+    def spawn_threadsafe(self, coro):
+        """Spawn from any thread; fire-and-forget."""
+        def _do():
+            self.spawn(coro)
+        self.loop.call_soon_threadsafe(_do)
 
     def record(self, name: str, elapsed: float):
         with self._stats_lock:
@@ -156,7 +199,7 @@ class RpcServer:
                 ftype, msg = await _read_frame(reader)
                 if ftype != _FRAME_REQ:
                     continue
-                asyncio.ensure_future(self._dispatch(msg, writer, write_lock))
+                self._io.spawn(self._dispatch(msg, writer, write_lock))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -183,8 +226,9 @@ class RpcServer:
             try:
                 _write_frame(writer, _FRAME_RESP, reply)
                 await writer.drain()
-            except (ConnectionError, OSError):
-                pass
+            except (ConnectionError, OSError) as e:
+                import logging
+                logging.getLogger(__name__).warning("reply write for %s failed: %s", method, e)
             except Exception:  # unpicklable result/exception: degrade to string
                 try:
                     detail = repr(reply.get("result", reply.get("error")))
@@ -240,7 +284,7 @@ class RpcClient:
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 raise RpcError(f"connect to {self.address} failed: {e}") from e
             self._writer = writer
-            asyncio.ensure_future(self._read_loop(reader))
+            self._io.spawn(self._read_loop(reader))
 
     async def _read_loop(self, reader: asyncio.StreamReader):
         try:
@@ -258,6 +302,10 @@ class RpcClient:
                         fut.set_result(msg.get("result"))
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             self._fail_all(RpcError(f"connection to {self.address} lost: {e}"))
+        except Exception as e:  # noqa: BLE001 - corrupt frame: surface loudly
+            import logging
+            logging.getLogger(__name__).exception("read loop died: %s", e)
+            self._fail_all(RpcError(f"read loop on {self.address} died: {e}"))
 
     def _fail_all(self, exc: Exception):
         self._writer = None

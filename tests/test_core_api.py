@@ -1,0 +1,221 @@
+"""End-to-end tests of the core API on a real single-node cluster
+(driver in-process, GCS+raylet on the IO loop, workers as subprocesses)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestTasks:
+    def test_basic_task(self, rt):
+        @rt.remote
+        def add(a, b):
+            return a + b
+
+        assert rt.get(add.remote(2, 3)) == 5
+
+    def test_kwargs_and_large_args(self, rt):
+        import numpy as np
+
+        @rt.remote
+        def shape_of(arr, scale=1):
+            return tuple(int(s * scale) for s in arr.shape)
+
+        arr = np.zeros((128, 256), dtype=np.float32)  # > inline threshold
+        assert rt.get(shape_of.remote(arr, scale=2)) == (256, 512)
+
+    def test_task_chaining_by_ref(self, rt):
+        @rt.remote
+        def one():
+            return 1
+
+        @rt.remote
+        def plus(x, y):
+            return x + y
+
+        a = one.remote()
+        b = plus.remote(a, 10)
+        c = plus.remote(b, a)
+        assert rt.get(c) == 12
+
+    def test_task_exception_propagates(self, rt):
+        @rt.remote
+        def boom():
+            raise ValueError("expected failure")
+
+        from ray_tpu.common.status import TaskError
+
+        with pytest.raises(TaskError) as ei:
+            rt.get(boom.remote())
+        assert "expected failure" in str(ei.value)
+
+    def test_num_returns(self, rt):
+        @rt.remote(num_returns=3)
+        def three():
+            return 1, 2, 3
+
+        refs = three.remote()
+        assert rt.get(refs) == [1, 2, 3]
+
+    def test_put_get_roundtrip(self, rt):
+        ref = rt.put({"nested": [1, 2, {"k": "v"}]})
+        assert rt.get(ref) == {"nested": [1, 2, {"k": "v"}]}
+
+    def test_put_as_task_arg(self, rt):
+        @rt.remote
+        def double(x):
+            return x * 2
+
+        ref = rt.put(21)
+        assert rt.get(double.remote(ref)) == 42
+
+    def test_wait(self, rt):
+        @rt.remote
+        def sleepy(t):
+            time.sleep(t)
+            return t
+
+        fast = sleepy.remote(0.01)
+        slow = sleepy.remote(5.0)
+        ready, not_ready = rt.wait([fast, slow], num_returns=1, timeout=10)
+        assert ready == [fast] and not_ready == [slow]
+
+    def test_large_return_value(self, rt):
+        import numpy as np
+
+        @rt.remote
+        def big():
+            return np.arange(500_000, dtype=np.int64)  # ~4MB > inline threshold
+
+        out = rt.get(big.remote())
+        assert out.shape == (500_000,) and out[-1] == 499_999
+
+    def test_nested_tasks(self, rt):
+        @rt.remote
+        def inner(x):
+            return x + 1
+
+        @rt.remote
+        def outer(x):
+            import ray_tpu as rti
+
+            return rti.get(inner.remote(x)) + 100
+
+        assert rt.get(outer.remote(1)) == 102
+
+
+class TestActors:
+    def test_actor_lifecycle_and_state(self, rt):
+        @rt.remote
+        class Counter:
+            def __init__(self, start=0):
+                self.value = start
+
+            def inc(self, by=1):
+                self.value += by
+                return self.value
+
+            def read(self):
+                return self.value
+
+        c = Counter.remote(10)
+        assert rt.get(c.inc.remote()) == 11
+        assert rt.get(c.inc.remote(5)) == 16
+        assert rt.get(c.read.remote()) == 16
+
+    def test_actor_call_ordering(self, rt):
+        @rt.remote
+        class Appender:
+            def __init__(self):
+                self.items = []
+
+            def push(self, x):
+                self.items.append(x)
+                return len(self.items)
+
+            def read(self):
+                return self.items
+
+        a = Appender.remote()
+        for i in range(20):
+            a.push.remote(i)
+        assert rt.get(a.read.remote()) == list(range(20))
+
+    def test_named_actor(self, rt):
+        @rt.remote
+        class Registry:
+            def ping(self):
+                return "pong"
+
+        Registry.options(name="the-registry").remote()
+        h = rt.get_actor("the-registry")
+        assert rt.get(h.ping.remote()) == "pong"
+
+    def test_actor_method_exception(self, rt):
+        @rt.remote
+        class Bad:
+            def fail(self):
+                raise RuntimeError("actor method failed")
+
+        from ray_tpu.common.status import TaskError
+
+        b = Bad.remote()
+        with pytest.raises(TaskError):
+            rt.get(b.fail.remote())
+
+    def test_actor_handle_passing(self, rt):
+        @rt.remote
+        class Store:
+            def __init__(self):
+                self.v = None
+
+            def set(self, v):
+                self.v = v
+                return True
+
+            def get_value(self):
+                return self.v
+
+        @rt.remote
+        def writer(store):
+            import ray_tpu as rti
+
+            return rti.get(store.set.remote("written-by-task"))
+
+        s = Store.remote()
+        assert rt.get(writer.remote(s)) is True
+        assert rt.get(s.get_value.remote()) == "written-by-task"
+
+    def test_kill_actor(self, rt):
+        @rt.remote
+        class Victim:
+            def ping(self):
+                return "ok"
+
+        v = Victim.remote()
+        assert rt.get(v.ping.remote()) == "ok"
+        rt.kill(v)
+        from ray_tpu.common.status import ActorDiedError
+
+        time.sleep(0.5)
+        with pytest.raises((ActorDiedError, Exception)):
+            rt.get(v.ping.remote(), timeout=10)
+
+
+class TestCluster:
+    def test_cluster_resources(self, rt):
+        total = rt.cluster_resources()
+        assert total["CPU"] == 4
+
+    def test_nodes(self, rt):
+        ns = rt.nodes()
+        assert len(ns) == 1 and ns[0]["Alive"]
