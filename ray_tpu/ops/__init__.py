@@ -1,0 +1,23 @@
+"""ray_tpu.ops — TPU compute kernels.
+
+The hot ops of the framework's model zoo: flash attention (Pallas TPU kernel
+with an XLA blockwise fallback), ring attention for sequence parallelism
+(collective-permute over the ``sp`` mesh axis), RMSNorm, and rotary
+embeddings. The reference framework has no kernel layer at all — its compute
+is delegated to torch/vLLM (SURVEY.md §2.3); here kernels are in-framework.
+"""
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.norms import layernorm, rmsnorm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "apply_rope",
+    "flash_attention",
+    "layernorm",
+    "mha_reference",
+    "ring_attention",
+    "rmsnorm",
+    "rope_frequencies",
+]

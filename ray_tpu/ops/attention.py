@@ -1,0 +1,217 @@
+"""Attention frontend: dispatch + differentiable flash attention.
+
+``flash_attention`` takes (B, S, H, D) activations, dispatches the forward to
+the Pallas TPU kernel (``ops/pallas/flash_attention.py``) on TPU backends and
+to a fused XLA reference elsewhere, and installs a memory-efficient blockwise
+backward via ``jax.custom_vjp`` (two ``lax.scan`` passes, materializing at
+most an S×block score tile at a time — never the S×S matrix).
+
+Net-new relative to the reference framework, which ships no attention
+implementation (SURVEY.md §2.3/§5: long-context delegated to vLLM).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def mha_reference(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Naive O(S²)-memory attention, (B, S, H, D) layout. Test oracle."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        row = jnp.arange(sq)[:, None]
+        col = jnp.arange(skv)[None, :]
+        s = jnp.where(row >= col, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _fwd_xla(q, k, v, causal, scale):
+    """Fused full-matrix forward returning (out, lse); (B, H, S, D) layout.
+
+    Used off-TPU (tests, CPU dry-runs) where VMEM tiling doesn't apply.
+    """
+    if q.shape[1] != k.shape[1]:  # GQA
+        k = jnp.repeat(k, q.shape[1] // k.shape[1], axis=1)
+        v = jnp.repeat(v, q.shape[1] // v.shape[1], axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        row = jnp.arange(s.shape[-2])[:, None]
+        col = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(row >= col, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # all-masked rows
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30),
+                     v.astype(jnp.float32))
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(q, k, v, causal, scale, block):
+    """Joint (out, lse) primitive so downstream consumers of lse (ring
+    attention merges) stay differentiable: bwd handles the dlse cotangent
+    via the extra ``P·dlse`` term in dS."""
+    return _flash_fwd_dispatch(q, k, v, causal, scale, block)
+
+
+def _flash_fwd_dispatch(q, k, v, causal, scale, block):
+    if _use_pallas():
+        from ray_tpu.ops.pallas.flash_attention import flash_attention_fwd_pallas
+
+        return flash_attention_fwd_pallas(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block, block_kv=block)
+    return _fwd_xla(q, k, v, causal, scale)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block):
+    out, lse = _flash_fwd_dispatch(q, k, v, causal, scale, block)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block, res, cotangents):
+    """Blockwise flash backward, (B, H, S, D) layout.
+
+    Standard recompute formulation: with P = exp(S·scale − lse) and
+    Δ_i = Σ_d dO_id·O_id,
+        dV = Pᵀ·dO,  dS = P ∘ (dO·Vᵀ − Δ + dlse),  dQ = scale·dS·K,
+        dK = scale·dSᵀ·Q  (the dlse term makes the lse output differentiable).
+    Pass 1 scans kv blocks accumulating dQ; pass 2 scans q blocks
+    accumulating dK/dV — each step touches only an S×block tile.
+    """
+    dout, dlse = cotangents
+    q, k, v, out, lse = res
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = hq // hkv
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    do = dout.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1) \
+        - dlse.astype(jnp.float32)                               # (B,H,Sq)
+
+    blk = min(block, sq, skv)
+    nkv = -(-skv // blk)
+    nq = -(-sq // blk)
+    skv_p, sq_p = nkv * blk, nq * blk
+    pad_kv = [(0, 0), (0, 0), (0, skv_p - skv), (0, 0)]
+    pad_q = [(0, 0), (0, 0), (0, sq_p - sq), (0, 0)]
+    kp = jnp.pad(kf, pad_kv)
+    vp = jnp.pad(vf, pad_kv)
+    qp = jnp.pad(qf, pad_q)
+    dop = jnp.pad(do, pad_q)
+    lsep = jnp.pad(lse, [(0, 0), (0, 0), (0, sq_p - sq)],
+                   constant_values=NEG_INF)
+    deltap = jnp.pad(delta, [(0, 0), (0, 0), (0, sq_p - sq)])
+
+    row_q = jnp.arange(sq)
+    col_kv = jnp.arange(skv_p)
+
+    def scores(qb, kb):
+        return jnp.einsum("bhqd,bhkd->bhqk", qb, kb) * scale
+
+    # Pass 1: dQ — scan over kv blocks against the full (unpadded) q.
+    kvb = kp.reshape(b, hq, nkv, blk, d).transpose(2, 0, 1, 3, 4)
+    vvb = vp.reshape(b, hq, nkv, blk, d).transpose(2, 0, 1, 3, 4)
+
+    def dq_step(dq_acc, xs):
+        i, kb, vb = xs
+        col = i * blk + jnp.arange(blk)
+        s = scores(qf, kb)                                   # (B,H,Sq,blk)
+        mask = (col[None, :] < skv)
+        if causal:
+            mask = mask & (row_q[:, None] >= col[None, :])
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vb)
+        ds = p * (dp - delta[..., None])
+        return dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kb) * scale, None
+
+    dq, _ = jax.lax.scan(
+        dq_step, jnp.zeros_like(qf),
+        (jnp.arange(nkv), kvb, vvb))
+
+    # Pass 2: dK/dV — scan over q blocks against the full (padded) k/v.
+    qb_ = qp.reshape(b, hq, nq, blk, d).transpose(2, 0, 1, 3, 4)
+    dob_ = dop.reshape(b, hq, nq, blk, d).transpose(2, 0, 1, 3, 4)
+    lseb_ = lsep.reshape(b, hq, nq, blk).transpose(2, 0, 1, 3)
+    deltab_ = deltap.reshape(b, hq, nq, blk).transpose(2, 0, 1, 3)
+
+    def dkv_step(carry, xs):
+        dk_acc, dv_acc = carry
+        i, qb, dob, lseb, deltab = xs
+        row = i * blk + jnp.arange(blk)
+        s = scores(qb, kp)                                   # (B,H,blk,Skv_p)
+        mask = (row[:, None] < sq) & (col_kv[None, :] < skv)
+        if causal:
+            mask = mask & (row[:, None] >= col_kv[None, :])
+        p = jnp.where(mask, jnp.exp(s - lseb[..., None]), 0.0)
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, dob)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dob, vp)
+        ds = p * (dp - deltab[..., None])
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qb) * scale
+        return (dk_acc, dv_acc), None
+
+    (dkp, dvp), _ = jax.lax.scan(
+        dkv_step, (jnp.zeros_like(kp), jnp.zeros_like(vp)),
+        (jnp.arange(nq), qb_, dob_, lseb_, deltab_))
+    dk = dkp[:, :, :skv]
+    dv = dvp[:, :, :skv]
+
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, block: int = 512):
+    """Differentiable flash attention, (B, S, H, D) layout (GQA-aware)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, _ = _flash_lse(qt, kt, vt, causal, scale, block)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None, block: int = 512):
+    """Differentiable variant returning (out, lse) in (B, S, H, D) /
+    (B, H, S) layouts; building block for ring attention."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_lse(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal, scale, block)
+    return out.transpose(0, 2, 1, 3), lse

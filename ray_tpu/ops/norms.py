@@ -1,0 +1,29 @@
+"""Normalization ops.
+
+Plain jnp implementations: XLA fuses these into neighboring ops on TPU, so a
+Pallas kernel buys nothing here (the win is in attention, where the naive
+algorithm materializes the S×S score matrix in HBM).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x, weight, eps: float = 1e-6):
+    """RMSNorm in f32 accumulation regardless of input dtype."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * (1.0 + weight.astype(jnp.float32))).astype(dtype)
+
+
+def layernorm(x, weight, bias, eps: float = 1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * (var + eps) ** -0.5
+    return (y * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,154 @@
+"""Flash-attention forward kernel (Pallas TPU).
+
+Online-softmax tiling: grid = (batch·heads, q blocks, kv blocks) with the kv
+dimension innermost ("arbitrary" = sequential), carrying the running max /
+normalizer / accumulator in VMEM scratch so the S×S score matrix never touches
+HBM. Causal blocks strictly above the diagonal are skipped with ``pl.when``
+(compute is elided; the scratch state is carried through unchanged).
+
+Layout contract: inputs are (B, H, S, D); GQA kv heads are resolved in the kv
+BlockSpec index map (no materialized head repeat). Matmuls run on the MXU in
+the input dtype with f32 accumulation (``preferred_element_type``).
+
+The reference framework has no kernel layer (its attention lives in torch /
+vLLM, outside the repo); this file is net-new TPU-first work (SURVEY.md §5
+"Long-context": TPU-native plan).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.attention import NEG_INF
+
+_LANES = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, block_q: int, block_kv: int,
+                kv_len: int, num_kv_blocks: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: q rows [iq·Bq, iq·Bq+Bq) never see kv cols >= (iq+1)·Bq, so
+    # blocks strictly above the diagonal are skipped entirely.
+    should_run = (ik * block_kv < (iq + 1) * block_q) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0]                      # (Bq, D)
+        k = k_ref[0]                      # (Bkv, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (Bq, Bkv) f32
+
+        col = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len               # padded kv tail
+        if causal:
+            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, row >= col)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                               # (Bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                              # (Bq, Bkv)
+        alpha = jnp.exp(m_prev - m_new)                     # (Bq, 1)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        # Fully-masked rows (padding) would divide by zero; keep them finite.
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m_ref[:, :1] + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def flash_attention_fwd_pallas(q, k, v, *, causal: bool, scale: float,
+                               block_q: int = 512, block_kv: int = 512,
+                               interpret: bool = False):
+    """q: (B, Hq, Sq, D); k, v: (B, Hkv, Skv, D).
+
+    Returns ``(out, lse)``: out (B, Hq, Sq, D) in q.dtype, lse (B, Hq, Sq)
+    f32 where ``lse[i] = log(sum_j exp(scale·q_i·k_j))`` over unmasked j.
+    """
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+
+    block_q = max(16, min(block_q, sq))
+    block_kv = max(16, min(block_kv, skv))
+    sq_p = math.ceil(sq / block_q) * block_q
+    skv_p = math.ceil(skv / block_kv) * block_kv
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if skv_p != skv:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    nq = sq_p // block_q
+    nk = skv_p // block_kv
+
+    def q_index(bh, iq, ik):
+        return (bh, iq, 0)
+
+    def kv_index(bh, iq, ik):
+        return (bh // hq * hkv + (bh % hq) // group, ik, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, kv_len=skv, num_kv_blocks=nk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * hq, sq_p, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q.reshape(b * hq, sq_p, d),
+      k.reshape(b * hkv, skv_p, d),
+      v.reshape(b * hkv, skv_p, d))
+
+    out = out.reshape(b, hq, sq_p, d)[:, :, :sq]
+    lse = lse[:, :, 0].reshape(b, hq, sq_p)[:, :, :sq]
+    return out, lse

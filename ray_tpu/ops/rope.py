@@ -1,0 +1,34 @@
+"""Rotary position embeddings (non-interleaved / llama "neox" layout)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 500000.0,
+                     dtype=jnp.float32):
+    """(max_seq, head_dim/2) cos/sin tables."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: (..., seq, heads, head_dim). cos/sin: (max_seq, head_dim/2).
+
+    ``positions``: optional (..., seq) int array for non-contiguous positions
+    (decode steps, packed sequences).
+    """
+    if positions is None:
+        seq = x.shape[-3]
+        c, s = cos[:seq], sin[:seq]                # (seq, hd/2)
+        c = c[:, None, :]
+        s = s[:, None, :]
+    else:
+        c = cos[positions][..., :, None, :]
+        s = sin[positions][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

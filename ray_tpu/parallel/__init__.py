@@ -1,0 +1,39 @@
+"""ray_tpu.parallel — mesh construction and sharding for TPU pods.
+
+The reference framework delegates model parallelism to torch/NCCL (SURVEY.md
+§2.3: DP via torch DDP in ``python/ray/train/torch/config.py:66-151``, TP/PP
+only through vLLM passthrough). Here parallelism is a first-class, in-framework
+concern: a named :class:`jax.sharding.Mesh` over the pod slice, logical-axis
+sharding rules, and helpers that place pytrees onto the mesh. XLA inserts the
+ICI collectives; multi-slice meshes put the outermost (data) axis on DCN.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    best_effort_mesh,
+    get_abstract_mesh,
+    make_mesh,
+    mesh_shape_for,
+)
+from ray_tpu.parallel.sharding import (
+    LOGICAL_AXES,
+    ShardingRules,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "ShardingRules",
+    "LOGICAL_AXES",
+    "best_effort_mesh",
+    "get_abstract_mesh",
+    "logical_sharding",
+    "logical_spec",
+    "make_mesh",
+    "mesh_shape_for",
+    "shard_pytree",
+    "with_logical_constraint",
+]

@@ -1,0 +1,126 @@
+"""Device mesh construction.
+
+Axis convention (outer → inner, slowest → fastest varying):
+
+    ``dp``    pure data parallel; gradients all-reduced. Safe to map onto DCN
+              (multi-slice) because it communicates once per step.
+    ``fsdp``  data parallel with parameter/optimizer sharding (ZeRO-3 style);
+              all-gathers weights per layer → must ride ICI.
+    ``sp``    sequence/context parallel (ring attention / all-to-all); ICI.
+    ``tp``    tensor parallel (megatron-style activation collectives); the
+              chattiest axis → innermost, nearest-neighbor ICI.
+    ``ep``    expert parallel for MoE, aliased over fsdp×sp in the flat mesh.
+    ``pp``    pipeline stages (between-stage ppermute).
+
+The reference framework has no in-framework notion of any of these (SURVEY.md
+§2.3 "Parallelism strategies"); its TPU support stops at advertising a
+``TPU-<pod>-head`` custom resource (reference ``python/ray/_private/
+accelerators/tpu.py:338-374``). Here the mesh IS the programming model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degrees for each parallelism axis. ``-1`` on at most one axis means
+    "absorb all remaining devices"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    sp: int = 1
+    tp: int = 1
+    pp: int = 1
+    # Number of ICI-connected slices; >1 puts the leading dp axis on DCN.
+    num_slices: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                 "sp": self.sp, "tp": self.tp}
+        wildcards = [a for a, s in sizes.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wildcards}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcards:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def mesh_shape_for(n_devices: int, config: Optional[MeshConfig] = None):
+    config = config or MeshConfig()
+    sizes = config.resolve(n_devices)
+    return tuple(sizes[a] for a in AXIS_ORDER)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              axis_names: Sequence[str] = AXIS_ORDER):
+    """Build a `jax.sharding.Mesh` over ``devices`` (default: all).
+
+    Uses `mesh_utils.create_device_mesh` so axis order maps onto the physical
+    ICI torus (innermost axes = nearest neighbors); for ``num_slices > 1``
+    uses the hybrid helper so the outer dp axis crosses DCN.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in axis_names)
+    if config.num_slices > 1:
+        if sizes["dp"] % config.num_slices:
+            raise ValueError("dp degree must be a multiple of num_slices")
+        per_slice = list(shape)
+        dp_i = list(axis_names).index("dp")
+        per_slice[dp_i] = sizes["dp"] // config.num_slices
+        dcn = [1] * len(shape)
+        dcn[dp_i] = config.num_slices
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(per_slice), tuple(dcn), devices=devices)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, AssertionError):
+            # Non-torus device sets (CPU virtual devices, odd subsets).
+            import numpy as np
+            if devices and getattr(devices[0], "platform", "") == "tpu":
+                import warnings
+                warnings.warn(
+                    "create_device_mesh failed on TPU devices; falling back "
+                    "to a topology-oblivious reshape — tp/sp collectives may "
+                    "cross non-neighbor ICI links", stacklevel=2)
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def best_effort_mesh(tp: int = 1, sp: int = 1, devices=None):
+    """Mesh that uses all devices: requested tp/sp, remainder on fsdp."""
+    import jax
+    n = len(devices) if devices is not None else len(jax.devices())
+    tp = math.gcd(tp, n)
+    sp = math.gcd(sp, max(1, n // tp))
+    return make_mesh(MeshConfig(fsdp=-1, sp=sp, tp=tp), devices=devices)
+
+
+def get_abstract_mesh(n_devices: int, config: Optional[MeshConfig] = None,
+                      axis_names: Sequence[str] = AXIS_ORDER):
+    """An AbstractMesh for shape/sharding reasoning without real devices."""
+    from jax.sharding import AbstractMesh
+
+    config = config or MeshConfig()
+    sizes = config.resolve(n_devices)
+    return AbstractMesh(tuple(sizes[a] for a in axis_names), tuple(axis_names))

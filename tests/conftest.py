@@ -8,10 +8,20 @@ Must set env vars BEFORE jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the outer environment may point JAX_PLATFORMS at
+# real TPU hardware, and a sitecustomize may have imported jax before us —
+# env vars alone are too late; update the live jax config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + jax.default_backend())
+assert jax.device_count() == 8
 
 import pytest  # noqa: E402
 
