@@ -1,0 +1,17 @@
+"""Model zoo — TPU-first functional JAX models.
+
+The reference framework ships no model implementations of its own (its Train
+library wraps user torch code, its LLM library delegates to vLLM —
+SURVEY.md §2.4). A TPU-native framework needs in-framework models because the
+compute path (sharding annotations, scan-over-layers, Pallas attention,
+remat policy) IS the framework's value on TPU.
+"""
+
+from ray_tpu.models import llama  # noqa: F401
+from ray_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_logical_axes,
+)

@@ -1,0 +1,244 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design (vs the reference framework, which has no in-tree model — its LLM
+serving delegates to vLLM, reference ``python/ray/llm/_internal/serve/
+deployments/llm/vllm/vllm_models.py:206-220``):
+
+- **Pure functional**: params are a plain pytree of ``jax.Array``; every
+  entry has a parallel tree of *logical axis names*
+  (:func:`param_logical_axes`) consumed by ``ray_tpu.parallel.sharding`` —
+  one rule table swap re-lays-out the model (fsdp / tp / both).
+- **Scan over layers**: layer params are stacked on a leading ``layers``
+  axis and the block runs under ``jax.lax.scan`` + ``jax.checkpoint`` —
+  one compiled block regardless of depth, O(1) compile time in n_layers,
+  remat bounds activation HBM.
+- **bfloat16 activations, float32 einsum accumulation** — MXU-native.
+- **Flash attention** via ``ray_tpu.ops.attention`` (Pallas kernel on TPU).
+- **GQA** (n_kv_heads < n_heads) as in Llama-3.
+
+Decode path (KV cache) is in :mod:`ray_tpu.models.decoding`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16       # activation / weight dtype
+    remat: bool = True              # checkpoint each layer under scan
+    attn_block: int = 512           # flash attention tile size
+    # Ring/sequence-parallel attention: set by the trainer when sp > 1.
+    sp_axis: Optional[str] = None
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
+        """Training FLOPs/token: 6·N (fwd+bwd matmuls) + causal attention
+        (per layer fwd: QKᵀ and P·V are 4·S·d, halved by causality → 2·S·d;
+        ×3 for fwd+bwd → 6·L·S·d). The single source of truth for MFU."""
+        seq = self.max_seq if seq is None else seq
+        return (6.0 * self.num_params()
+                + 6.0 * self.n_layers * seq * self.hidden)
+
+    def num_params(self) -> int:
+        p = self.vocab_size * self.hidden                        # embed
+        per_layer = (
+            self.hidden * self.q_dim                             # wq
+            + 2 * self.hidden * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.q_dim * self.hidden                           # wo
+            + 3 * self.hidden * self.mlp_dim                     # gate/up/down
+            + 2 * self.hidden                                    # norms
+        )
+        p += self.n_layers * per_layer + self.hidden             # final norm
+        if not self.tie_embeddings:
+            p += self.hidden * self.vocab_size                   # lm head
+        return p
+
+
+# Named configs. tiny/debug sizes keep CI on the 8-device CPU mesh fast.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "debug": LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, head_dim=16, mlp_dim=128, max_seq=128,
+                         dtype=jnp.float32, remat=False),
+    "tiny": LlamaConfig(vocab_size=32000, hidden=512, n_layers=4, n_heads=8,
+                        n_kv_heads=4, head_dim=64, mlp_dim=1408, max_seq=2048),
+    "1b": LlamaConfig(vocab_size=128256, hidden=2048, n_layers=16, n_heads=32,
+                      n_kv_heads=8, head_dim=64, mlp_dim=8192, max_seq=8192),
+    "8b": LlamaConfig(),  # Llama-3-8B shapes
+    "70b": LlamaConfig(hidden=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                       head_dim=128, mlp_dim=28672),
+}
+
+
+def param_logical_axes(config: LlamaConfig) -> Params:
+    """Tree matching :func:`init_params` with logical-axis tuples as leaves."""
+    axes = {
+        "embed": ("vocab", "embed_fsdp"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed_fsdp", "heads", "head_dim"),
+            "wk": ("layers", "embed_fsdp", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed_fsdp", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed_fsdp"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed_fsdp", "mlp"),
+            "w_up": ("layers", "embed_fsdp", "mlp"),
+            "w_down": ("layers", "mlp", "embed_fsdp"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed_fsdp", "vocab")
+    return axes
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """Truncated-normal init, scaled residual projections (GPT-2 style)."""
+    c = config
+    k = iter(jax.random.split(key, 16))
+    dt = c.dtype
+
+    def tn(key, shape, std):
+        return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32)
+                * std).astype(dt)
+
+    std = c.hidden ** -0.5
+    out_std = std / (2 * c.n_layers) ** 0.5
+    L = c.n_layers
+    params: Params = {
+        # hidden^-0.5 keeps tied-head logits ~unit-variance at init.
+        "embed": tn(next(k), (c.vocab_size, c.hidden), std),
+        "layers": {
+            "attn_norm": jnp.zeros((L, c.hidden), dt),
+            "wq": tn(next(k), (L, c.hidden, c.n_heads, c.head_dim), std),
+            "wk": tn(next(k), (L, c.hidden, c.n_kv_heads, c.head_dim), std),
+            "wv": tn(next(k), (L, c.hidden, c.n_kv_heads, c.head_dim), std),
+            "wo": tn(next(k), (L, c.n_heads, c.head_dim, c.hidden), out_std),
+            "mlp_norm": jnp.zeros((L, c.hidden), dt),
+            "w_gate": tn(next(k), (L, c.hidden, c.mlp_dim), std),
+            "w_up": tn(next(k), (L, c.hidden, c.mlp_dim), std),
+            "w_down": tn(next(k), (L, c.mlp_dim, c.hidden), out_std),
+        },
+        "final_norm": jnp.zeros((c.hidden,), dt),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = tn(next(k), (c.hidden, c.vocab_size), std)
+    return params
+
+
+def _attention(x, layer, cos, sin, config: LlamaConfig,
+               rules: ShardingRules, positions=None, mesh=None):
+    c = config
+    q = jnp.einsum("bse,ehd->bshd", x, layer["wq"].astype(x.dtype))
+    kk = jnp.einsum("bse,ehd->bshd", x, layer["wk"].astype(x.dtype))
+    v = jnp.einsum("bse,ehd->bshd", x, layer["wv"].astype(x.dtype))
+    q = apply_rope(q, cos, sin, positions)
+    kk = apply_rope(kk, cos, sin, positions)
+    q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"), rules)
+    if c.sp_axis is not None and mesh is not None:
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        out = ring_attention(q, kk, v, mesh, causal=True,
+                             sp_axis=c.sp_axis,
+                             heads_axis=rules.heads,
+                             batch_axes=rules.batch,
+                             block=c.attn_block)
+    else:
+        out = flash_attention(q, kk, v, causal=True, block=c.attn_block)
+    out = with_logical_constraint(
+        out, ("batch", "seq", "heads", "head_dim"), rules)
+    return jnp.einsum("bshd,hde->bse", out, layer["wo"].astype(x.dtype))
+
+
+def _mlp(x, layer):
+    g = jnp.einsum("bse,em->bsm", x, layer["w_gate"].astype(x.dtype))
+    u = jnp.einsum("bse,em->bsm", x, layer["w_up"].astype(x.dtype))
+    return jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                      layer["w_down"].astype(x.dtype))
+
+
+def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
+            rules: Optional[ShardingRules] = None,
+            positions: Optional[jax.Array] = None, mesh=None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, vocab) float32.
+
+    Runs the layer stack as a single scanned+rematerialized block.
+    ``mesh`` is only needed for the sequence-parallel (ring attention) path.
+    """
+    c = config
+    rules = rules or ShardingRules()
+    x = params["embed"].astype(c.dtype)[tokens]
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block(x, layer):
+        h = _attention(rmsnorm(x, layer["attn_norm"], c.norm_eps),
+                       layer, cos, sin, c, rules, positions, mesh)
+        x = x + h
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        x = x + _mlp(rmsnorm(x, layer["mlp_norm"], c.norm_eps), layer)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        return x, None
+
+    if c.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], config: LlamaConfig,
+            rules: Optional[ShardingRules] = None, mesh=None):
+    """Next-token cross entropy.
+
+    ``batch``: {"tokens": (B, S) int32, optional "mask": (B, S) 0/1 —
+    positions whose *prediction* counts (mask[i] gates the loss at step i
+    predicting token i+1)}.
+    Returns (loss, aux dict).
+    """
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, config, rules, mesh=mesh)  # (B,S,V) f32
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    mask = batch.get("mask")
+    # mask[i] gates the loss term at step i (predicting token i+1), so the
+    # last position's mask value is unused.
+    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask[:, :-1].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc, "tokens": denom}

@@ -85,11 +85,41 @@ def logical_sharding(logical_axes, mesh, rules: ShardingRules):
 
 
 def with_logical_constraint(x, logical_axes, rules: ShardingRules):
-    """`lax.with_sharding_constraint` by logical axis names (inside jit)."""
+    """`lax.with_sharding_constraint` by logical axis names (inside jit).
+
+    No-op when no mesh is active (single-device eager/jit use), and mesh
+    axes the active mesh doesn't have are dropped — the same model code runs
+    unsharded, dp-only, or fully fsdp+tp+sp without edits.
+    """
     import jax
 
-    return jax.lax.with_sharding_constraint(
-        x, jax.sharding.PartitionSpec(*rules.mesh_axes(logical_axes)))
+    mesh = jax.sharding.get_abstract_mesh()
+    legacy_mesh = None
+    if mesh is None or mesh.empty:
+        # A legacy `with mesh:` context doesn't populate the abstract mesh;
+        # honor it rather than silently dropping the constraint.
+        from jax._src import mesh as mesh_lib
+
+        legacy_mesh = mesh_lib.thread_resources.env.physical_mesh
+        if legacy_mesh.empty:
+            return x
+        mesh = legacy_mesh
+    names = set(mesh.axis_names)
+
+    def keep(ax):
+        if ax is None:
+            return None
+        if isinstance(ax, tuple):
+            kept = tuple(a for a in ax if a in names)
+            return None if not kept else (kept[0] if len(kept) == 1 else kept)
+        return ax if ax in names else None
+
+    spec = jax.sharding.PartitionSpec(
+        *(keep(a) for a in rules.mesh_axes(logical_axes)))
+    if legacy_mesh is not None:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(legacy_mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def shard_pytree(tree, axes_tree, mesh, rules: ShardingRules):
