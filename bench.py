@@ -30,7 +30,7 @@ def peak_flops(device) -> float:
     kind = device.device_kind.lower().replace(" ", "")
     for key in ("v6", "v5p", "v4", "v3", "v2", "v5"):
         if key in kind:
-            return PEAK_FLOPS["v5" if key == "v5" else key]
+            return PEAK_FLOPS[key]
     return PEAK_FLOPS["v5"]
 
 

@@ -1,0 +1,36 @@
+"""Collective communication library.
+
+API surface mirrors the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py:150-692``): process-group-style
+collectives among actors/tasks — allreduce, reduce, broadcast, allgather,
+reducescatter, send/recv, barrier.
+
+Backends (the keystone divergence from the reference, SURVEY.md §2.3):
+
+- ``"xla"`` — in-program XLA collectives over the ICI mesh; for jax.Arrays
+  held by a single-controller process that owns a device mesh (the NCCL
+  replacement: collectives compile into the program, ride ICI).
+- ``"kv"``  — GCS-KV-store-based CPU/DCN fallback for numpy tensors among
+  distributed actors (the gloo replacement; rendezvous through the internal
+  KV exactly as the reference's collective groups bootstrap via the GCS).
+"""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    GroupManager,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_group_handle,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import Backend, ReduceOp  # noqa: F401

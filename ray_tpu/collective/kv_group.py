@@ -1,0 +1,173 @@
+"""KV-store collective group — the CPU/DCN fallback backend.
+
+Plays the role of the reference's gloo backend (reference
+``python/ray/util/collective/collective_group/gloo_collective_group.py``),
+but transports tensor bytes through the GCS internal KV + long-polls, the
+same store the reference uses only for rendezvous. No extra daemon, works
+for any actor set, survives raylet topology changes.
+
+Semantics: standard process-group rules — every member calls the same
+collective ops in the same order (per-group monotone sequence numbers keep
+ops matched; mismatched call orders surface as timeouts, not corruption).
+
+Data-plane keys are garbage-collected every ``GC_EVERY`` ops behind a
+barrier, so long-running groups don't grow the KV unboundedly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.collective.types import NUMPY_REDUCERS, ReduceOp
+
+GC_EVERY = 16
+
+
+class KVGroup:
+    backend_name = "kv"
+
+    def __init__(self, kv, world_size: int, rank: int, group_name: str,
+                 timeout_s: float = 60.0):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range [0, {world_size})")
+        self._kv = kv                       # GcsClient (kv_put/kv_get/…)
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self.timeout_s = timeout_s
+        self._ns = f"collective:{group_name}"
+        self._seq = 0
+        self._p2p_send_seq = {}
+        self._p2p_recv_seq = {}
+        # Rendezvous: announce, then wait for the full membership.
+        self._kv.kv_put(self._ns, f"member:{rank}",
+                        pickle.dumps(world_size), overwrite=True)
+        for r in range(world_size):
+            self._wait_key(f"member:{r}")
+
+    # ------------------------------------------------------------ plumbing
+    def _wait_key(self, key: str, timeout: Optional[float] = None) -> bytes:
+        deadline = time.monotonic() + (timeout or self.timeout_s)
+        delay = 0.002
+        while True:
+            blob = self._kv.kv_get(self._ns, key)
+            if blob is not None:
+                return blob
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {self.group_name!r} rank {self.rank}: "
+                    f"timed out waiting for {key!r} — mismatched op order or "
+                    f"a dead member?")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def _put(self, key: str, arr: np.ndarray):
+        self._kv.kv_put(self._ns, key,
+                        pickle.dumps(np.asarray(arr), protocol=5),
+                        overwrite=True)
+
+    def _get(self, key: str) -> np.ndarray:
+        return pickle.loads(self._wait_key(key))
+
+    def _next(self) -> int:
+        self._seq += 1
+        if self._seq % GC_EVERY == 0:
+            self._gc()
+        return self._seq
+
+    def _gc(self):
+        """Barrier, then rank 0 deletes data keys from finished ops."""
+        seq = self._seq
+        self._barrier_at(f"gcb:{seq}")
+        if self.rank == 0:
+            horizon = seq - 1
+            for key in self._kv.kv_keys(self._ns, prefix=b"op:"):
+                try:
+                    op_seq = int(key.decode().split(":")[1])
+                except (ValueError, IndexError):
+                    continue
+                if op_seq <= horizon:
+                    self._kv.kv_del(self._ns, key)
+            # Barrier keys from the *previous* GC round: every member has
+            # passed that barrier (they reached this one), safe to delete.
+            for r in range(self.world_size):
+                self._kv.kv_del(self._ns, f"gcb:{seq - GC_EVERY}:{r}")
+
+    def _barrier_at(self, tag: str):
+        self._kv.kv_put(self._ns, f"{tag}:{self.rank}", b"1", overwrite=True)
+        for r in range(self.world_size):
+            self._wait_key(f"{tag}:{r}")
+
+    # ---------------------------------------------------------- collectives
+    def barrier(self):
+        self._barrier_at(f"op:{self._next()}:bar")
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        seq = self._next()
+        self._put(f"op:{seq}:ar:{self.rank}", tensor)
+        reducer = getattr(np, NUMPY_REDUCERS[op])
+        out = None
+        for r in range(self.world_size):
+            part = self._get(f"op:{seq}:ar:{r}")
+            out = part if out is None else reducer(out, part)
+        return out
+
+    def reduce(self, tensor, dst_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        seq = self._next()
+        self._put(f"op:{seq}:rd:{self.rank}", tensor)
+        if self.rank != dst_rank:
+            return np.asarray(tensor)
+        reducer = getattr(np, NUMPY_REDUCERS[op])
+        out = None
+        for r in range(self.world_size):
+            part = self._get(f"op:{seq}:rd:{r}")
+            out = part if out is None else reducer(out, part)
+        return out
+
+    def broadcast(self, tensor, src_rank: int = 0) -> np.ndarray:
+        seq = self._next()
+        if self.rank == src_rank:
+            self._put(f"op:{seq}:bc", tensor)
+            return np.asarray(tensor)
+        return self._get(f"op:{seq}:bc")
+
+    def allgather(self, tensor) -> List[np.ndarray]:
+        seq = self._next()
+        self._put(f"op:{seq}:ag:{self.rank}", tensor)
+        return [self._get(f"op:{seq}:ag:{r}")
+                for r in range(self.world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        """Reduce across members, return this rank's 1/world_size slice of
+        axis 0 (axis-0 length must divide evenly)."""
+        arr = np.asarray(tensor)
+        if arr.shape[0] % self.world_size:
+            raise ValueError(
+                f"reducescatter axis-0 length {arr.shape[0]} not divisible "
+                f"by world size {self.world_size}")
+        full = self.allreduce(arr, op)
+        chunk = full.shape[0] // self.world_size
+        return full[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def send(self, tensor, dst_rank: int):
+        seq = self._p2p_send_seq.get(dst_rank, 0) + 1
+        self._p2p_send_seq[dst_rank] = seq
+        self._put(f"p2p:{self.rank}:{dst_rank}:{seq}", tensor)
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        seq = self._p2p_recv_seq.get(src_rank, 0) + 1
+        self._p2p_recv_seq[src_rank] = seq
+        key = f"p2p:{src_rank}:{self.rank}:{seq}"
+        out = self._get(key)
+        self._kv.kv_del(self._ns, key)
+        return out
+
+    def destroy(self):
+        if self.rank == 0:
+            for key in self._kv.kv_keys(self._ns):
+                self._kv.kv_del(self._ns, key)

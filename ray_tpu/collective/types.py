@@ -1,0 +1,36 @@
+"""Collective types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    XLA = "xla"    # in-program ICI collectives (reference's NCCL role)
+    KV = "kv"      # GCS-KV transport, CPU/DCN fallback (reference's gloo role)
+
+    @classmethod
+    def parse(cls, name: str) -> "Backend":
+        try:
+            return cls(name.lower())
+        except ValueError:
+            if name.lower() in ("nccl", "gloo", "torch_gloo", "mpi"):
+                raise ValueError(
+                    f"backend {name!r} is GPU/CPU-cluster specific to the "
+                    f"reference framework; use 'xla' (ICI) or 'kv' (DCN)")
+            raise ValueError(f"unrecognized backend {name!r}")
+
+
+class ReduceOp(enum.Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+
+
+NUMPY_REDUCERS = {
+    ReduceOp.SUM: "add",
+    ReduceOp.PRODUCT: "multiply",
+    ReduceOp.MIN: "minimum",
+    ReduceOp.MAX: "maximum",
+}

@@ -1,0 +1,143 @@
+"""XLA collective group — in-program ICI collectives.
+
+The NCCL replacement (reference ``python/ray/util/collective/
+collective_group/nccl_collective_group.py``), redesigned for XLA's
+compilation model: a "group" is a device mesh axis owned by ONE
+single-controller process, and each collective op is a tiny jitted program
+whose collective rides ICI.
+
+Convention: ops take a **stacked** array whose leading axis is the member
+axis (length ``world_size``); the array is (re)sharded so member i's slab
+lives on device i, the collective runs on-device over the mesh axis, and
+the result comes back replicated (allreduce/allgather) or member-sharded
+(reducescatter). This is the eager-op complement to writing ``psum`` /
+``ppermute`` directly inside your own pjit programs — which remains the
+idiomatic hot path (SURVEY.md §2.3: collectives compile into XLA programs).
+
+Multi-host SPMD groups bootstrap a coordinator address via the internal KV
+(exactly how the reference shares the NCCL uniqueid) and then use
+``jax.distributed`` + the same jitted ops over the global mesh; the Train
+worker group owns that wiring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.collective.types import ReduceOp
+
+_REDUCE_LAX = {
+    ReduceOp.SUM: "psum",
+    ReduceOp.MAX: "pmax",
+    ReduceOp.MIN: "pmin",
+}
+
+
+class XlaGroup:
+    backend_name = "xla"
+
+    def __init__(self, world_size: int, rank: int = 0, group_name: str = "",
+                 devices: Optional[list] = None, axis: str = "x"):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = devices if devices is not None else jax.devices()
+        if world_size > len(devices):
+            raise ValueError(
+                f"world_size {world_size} exceeds {len(devices)} devices")
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self.axis = axis
+        self.mesh = Mesh(np.asarray(devices[:world_size]), (axis,))
+        self._member_sharding = NamedSharding(self.mesh, P(axis))
+        self._replicated = NamedSharding(self.mesh, P())
+
+    def _check(self, tensor):
+        if tensor.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading (member) axis {tensor.shape[0]} != world_size "
+                f"{self.world_size}")
+
+    def _placed(self, tensor):
+        import jax
+
+        return jax.device_put(tensor, self._member_sharding)
+
+    @functools.lru_cache(maxsize=32)
+    def _fn(self, kind: str, lax_name: str):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis
+
+        if kind == "allreduce":
+            def body(x):                       # per-device (1, ...)
+                return getattr(jax.lax, lax_name)(x[0], axis)
+            out_spec = P()
+        elif kind == "reducescatter":
+            def body(x):                       # per-device (1, W*c, ...)
+                return jax.lax.psum_scatter(x[0], axis, tiled=True)
+            out_spec = P(axis)
+        else:
+            raise AssertionError(kind)
+        return jax.jit(jax.shard_map(body, mesh=self.mesh,
+                                     in_specs=P(axis), out_specs=out_spec))
+
+    # ---------------------------------------------------------- collectives
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """(W, ...) stacked → (...) reduced, replicated over the group."""
+        tensor = self._placed(tensor)
+        self._check(tensor)
+        lax_name = _REDUCE_LAX.get(op)
+        if lax_name is None:
+            raise ValueError(f"{op} unsupported by the xla backend")
+        return self._fn("allreduce", lax_name)(tensor)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        # Single-controller: result is replicated anyway.
+        return self.allreduce(tensor, op)
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        """Replicate member ``src_rank``'s slab over the group."""
+        import jax
+
+        tensor = self._placed(tensor)
+        self._check(tensor)
+        return jax.device_put(tensor[src_rank], self._replicated)
+
+    def allgather(self, tensor) -> List:
+        """(W, ...) stacked → list of W arrays, each replicated."""
+        import jax
+
+        tensor = self._placed(tensor)
+        self._check(tensor)
+        gathered = jax.device_put(tensor, self._replicated)
+        return [gathered[i] for i in range(self.world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """(W, W·c, ...) stacked → (W, c, ...): member i gets the reduction
+        of every member's i-th chunk (sharded, member i's chunk on device i).
+        """
+        tensor = self._placed(tensor)
+        self._check(tensor)
+        if op is not ReduceOp.SUM:
+            raise ValueError("xla reducescatter supports SUM only")
+        if tensor.shape[1] % self.world_size:
+            raise ValueError(
+                f"axis-1 length {tensor.shape[1]} not divisible by "
+                f"world size {self.world_size}")
+        flat = self._fn("reducescatter", "psum")(tensor)   # (W*c, ...)
+        return flat.reshape((self.world_size, -1) + tensor.shape[2:])
+
+    def barrier(self):
+        """Single-controller: drain the dispatch queue."""
+        import jax
+
+        jax.effects_barrier()
+
+    def destroy(self):
+        self._fn.cache_clear()

@@ -15,8 +15,6 @@ deployments/llm/vllm/vllm_models.py:206-220``):
 - **bfloat16 activations, float32 einsum accumulation** — MXU-native.
 - **Flash attention** via ``ray_tpu.ops.attention`` (Pallas kernel on TPU).
 - **GQA** (n_kv_heads < n_heads) as in Llama-3.
-
-Decode path (KV cache) is in :mod:`ray_tpu.models.decoding`.
 """
 
 from __future__ import annotations
@@ -64,7 +62,7 @@ class LlamaConfig:
         ×3 for fwd+bwd → 6·L·S·d). The single source of truth for MFU."""
         seq = self.max_seq if seq is None else seq
         return (6.0 * self.num_params()
-                + 6.0 * self.n_layers * seq * self.hidden)
+                + 6.0 * self.n_layers * seq * self.q_dim)
 
     def num_params(self) -> int:
         p = self.vocab_size * self.hidden                        # embed
@@ -99,7 +97,10 @@ CONFIGS: Dict[str, LlamaConfig] = {
 def param_logical_axes(config: LlamaConfig) -> Params:
     """Tree matching :func:`init_params` with logical-axis tuples as leaves."""
     axes = {
-        "embed": ("vocab", "embed_fsdp"),
+        # The table's vocab dim stays unsharded by default (embed_vocab rule):
+        # a gather over a tp-sharded vocab axis forces XLA into
+        # replicate-then-repartition ("involuntary full rematerialization").
+        "embed": ("embed_vocab", "embed_fsdp"),
         "layers": {
             "attn_norm": ("layers", "embed"),
             "wq": ("layers", "embed_fsdp", "heads", "head_dim"),
@@ -193,7 +194,14 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
     """
     c = config
     rules = rules or ShardingRules()
-    x = params["embed"].astype(c.dtype)[tokens]
+    tokens = with_logical_constraint(tokens, ("batch", "seq"), rules)
+    # Gather from a replicated table view: with batch-sharded indices the
+    # gather output then lands directly in the activation layout. (The table
+    # is stored fsdp-sharded; XLA inserts one all-gather — cheap next to the
+    # involuntary-full-remat path a sharded-table gather triggers.)
+    table = with_logical_constraint(
+        params["embed"], ("embed_vocab", "embed"), rules)
+    x = table.astype(c.dtype)[tokens]
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
 

@@ -21,7 +21,8 @@ LOGICAL_AXES = (
     "kv_heads",   # kv heads (GQA)
     "head_dim",
     "mlp",        # ffn hidden              → tp
-    "vocab",      # embedding/logits vocab  → tp
+    "vocab",      # logits vocab            → tp
+    "embed_vocab",  # embedding-table vocab dim (gather axis) → replicated
     "layers",     # scan-over-layers leading axis (never sharded)
     "expert",     # MoE experts             → ep (fsdp, sp)
     "kv_seq",     # kv-cache sequence dim
@@ -44,6 +45,7 @@ class ShardingRules:
     head_dim: Axis = None
     mlp: Axis = "tp"
     vocab: Axis = "tp"
+    embed_vocab: Axis = None
     layers: Axis = None
     expert: Axis = ("fsdp", "sp")
     kv_seq: Axis = None

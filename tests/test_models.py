@@ -121,10 +121,17 @@ def test_sharded_matches_single_device_loss():
 
 
 def test_loss_mask():
+    """Masked loss == mean of per-position NLLs at exactly the masked
+    prediction positions (mask[i] gates the step predicting token i+1)."""
     params = llama.init_params(CFG, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, CFG.vocab_size)
-    full, aux_full = llama.loss_fn(params, {"tokens": tokens}, CFG)
     mask = jnp.zeros((2, 16), jnp.int32).at[:, :8].set(1)
-    _, aux_masked = llama.loss_fn(params, {"tokens": tokens, "mask": mask},
-                                  CFG)
-    assert aux_masked["tokens"] < aux_full["tokens"]
+    loss_masked, aux = llama.loss_fn(params, {"tokens": tokens, "mask": mask},
+                                     CFG)
+    assert aux["tokens"] == 16  # 8 prediction positions × 2 rows
+
+    logits = llama.forward(params, tokens, CFG)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    ref = nll[:, :8].mean()  # steps 0..7 predict tokens 1..8
+    np.testing.assert_allclose(float(loss_masked), float(ref), rtol=1e-6)
