@@ -61,8 +61,14 @@ class GroupManager:
                 f"create_collective_group()")
         meta = pickle.loads(info)
         rank = self._my_declared_rank(meta)
-        return self.create(meta["backend"], meta["world_size"], rank,
-                           group_name)
+        try:
+            return self.create(meta["backend"], meta["world_size"], rank,
+                               group_name)
+        except RuntimeError:
+            # Lost a lazy-join race with a concurrent thread of this actor:
+            # the group now exists — use it.
+            with self._lock:
+                return self._groups[group_name]
 
     @staticmethod
     def _my_declared_rank(meta) -> int:

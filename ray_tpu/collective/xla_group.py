@@ -22,7 +22,6 @@ worker group owns that wiring.
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional
 
 import numpy as np
@@ -55,8 +54,12 @@ class XlaGroup:
         self.mesh = Mesh(np.asarray(devices[:world_size]), (axis,))
         self._member_sharding = NamedSharding(self.mesh, P(axis))
         self._replicated = NamedSharding(self.mesh, P())
+        self._fn_cache = {}  # per-instance: no cross-group lifetime pinning
 
     def _check(self, tensor):
+        import numpy as _np
+
+        tensor = _np.asarray(tensor) if not hasattr(tensor, "shape") else tensor
         if tensor.shape[0] != self.world_size:
             raise ValueError(
                 f"leading (member) axis {tensor.shape[0]} != world_size "
@@ -67,8 +70,10 @@ class XlaGroup:
 
         return jax.device_put(tensor, self._member_sharding)
 
-    @functools.lru_cache(maxsize=32)
     def _fn(self, kind: str, lax_name: str):
+        cached = self._fn_cache.get((kind, lax_name))
+        if cached is not None:
+            return cached
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -84,14 +89,16 @@ class XlaGroup:
             out_spec = P(axis)
         else:
             raise AssertionError(kind)
-        return jax.jit(jax.shard_map(body, mesh=self.mesh,
-                                     in_specs=P(axis), out_specs=out_spec))
+        fn = jax.jit(jax.shard_map(body, mesh=self.mesh,
+                                   in_specs=P(axis), out_specs=out_spec))
+        self._fn_cache[(kind, lax_name)] = fn
+        return fn
 
     # ---------------------------------------------------------- collectives
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         """(W, ...) stacked → (...) reduced, replicated over the group."""
-        tensor = self._placed(tensor)
         self._check(tensor)
+        tensor = self._placed(tensor)
         lax_name = _REDUCE_LAX.get(op)
         if lax_name is None:
             raise ValueError(f"{op} unsupported by the xla backend")
@@ -105,16 +112,16 @@ class XlaGroup:
         """Replicate member ``src_rank``'s slab over the group."""
         import jax
 
-        tensor = self._placed(tensor)
         self._check(tensor)
+        tensor = self._placed(tensor)
         return jax.device_put(tensor[src_rank], self._replicated)
 
     def allgather(self, tensor) -> List:
         """(W, ...) stacked → list of W arrays, each replicated."""
         import jax
 
-        tensor = self._placed(tensor)
         self._check(tensor)
+        tensor = self._placed(tensor)
         gathered = jax.device_put(tensor, self._replicated)
         return [gathered[i] for i in range(self.world_size)]
 
@@ -122,7 +129,6 @@ class XlaGroup:
         """(W, W·c, ...) stacked → (W, c, ...): member i gets the reduction
         of every member's i-th chunk (sharded, member i's chunk on device i).
         """
-        tensor = self._placed(tensor)
         self._check(tensor)
         if op is not ReduceOp.SUM:
             raise ValueError("xla reducescatter supports SUM only")
@@ -130,6 +136,7 @@ class XlaGroup:
             raise ValueError(
                 f"axis-1 length {tensor.shape[1]} not divisible by "
                 f"world size {self.world_size}")
+        tensor = self._placed(tensor)
         flat = self._fn("reducescatter", "psum")(tensor)   # (W*c, ...)
         return flat.reshape((self.world_size, -1) + tensor.shape[2:])
 
@@ -140,4 +147,4 @@ class XlaGroup:
         jax.effects_barrier()
 
     def destroy(self):
-        self._fn.cache_clear()
+        self._fn_cache.clear()

@@ -1,0 +1,29 @@
+"""Train library — Train-v2-shaped distributed training on TPU.
+
+Reference architecture (SURVEY.md §3.4, reference ``python/ray/train/v2/``):
+controller state-machine loop + gang-scheduled worker group + scaling /
+failure policies + checkpoint manager. The TPU divergence: workers don't
+wire a torch process group — rank 0 publishes a JAX coordinator address via
+the internal KV and every worker joins the global device mesh
+(``jax.distributed``), after which all parallelism is in-program GSPMD.
+"""
+
+from ray_tpu.train.checkpoint import (  # noqa: F401
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+)
+from ray_tpu.train.context import (  # noqa: F401
+    TrainContext,
+    checkpoint_dir,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import (  # noqa: F401
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)

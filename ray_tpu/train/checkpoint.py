@@ -1,0 +1,160 @@
+"""Checkpoints: directory-based artifacts + top-k retention.
+
+Reference: ``python/ray/train/_checkpoint.py`` (dir-based ``Checkpoint``)
+and ``train/v2/_internal/execution/checkpoint/checkpoint_manager.py``
+(registration + ``CheckpointConfig`` pruning). Storage here is a local/NFS
+path; jax pytrees are saved with orbax when available (the TPU-native
+serializer — sharded arrays restore onto the live mesh), pickle otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory of files produced by training (reference
+    ``Checkpoint.from_directory``)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # --- pytree convenience (net-new vs reference: jax-aware payloads) ---
+    @classmethod
+    def from_pytree(cls, path: str, tree: Any) -> "Checkpoint":
+        os.makedirs(path, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(os.path.abspath(path), "pytree"),
+                       tree, force=True)
+            ckptr.wait_until_finished()
+        except Exception:  # noqa: BLE001 — orbax missing or backend quirks
+            # A half-written orbax dir would shadow the pickle on restore.
+            shutil.rmtree(os.path.join(os.path.abspath(path), "pytree"),
+                          ignore_errors=True)
+            import pickle
+
+            import jax
+
+            host_tree = jax.tree.map(
+                lambda x: __import__("numpy").asarray(x), tree)
+            with open(os.path.join(path, "pytree.pkl"), "wb") as f:
+                pickle.dump(host_tree, f, protocol=5)
+        return cls(path)
+
+    def to_pytree(self, target: Any = None) -> Any:
+        """Restore; ``target`` (an abstract/shaped pytree) drives sharded
+        restore placement under orbax."""
+        pdir = os.path.join(self.path, "pytree")
+        if os.path.isdir(pdir):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            return ckptr.restore(pdir, target)
+        import pickle
+
+        with open(os.path.join(self.path, "pytree.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Reference: ``python/ray/air/config.py`` CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"     # "max" | "min"
+
+
+@dataclasses.dataclass
+class _Tracked:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any]
+    index: int
+
+
+class CheckpointManager:
+    """Registers reported checkpoints, prunes to ``num_to_keep``."""
+
+    def __init__(self, storage_path: str, config: CheckpointConfig):
+        self.storage_path = storage_path
+        self.config = config
+        self._tracked: List[_Tracked] = []
+        self._index = 0
+        self._lock = threading.Lock()
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        with self._lock:
+            self._index += 1
+            t = _Tracked(checkpoint, dict(metrics), self._index)
+            self._tracked.append(t)
+            with open(os.path.join(checkpoint.path, "_metrics.json"),
+                      "w") as f:
+                json.dump({"metrics": _json_safe(metrics),
+                           "index": self._index}, f)
+            self._prune()
+            return checkpoint
+
+    def _score(self, t: _Tracked):
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return t.index                       # keep most recent
+        if attr not in t.metrics:
+            return float("-inf")                 # unscored ranks worst
+        sign = 1 if self.config.checkpoint_score_order == "max" else -1
+        return sign * float(t.metrics[attr])
+
+    def _prune(self):
+        keep = self.config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        self._tracked.sort(key=self._score, reverse=True)
+        for t in self._tracked[keep:]:
+            shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+        self._tracked = self._tracked[:keep]
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._tracked:
+                return None
+            return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._tracked:
+                return None
+            return max(self._tracked, key=self._score).checkpoint
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _json_safe(v) for k, v in obj.items()}
+        try:
+            return float(obj)
+        except (TypeError, ValueError):
+            return repr(obj)

@@ -1,0 +1,128 @@
+"""Worker-side training context + report API.
+
+Reference: ``python/ray/train/v2/api/context.py`` (TrainContext) and
+``ray.train.report`` — metrics/checkpoint flow from workers to the
+controller. TPU addition: the context carries the JAX distributed-mesh
+bootstrap info (coordinator address, process id/count) so ``train_fn``
+can join the global device mesh with one call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_context_lock = threading.Lock()
+_context: Optional["TrainContext"] = None
+
+
+@dataclasses.dataclass
+class TrainContext:
+    experiment_name: str
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    storage_path: str
+    # JAX mesh bootstrap (multi-host SPMD): rank 0's RPC coordinator.
+    coordinator: Optional[str] = None
+    resume_from: Optional[Checkpoint] = None
+
+    # populated by the worker harness
+    _reports: List[dict] = dataclasses.field(default_factory=list)
+    _report_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+    _stop_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False)
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_storage_path(self) -> str:
+        return self.storage_path
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        """Checkpoint to resume from (set on restore / failure restart)."""
+        return self.resume_from
+
+    def should_stop(self) -> bool:
+        """Cooperative-cancellation flag (elastic resize / shutdown)."""
+        return self._stop_event.is_set()
+
+    def init_jax_distributed(self) -> None:
+        """Join the global JAX mesh (multi-host SPMD). No-op when
+        single-process (tests, one-host runs)."""
+        if self.world_size == 1 or self.coordinator is None:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.world_size,
+            process_id=self.world_rank)
+
+    # -------------------------------------------------- report plumbing
+    def _push_report(self, metrics: Dict[str, Any],
+                     checkpoint: Optional[Checkpoint]):
+        with self._report_lock:
+            self._reports.append({
+                "metrics": dict(metrics),
+                "checkpoint_path": checkpoint.path if checkpoint else None,
+            })
+
+    def _drain_reports(self) -> List[dict]:
+        with self._report_lock:
+            out, self._reports = self._reports, []
+            return out
+
+
+def get_context() -> TrainContext:
+    with _context_lock:
+        if _context is None:
+            raise RuntimeError(
+                "ray_tpu.train.get_context() called outside a training "
+                "worker")
+        return _context
+
+
+def _set_context(ctx: Optional[TrainContext]):
+    global _context
+    with _context_lock:
+        _context = ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ checkpoint) from inside ``train_fn``.
+
+    Like the reference, the checkpoint must already be persisted (written
+    to a directory under the storage path — e.g. via
+    ``Checkpoint.from_pytree``); report only registers it.
+    """
+    get_context()._push_report(metrics, checkpoint)
+
+
+def checkpoint_dir(step: int) -> str:
+    """Canonical per-step checkpoint directory for this run (rank-shared)."""
+    ctx = get_context()
+    return os.path.join(ctx.storage_path, f"checkpoint_{step:08d}")

@@ -1,0 +1,178 @@
+"""JaxTrainer: controller loop + configs.
+
+Reference: ``python/ray/train/v2/api/data_parallel_trainer.py:108`` (fit)
+driving ``TrainController`` (``…/controller/controller.py:93`` — poll
+workers, consult failure policy, restart group). Same control shape here,
+driver-side: the controller loop polls the worker group, registers reported
+checkpoints, and restarts the gang (from the latest checkpoint) on worker
+failure until ``FailureConfig.max_failures`` is exhausted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Reference: ``ray.train.ScalingConfig`` (air/config.py). TPU twist:
+    ``use_tpu`` + per-worker chip counts; SLICE_PACK keeps the gang on one
+    ICI slice."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if not res:
+            res = {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[str] = None
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class JaxTrainer:
+    """Data-parallel/SPMD trainer over a gang of TPU workers."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 poll_interval_s: float = 0.2):
+        self.train_fn = train_loop_per_worker
+        self.config = train_loop_config
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from = resume_from_checkpoint
+        self.poll_interval_s = poll_interval_s
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, timeout_s: float = 3600.0) -> Result:
+        name = self.run_config.name or f"train_{int(time.time())}"
+        storage = os.path.join(
+            self.run_config.storage_path
+            or os.path.expanduser("~/ray_tpu_results"), name)
+        os.makedirs(storage, exist_ok=True)
+        manager = CheckpointManager(storage,
+                                    self.run_config.checkpoint_config)
+        if self.resume_from is None:
+            found = _latest_checkpoint_in(storage)
+            if found is not None:
+                logger.info("auto-resuming from %s", found.path)
+                self.resume_from = found
+
+        failures = 0
+        last_metrics: Dict[str, Any] = {}
+        deadline = time.monotonic() + timeout_s
+        while True:
+            group = WorkerGroup(self.scaling.num_workers,
+                                self.scaling.bundle(),
+                                self.scaling.placement_strategy)
+            resume = manager.latest or self.resume_from
+            group.start(experiment_name=name, storage_path=storage,
+                        train_fn=self.train_fn, config=self.config,
+                        resume_from_path=resume.path if resume else None)
+            error = None
+            try:
+                error, last_metrics = self._poll_until_done(
+                    group, manager, last_metrics, deadline)
+            finally:
+                group.shutdown()
+            if error is None:
+                return Result(metrics=last_metrics,
+                              checkpoint=manager.latest, path=storage)
+            failures += 1
+            max_failures = self.run_config.failure_config.max_failures
+            if failures > max_failures:
+                raise TrainingFailedError(
+                    f"training failed {failures} time(s), "
+                    f"max_failures={max_failures} exhausted:\n{error}")
+            logger.warning("worker failure (%d/%d), restarting group:\n%s",
+                           failures,
+                           self.run_config.failure_config.max_failures,
+                           error)
+
+    def _poll_until_done(self, group: WorkerGroup,
+                         manager: CheckpointManager,
+                         last_metrics: Dict[str, Any],
+                         deadline: float):
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("JaxTrainer.fit timeout exceeded")
+            try:
+                statuses = group.poll()
+            except Exception as e:  # noqa: BLE001 — actor death IS a failure
+                return (f"worker group poll failed (worker process died?): "
+                        f"{type(e).__name__}: {e}"), last_metrics
+            for rank, st in enumerate(statuses):
+                for rep in st["reports"]:
+                    if rep["metrics"]:
+                        last_metrics = rep["metrics"]
+                    # rank 0's checkpoint registration wins; other ranks
+                    # contribute shards to the same directory.
+                    if rep["checkpoint_path"] and rank == 0:
+                        manager.register(Checkpoint(rep["checkpoint_path"]),
+                                         rep["metrics"])
+            errs = [st["error"] for st in statuses if st["status"] == "error"]
+            if errs:
+                return errs[0], last_metrics
+            if all(st["status"] == "finished" for st in statuses):
+                return None, last_metrics
+            time.sleep(self.poll_interval_s)
+
+
+def _latest_checkpoint_in(storage: str) -> Optional[Checkpoint]:
+    try:
+        entries = sorted(
+            e for e in os.listdir(storage)
+            if e.startswith("checkpoint_")
+            and os.path.isdir(os.path.join(storage, e)))
+    except FileNotFoundError:
+        return None
+    # Only count checkpoints that completed registration.
+    for e in reversed(entries):
+        path = os.path.join(storage, e)
+        if os.path.exists(os.path.join(path, "_metrics.json")):
+            return Checkpoint(path)
+    return None
