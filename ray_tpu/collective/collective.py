@@ -65,10 +65,12 @@ class GroupManager:
             return self.create(meta["backend"], meta["world_size"], rank,
                                group_name)
         except RuntimeError:
-            # Lost a lazy-join race with a concurrent thread of this actor:
-            # the group now exists — use it.
+            # Only swallow the lazy-join race (a concurrent thread created
+            # the group); re-raise genuine construction failures.
             with self._lock:
-                return self._groups[group_name]
+                if group_name in self._groups:
+                    return self._groups[group_name]
+            raise
 
     @staticmethod
     def _my_declared_rank(meta) -> int:

@@ -129,6 +129,8 @@ class XlaGroup:
         """(W, W·c, ...) stacked → (W, c, ...): member i gets the reduction
         of every member's i-th chunk (sharded, member i's chunk on device i).
         """
+        tensor = np.asarray(tensor) if not hasattr(tensor, "shape") \
+            else tensor
         self._check(tensor)
         if op is not ReduceOp.SUM:
             raise ValueError("xla reducescatter supports SUM only")

@@ -1,0 +1,20 @@
+"""Compiled graphs — static actor DAGs (reference: ``python/ray/dag/``).
+
+``.bind()`` on remote functions/classes/actor methods builds a DAG;
+``experimental_compile()`` freezes it into a reusable execution plan
+(actors instantiated once, schedule topo-sorted once, argument wiring
+precomputed). On TPU the heavy lifting *inside* each stage is a compiled
+XLA program; the graph layer's job is stage orchestration — e.g.
+pipeline-parallel stages as a chain of TPU actors.
+"""
+
+from ray_tpu.graph.dag import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.graph.compiled import CompiledDAG  # noqa: F401

@@ -1,0 +1,91 @@
+"""Compiled DAG execution (reference: ``python/ray/dag/compiled_dag_node.py``
+``CompiledDAG:809``).
+
+Compilation freezes the graph: DAG-owned actors are instantiated exactly
+once, the schedule is topo-sorted once, and each ``execute()`` replays the
+schedule submitting actor tasks with pre-wired argument routing — the
+driver does no graph traversal, serialization of the graph, or actor
+creation per call. Successive ``execute()`` calls pipeline naturally:
+submission is async, so stage k of invocation i+1 overlaps stage k+1 of
+invocation i (the actor-side sequence queues keep per-actor order).
+
+The reference gains additional speed from preallocated shm/NCCL channels;
+the TPU equivalent (device-buffer channels between TPU actors) rides the
+object-plane work and is tracked as future work — the API contract
+(`experimental_compile` → ``execute`` → ref) is stable either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.graph.dag import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_inflight: int = 64):
+        self._root = root
+        self._schedule = root._topo()
+        self._max_inflight = max_inflight
+        self._inflight: List[Any] = []
+        self._owned_actors = []
+        self._actors: Dict[int, Any] = {}
+        self._validate()
+        self._instantiate_actors()
+
+    def _validate(self):
+        n_inputs = sum(isinstance(n, InputNode) for n in self._schedule)
+        if n_inputs > 1:
+            raise ValueError("a DAG must have exactly one InputNode")
+        for node in self._schedule:
+            if isinstance(node, (InputNode, InputAttributeNode, ClassNode,
+                                 ClassMethodNode, FunctionNode,
+                                 MultiOutputNode)):
+                continue
+            raise TypeError(f"cannot compile node type {type(node).__name__}")
+
+    def _instantiate_actors(self):
+        resolved: Dict[int, Any] = {}
+        for node in self._schedule:
+            if isinstance(node, ClassNode):
+                handle = node._instantiate(resolved)
+                resolved[id(node)] = handle
+                self._actors[id(node)] = handle
+                self._owned_actors.append(handle)
+
+    def execute(self, *args, **kwargs):
+        """Submit one invocation; returns ObjectRef (or list for
+        MultiOutputNode). Backpressure: caps driver-side inflight refs."""
+        if len(self._inflight) >= self._max_inflight:
+            import ray_tpu
+
+            head = self._inflight.pop(0)
+            ray_tpu.wait(head if isinstance(head, list) else [head],
+                         num_returns=1, timeout=None)
+        resolved: Dict[int, Any] = dict(self._actors)
+        for node in self._schedule:
+            if isinstance(node, ClassNode):
+                continue  # already resolved to its live handle
+            resolved[id(node)] = node._apply(resolved, args, kwargs)
+        out = resolved[id(self._root)]
+        self._inflight.append(out)
+        return out
+
+    def teardown(self):
+        import ray_tpu
+
+        for handle in self._owned_actors:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        self._owned_actors = []
+        self._actors = {}

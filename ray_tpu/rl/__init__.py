@@ -1,0 +1,19 @@
+"""RL library — RLlib-equivalent stack, TPU-first.
+
+Reference architecture (SURVEY.md §3.5, reference ``rllib/``): an
+``Algorithm`` drives a sample→learn loop over an EnvRunner actor fleet
+(CPU) and a Learner group (accelerator). Divergences for TPU: the Learner
+is a JAX/optax pure-function SGD step (pjit-able onto a TPU mesh), env
+runners are numpy-only processes (no accelerator runtime in rollout
+workers), and fleet fan-out goes through :class:`FaultTolerantActorManager`
+exactly as the reference does (``rllib/utils/actor_manager.py:198``).
+"""
+
+from ray_tpu.rl.actor_manager import FaultTolerantActorManager  # noqa: F401
+from ray_tpu.rl.algorithm import (  # noqa: F401
+    Algorithm,
+    AlgorithmConfig,
+    PPO,
+    PPOConfig,
+)
+from ray_tpu.rl.envs import CartPoleEnv, make_env  # noqa: F401

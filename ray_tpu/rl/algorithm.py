@@ -1,0 +1,186 @@
+"""Algorithm: the sample→learn control loop.
+
+Reference: ``rllib/algorithms/algorithm.py:207`` (Algorithm),
+``algorithm_config.py`` (builder-style config), PPO ``training_step`` at
+``rllib/algorithms/ppo/ppo.py:388``: fan out sampling to the EnvRunner
+fleet via FaultTolerantActorManager, update the learner, broadcast weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rl.actor_manager import FaultTolerantActorManager
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.learner import PPOLearner, compute_gae
+from ray_tpu.rl.module import init_policy_params
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    env: Union[str, Any] = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    lr: float = 3e-4
+    seed: int = 0
+    # network
+    hidden: tuple = (64, 64)
+    # restart dead env runners on the next step
+    restart_failed_env_runners: bool = True
+
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # type: ignore[attr-defined]
+
+
+class Algorithm:
+    """Base sample→learn loop driver (reference ``Algorithm.step:986``)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        self._weights_version = 0
+        self._env_probe = _probe_env(config.env)
+        remote_runner = ray_tpu.remote(EnvRunner)
+        actors = [
+            remote_runner.remote(config.env, seed=config.seed,
+                                 worker_index=i)
+            for i in range(config.num_env_runners)
+        ]
+        self.env_runner_group = FaultTolerantActorManager(actors)
+        self._return_window: List[float] = []
+
+    # -------------------------------------------------------------- train
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        self.iteration += 1
+        results = self.training_step()
+        results.setdefault("training_iteration", self.iteration)
+        results["time_this_iter_s"] = time.perf_counter() - t0
+        return results
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _maybe_restore_runners(self):
+        if self.config.restart_failed_env_runners:
+            self.env_runner_group.probe_health()
+
+    def _sample_fragments(self) -> List[Dict[str, Any]]:
+        self._maybe_restore_runners()
+        version = self._weights_version
+        weights = self.get_weights()
+        self.env_runner_group.foreach_actor(
+            lambda a: a.set_weights.remote(weights, version))
+        results = self.env_runner_group.foreach_actor(
+            lambda a: a.sample.remote(self.config.rollout_fragment_length))
+        return [r.value for r in results if r.ok]
+
+    def episode_return_mean(self) -> float:
+        if not self._return_window:
+            return float("nan")
+        return float(np.mean(self._return_window[-100:]))
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def stop(self):
+        for i in list(self.env_runner_group.actors):
+            self.env_runner_group.remove_actor(i)
+
+
+class PPO(Algorithm):
+    def __init__(self, config: "PPOConfig"):
+        super().__init__(config)
+        params = init_policy_params(
+            self._env_probe["obs_size"], self._env_probe["num_actions"],
+            hidden=tuple(config.hidden), seed=config.seed)
+        self.learner = PPOLearner(
+            params, lr=config.lr, clip=config.clip,
+            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
+            num_epochs=config.num_epochs,
+            minibatch_size=config.minibatch_size, seed=config.seed)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def training_step(self) -> Dict[str, Any]:
+        fragments = self._sample_fragments()
+        if not fragments:
+            raise RuntimeError("no healthy env runners produced samples")
+        advs, targets, returns = [], [], []
+        for f in fragments:
+            a, vt = compute_gae(
+                f["rewards"], f["values"], f["dones"], f["last_value"],
+                gamma=self.config.gamma, lam=self.config.lam)
+            advs.append(a)
+            targets.append(vt)
+            returns.extend(f["episode_returns"])
+        batch = {
+            "obs": np.concatenate([f["obs"] for f in fragments]),
+            "actions": np.concatenate([f["actions"] for f in fragments]),
+            "logp_old": np.concatenate([f["logp"] for f in fragments]),
+            "advantages": np.concatenate(advs),
+            "value_targets": np.concatenate(targets),
+        }
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        metrics = self.learner.update(batch)
+        self._weights_version += 1
+        self._return_window.extend(returns)
+        return {
+            "env_runners": {
+                "episode_return_mean": self.episode_return_mean(),
+                "num_episodes": len(returns),
+                "num_env_steps_sampled": sum(
+                    len(f["obs"]) for f in fragments),
+                "num_healthy_workers":
+                    self.env_runner_group.num_healthy_actors(),
+            },
+            "learners": {"default_policy": metrics},
+        }
+
+
+@dataclasses.dataclass
+class PPOConfig(AlgorithmConfig):
+    lam: float = 0.95
+    clip: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    algo_class = PPO
+
+
+def _probe_env(env_spec) -> Dict[str, int]:
+    from ray_tpu.rl.envs import make_env
+
+    env = make_env(env_spec)
+    obs, _ = env.reset(seed=0)
+    num_actions = getattr(env, "num_actions", None)
+    if num_actions is None:
+        space = getattr(env, "action_space", None)
+        num_actions = int(getattr(space, "n"))
+    return {"obs_size": int(np.asarray(obs).size),
+            "num_actions": int(num_actions)}

@@ -1,0 +1,79 @@
+"""EnvRunner: rollout actor (reference
+``rllib/env/single_agent_env_runner.py:68``, ``sample:147``).
+
+Numpy-only process: steps its env with the inference copy of the policy,
+keeps env state across sample() calls (truncation-free stitching), returns
+fixed-size rollout fragments plus completed-episode returns for metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rl.envs import make_env
+from ray_tpu.rl.module import Params, np_sample_action
+
+
+class EnvRunner:
+    def __init__(self, env_spec: Union[str, Any] = "CartPole-v1",
+                 seed: int = 0, worker_index: int = 0):
+        self.env = make_env(env_spec, seed=seed + worker_index)
+        self._rng = np.random.default_rng(seed * 100003 + worker_index)
+        self._params: Optional[Params] = None
+        self._obs, _ = self.env.reset(seed=seed + worker_index)
+        self._episode_return = 0.0
+        self._weights_version = -1
+
+    def ping(self) -> bool:
+        return True
+
+    def set_weights(self, params: Params, version: int = 0) -> bool:
+        self._params = params
+        self._weights_version = version
+        return True
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        assert self._params is not None, "set_weights first"
+        obs_buf = np.empty((num_steps,) + self._obs.shape, np.float32)
+        act_buf = np.empty(num_steps, np.int32)
+        rew_buf = np.empty(num_steps, np.float32)
+        done_buf = np.empty(num_steps, np.bool_)      # episode boundary
+        logp_buf = np.empty(num_steps, np.float32)
+        val_buf = np.empty(num_steps, np.float32)
+        episode_returns = []
+
+        for t in range(num_steps):
+            action, logp, value = np_sample_action(
+                self._params, self._obs, self._rng)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = logp
+            val_buf[t] = value
+            self._obs, reward, terminated, truncated, _ = self.env.step(
+                action)
+            rew_buf[t] = reward
+            # Truncation treated as termination for GAE (standard
+            # simplification: no next-state bootstrap at the cut).
+            done_buf[t] = terminated or truncated
+            self._episode_return += reward
+            if terminated or truncated:
+                episode_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+
+        # Bootstrap value for the (possibly mid-episode) final state.
+        from ray_tpu.rl.module import np_forward
+
+        _, last_val = np_forward(self._params, self._obs[None])
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+            "last_value": float(last_val[0]),
+            "episode_returns": episode_returns,
+            "weights_version": self._weights_version,
+        }

@@ -1,0 +1,85 @@
+"""Built-in environments (gymnasium API shape: reset → (obs, info),
+step → (obs, reward, terminated, truncated, info)).
+
+The reference depends on external gym; this image has none, and rollout
+workers shouldn't need an accelerator runtime anyway — these are pure
+numpy. ``make_env`` also accepts any user callable returning an object
+with the same API, so external gymnasium envs plug straight in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+
+class CartPoleEnv:
+    """Classic cart-pole (dynamics per Barto-Sutton-Anderson / gym
+    CartPole-v1: termination at |x|>2.4, |θ|>12°, truncation at 500)."""
+
+    observation_size = 4
+    num_actions = 2
+    max_episode_steps = 500
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * np.pi / 180
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4)
+        self._steps = 0
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        return self._state.astype(np.float32).copy(), {}
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        temp = (force + pole_ml * theta_dot**2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN
+            * (4.0 / 3.0 - self.POLE_MASS * cos_t**2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        self._state = np.array([
+            x + self.DT * x_dot,
+            x_dot + self.DT * x_acc,
+            theta + self.DT * theta_dot,
+            theta_dot + self.DT * theta_acc,
+        ])
+        self._steps += 1
+        terminated = bool(abs(self._state[0]) > self.X_LIMIT
+                          or abs(self._state[2]) > self.THETA_LIMIT)
+        truncated = self._steps >= self.max_episode_steps
+        return (self._state.astype(np.float32).copy(), 1.0, terminated,
+                truncated, {})
+
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {
+    "CartPole-v1": CartPoleEnv,
+}
+
+
+def register_env(name: str, factory: Callable[..., Any]) -> None:
+    _REGISTRY[name] = factory
+
+
+def make_env(spec: Union[str, Callable[..., Any]], seed: Optional[int] = None):
+    factory = _REGISTRY[spec] if isinstance(spec, str) else spec
+    try:
+        return factory(seed=seed)
+    except TypeError:
+        return factory()

@@ -1,0 +1,84 @@
+"""RLModule equivalent: policy + value MLPs with twin implementations.
+
+Reference: ``rllib/core/rl_module/`` — one module definition used in two
+roles: inference-only copies on env runners, trainable copy on learners.
+TPU twist: the trainable copy is pure-JAX (pjit-able); the inference copy
+is pure numpy so rollout workers never load an accelerator runtime. Both
+share one param pytree (dict of numpy arrays at the boundary).
+
+Policy and value are separate towers (no shared trunk): the value
+regression's large early losses otherwise dominate the shared features and
+stall policy learning at this scale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+Params = Dict[str, np.ndarray]
+
+
+def init_policy_params(obs_size: int, num_actions: int,
+                       hidden: Tuple[int, ...] = (64, 64),
+                       seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    params: Params = {}
+    sizes = (obs_size,) + hidden
+
+    def dense(name, fan_in, fan_out, scale):
+        params[f"{name}_w"] = (rng.standard_normal((fan_in, fan_out))
+                               * scale).astype(np.float32)
+        params[f"{name}_b"] = np.zeros(fan_out, np.float32)
+
+    for tower in ("p", "v"):
+        for i in range(len(hidden)):
+            dense(f"{tower}{i}", sizes[i], sizes[i + 1],
+                  np.sqrt(2.0 / sizes[i]))
+    # small-init policy head → near-uniform initial policy
+    dense("pi", sizes[-1], num_actions, 0.01)
+    dense("vh", sizes[-1], 1, np.sqrt(1.0 / sizes[-1]))
+    return params
+
+
+def _n_hidden(params) -> int:
+    n = 0
+    while f"p{n}_w" in params:
+        n += 1
+    return n
+
+
+def np_forward(params: Params, obs: np.ndarray):
+    """(B, obs) → (logits (B, A), value (B,)). Pure numpy (env runners)."""
+    x = v = obs
+    for i in range(_n_hidden(params)):
+        x = np.tanh(x @ params[f"p{i}_w"] + params[f"p{i}_b"])
+        v = np.tanh(v @ params[f"v{i}_w"] + params[f"v{i}_b"])
+    logits = x @ params["pi_w"] + params["pi_b"]
+    value = (v @ params["vh_w"] + params["vh_b"])[:, 0]
+    return logits, value
+
+
+def jax_forward(params, obs):
+    """Same network in jnp (learners); params may be jax arrays."""
+    import jax.numpy as jnp
+
+    x = v = obs
+    for i in range(_n_hidden(params)):
+        x = jnp.tanh(x @ params[f"p{i}_w"] + params[f"p{i}_b"])
+        v = jnp.tanh(v @ params[f"v{i}_w"] + params[f"v{i}_b"])
+    logits = x @ params["pi_w"] + params["pi_b"]
+    value = (v @ params["vh_w"] + params["vh_b"])[:, 0]
+    return logits, value
+
+
+def np_sample_action(params: Params, obs: np.ndarray,
+                     rng: np.random.Generator):
+    """Single-obs categorical sample → (action, logp, value)."""
+    logits, value = np_forward(params, obs[None])
+    logits = logits[0] - logits[0].max()
+    p = np.exp(logits)
+    p /= p.sum()
+    action = int(rng.choice(len(p), p=p))
+    return action, float(np.log(p[action] + 1e-20)), float(value[0])

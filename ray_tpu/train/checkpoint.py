@@ -127,10 +127,15 @@ class CheckpointManager:
         keep = self.config.num_to_keep
         if keep is None or len(self._tracked) <= keep:
             return
-        self._tracked.sort(key=self._score, reverse=True)
-        for t in self._tracked[keep:]:
+        # The most recent checkpoint is always protected from score-based
+        # pruning (as in the reference): it is the resume point.
+        newest = max(self._tracked, key=lambda t: t.index)
+        rest = sorted((t for t in self._tracked if t is not newest),
+                      key=self._score, reverse=True)
+        kept = [newest] + rest[:keep - 1]
+        for t in rest[keep - 1:]:
             shutil.rmtree(t.checkpoint.path, ignore_errors=True)
-        self._tracked = self._tracked[:keep]
+        self._tracked = kept
 
     @property
     def latest(self) -> Optional[Checkpoint]:

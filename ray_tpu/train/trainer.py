@@ -110,11 +110,13 @@ class JaxTrainer:
                                 self.scaling.bundle(),
                                 self.scaling.placement_strategy)
             resume = manager.latest or self.resume_from
-            group.start(experiment_name=name, storage_path=storage,
-                        train_fn=self.train_fn, config=self.config,
-                        resume_from_path=resume.path if resume else None)
             error = None
             try:
+                # start() inside the try: a scheduling failure must still
+                # release the placement group + any created actors.
+                group.start(experiment_name=name, storage_path=storage,
+                            train_fn=self.train_fn, config=self.config,
+                            resume_from_path=resume.path if resume else None)
                 error, last_metrics = self._poll_until_done(
                     group, manager, last_metrics, deadline)
             finally:

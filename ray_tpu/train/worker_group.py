@@ -74,9 +74,12 @@ class TrainWorker:
         return True
 
     def poll(self) -> Dict[str, Any]:
+        # Status BEFORE draining: a 'finished' status then guarantees every
+        # report (train_fn pushes before the thread flips the status) was
+        # included in this drain — no lost final checkpoint.
+        status, error = self._status, self._error
         reports = self._ctx._drain_reports() if self._ctx else []
-        return {"status": self._status, "error": self._error,
-                "reports": reports}
+        return {"status": status, "error": error, "reports": reports}
 
     def stop(self) -> bool:
         if self._ctx is not None:

@@ -1,0 +1,143 @@
+"""RL stack tests: env dynamics, GAE, fault-tolerant fleet, PPO learning."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.envs import CartPoleEnv
+from ray_tpu.rl.learner import compute_gae
+from ray_tpu.rl.module import init_policy_params, jax_forward, np_forward
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestEnv:
+    def test_cartpole_api(self):
+        env = CartPoleEnv(seed=0)
+        obs, info = env.reset()
+        assert obs.shape == (4,)
+        obs2, r, term, trunc, _ = env.step(1)
+        assert r == 1.0 and not term and not trunc
+        assert not np.allclose(obs, obs2)
+
+    def test_cartpole_terminates_on_bad_policy(self):
+        env = CartPoleEnv(seed=0)
+        env.reset()
+        done = False
+        for _ in range(200):
+            _, _, term, trunc, _ = env.step(1)  # constant push falls over
+            if term:
+                done = True
+                break
+        assert done
+
+    def test_seeding_deterministic(self):
+        a, _ = CartPoleEnv(seed=7).reset()
+        b, _ = CartPoleEnv(seed=7).reset()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestModule:
+    def test_np_jax_forward_agree(self):
+        params = init_policy_params(4, 2, seed=3)
+        obs = np.random.default_rng(0).standard_normal((5, 4)).astype(
+            np.float32)
+        np_logits, np_v = np_forward(params, obs)
+        jx_logits, jx_v = jax_forward(params, obs)
+        np.testing.assert_allclose(np_logits, np.asarray(jx_logits),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np_v, np.asarray(jx_v), rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestGAE:
+    def test_single_step_episode(self):
+        adv, vt = compute_gae(
+            rewards=np.array([1.0], np.float32),
+            values=np.array([0.5], np.float32),
+            dones=np.array([True]), last_value=99.0, gamma=0.9, lam=0.8)
+        # terminal: delta = 1 - 0.5, no bootstrap from last_value
+        np.testing.assert_allclose(adv, [0.5])
+        np.testing.assert_allclose(vt, [1.0])
+
+    def test_bootstrap_on_fragment_cut(self):
+        adv, _ = compute_gae(
+            rewards=np.array([0.0], np.float32),
+            values=np.array([0.0], np.float32),
+            dones=np.array([False]), last_value=2.0, gamma=0.5, lam=1.0)
+        np.testing.assert_allclose(adv, [1.0])  # gamma * last_value
+
+    def test_no_leak_across_episode_boundary(self):
+        # episode ends at t=0; t=0's advantage must ignore t=1's value
+        adv, _ = compute_gae(
+            rewards=np.array([1.0, 0.0], np.float32),
+            values=np.array([0.0, 100.0], np.float32),
+            dones=np.array([True, False]), last_value=0.0,
+            gamma=0.99, lam=0.95)
+        np.testing.assert_allclose(adv[0], 1.0)
+
+
+class TestFaultTolerantActorManager:
+    def test_fanout_and_failure_isolation(self, rt):
+        from ray_tpu.rl.actor_manager import FaultTolerantActorManager
+
+        @ray_tpu.remote
+        class W:
+            def __init__(self, i):
+                self.i = i
+
+            def ping(self):
+                return True
+
+            def work(self, x):
+                return self.i * x
+
+            def die(self):
+                import os
+
+                os._exit(1)
+
+        actors = [W.remote(i) for i in range(3)]
+        mgr = FaultTolerantActorManager(actors)
+        out = mgr.foreach_actor(lambda a: a.work.remote(10))
+        assert [r.get() for r in out] == [0, 10, 20]
+
+        actors[1].die.remote()
+        import time
+
+        time.sleep(0.5)
+        out = mgr.foreach_actor(lambda a: a.work.remote(10),
+                                timeout_seconds=5.0)
+        ok = [r for r in out if r.ok]
+        bad = [r for r in out if not r.ok]
+        assert len(bad) == 1 and bad[0].actor_index == 1
+        assert sorted(r.value for r in ok) == [0, 20]
+        assert mgr.num_healthy_actors() == 2
+
+
+class TestPPO:
+    def test_ppo_smoke_and_learning(self, rt):
+        from ray_tpu.rl import PPOConfig
+
+        algo = (PPOConfig(seed=1, hidden=(32, 32),
+                          rollout_fragment_length=512,
+                          num_epochs=6, minibatch_size=256, lr=1e-3)
+                .environment("CartPole-v1")
+                .env_runners(2)
+                .build())
+        first = algo.train()
+        assert first["env_runners"]["num_env_steps_sampled"] == 1024
+        early = first["env_runners"]["episode_return_mean"]
+        for _ in range(11):
+            result = algo.train()
+        final = result["env_runners"]["episode_return_mean"]
+        algo.stop()
+        # untrained CartPole hovers ~20-30 return; PPO should clearly learn
+        assert final > max(2 * early, 60.0), (early, final)
+        assert result["learners"]["default_policy"]["total_loss"] == pytest.approx(
+            result["learners"]["default_policy"]["total_loss"])
