@@ -32,6 +32,7 @@ class MemoryStore:
         self._entries: Dict[ObjectID, Entry] = {}
         self._cv = threading.Condition()
         self._bytes_used = 0
+        self._done_callbacks: Dict[ObjectID, list] = {}
 
     def put(self, object_id: ObjectID, value: Optional[bytes] = None,
             error: Optional[bytes] = None,
@@ -48,7 +49,26 @@ class MemoryStore:
             self._entries[object_id] = Entry(
                 value=value, error=error, location=location, is_ready=True, size=size)
             self._bytes_used += size
+            callbacks = self._done_callbacks.pop(object_id, [])
             self._cv.notify_all()
+        for cb in callbacks:  # outside the lock: callbacks may re-enter
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observer errors stay local
+                pass
+
+    def add_done_callback(self, object_id: ObjectID, callback) -> None:
+        """Invoke ``callback()`` once the entry becomes ready (immediately
+        if it already is). Used by routing layers for load accounting."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.is_ready:
+                self._done_callbacks.setdefault(object_id, []).append(callback)
+                return
+        try:
+            callback()
+        except Exception:  # noqa: BLE001
+            pass
 
     def mark_pending(self, object_id: ObjectID) -> None:
         with self._cv:
@@ -95,6 +115,8 @@ class MemoryStore:
                 e = self._entries.pop(oid, None)
                 if e is not None:
                     self._bytes_used -= e.size
+                # a freed-before-ready object will never fire its callbacks
+                self._done_callbacks.pop(oid, None)
 
     def stats(self) -> dict:
         with self._cv:

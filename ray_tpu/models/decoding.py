@@ -1,0 +1,220 @@
+"""KV-cache inference: slot-based prefill/decode for continuous batching.
+
+Net-new vs the reference (which delegates LLM inference to vLLM —
+``python/ray/llm/_internal/serve/deployments/llm/vllm/``). TPU-first
+design choices:
+
+- **Fixed shapes**: the cache is a (layers, slots, max_seq, kv_heads, hd)
+  ring of slots; prefill and decode are jitted once per (bucketed) shape —
+  no dynamic shapes, no recompiles in steady state.
+- **Slot model**: each active request owns one batch row ("slot") with its
+  own length counter; the decode step advances ALL active slots one token
+  (Orca-style continuous batching; the engine in
+  ``ray_tpu.serve.llm`` admits/evicts slots between steps).
+- **Functional cache**: jitted steps take and return the cache arrays
+  (donated), so XLA updates them in place on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, Params
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(config: LlamaConfig, num_slots: int,
+               max_seq: Optional[int] = None, dtype=None) -> Cache:
+    c = config
+    S = max_seq or c.max_seq
+    dt = dtype or c.dtype
+    shape = (c.n_layers, num_slots, S, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "length": jnp.zeros((num_slots,), jnp.int32),
+    }
+
+
+def _attend_cached(q, k_cache, v_cache, lengths, scale):
+    """q: (B, 1, H, D) new-token queries; k/v_cache: (B, S, KV, D);
+    lengths: (B,) valid prefix per slot (incl. the new token)."""
+    B, _, H, D = q.shape
+    KV = k_cache.shape[2]
+    group = H // KV
+    qf = q.astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    if group > 1:
+        kf = jnp.repeat(kf, group, axis=2)
+        vf = jnp.repeat(vf, group, axis=2)
+    s = jnp.einsum("bqhd,bshd->bhqs", qf, kf) * scale     # (B,H,1,S)
+    mask = (jnp.arange(s.shape[-1])[None, :] < lengths[:, None])
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqs,bshd->bqhd", p, vf)
+    return out.astype(q.dtype)
+
+
+def _decode_block(x, layer, k_cache, v_cache, lengths, cos, sin,
+                  config: LlamaConfig):
+    """One transformer block for one new token per slot, updating cache.
+
+    x: (B, 1, E); k/v_cache: (B, S, KV, D); lengths: (B,) count BEFORE
+    this token. Returns (x, new_k_cache, new_v_cache).
+    """
+    c = config
+    h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+    positions = lengths[:, None]                           # (B, 1)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+
+    # write new k/v at each slot's current length
+    slot_ids = jnp.arange(x.shape[0])
+    k_cache = k_cache.at[slot_ids, lengths].set(k[:, 0])
+    v_cache = v_cache.at[slot_ids, lengths].set(v[:, 0])
+
+    out = _attend_cached(q, k_cache, v_cache, lengths + 1,
+                         c.head_dim ** -0.5)
+    x = x + jnp.einsum("bshd,hde->bse", out,
+                       layer["wo"].astype(x.dtype))
+    h = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+    g = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(h.dtype))
+    u = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(h.dtype))
+    x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                       layer["w_down"].astype(h.dtype))
+    return x, k_cache, v_cache
+
+
+def make_decode_step(params: Params, config: LlamaConfig):
+    """Build the jitted one-token-for-all-slots decode step.
+
+    step(cache, tokens (B,) int32, active (B,) bool) →
+        (cache, logits (B, vocab) f32)
+    Inactive slots pass through untouched (their length doesn't advance).
+    """
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def step(cache: Cache, tokens: jax.Array, active: jax.Array):
+        lengths = cache["length"]
+        x = params["embed"].astype(c.dtype)[tokens][:, None, :]  # (B,1,E)
+
+        def body(x, scanned):
+            layer, kc, vc = scanned
+            x, kc, vc = _decode_block(x, layer, kc, vc, lengths, cos, sin, c)
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = jnp.einsum("be,ev->bv", x[:, 0].astype(jnp.float32),
+                            head.astype(jnp.float32))
+        # only active slots advance / keep their writes
+        keep = active[:, None, None, None]
+        new_k = jnp.where(keep[None], new_k, cache["k"])
+        new_v = jnp.where(keep[None], new_v, cache["v"])
+        new_len = jnp.where(active, lengths + 1, lengths)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_prefill(params: Params, config: LlamaConfig):
+    """Build the jitted single-slot prefill.
+
+    prefill(cache, tokens (1, P) padded, true_len, slot) →
+        (cache, last_logits (vocab,) f32)
+    Jitted per padded length P (bucket prompt lengths to limit compiles).
+    """
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def prefill(cache: Cache, tokens: jax.Array, true_len: jax.Array,
+                slot: jax.Array, pad_len: int):
+        x = params["embed"].astype(c.dtype)[tokens]          # (1, P, E)
+        positions = jnp.arange(pad_len)[None, :]
+        mask_valid = positions[0] < true_len                 # (P,)
+
+        def body(x, scanned):
+            layer, kc_all, vc_all = scanned                  # (slots, S, …)
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            # causal attention within the prompt
+            from ray_tpu.ops.attention import mha_reference
+
+            out = mha_reference(q, k, v, causal=True)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            # write prompt k/v into this slot's cache rows [0, P)
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, jnp.where(mask_valid[None, :, None, None], k,
+                                  0.0).astype(kc_all.dtype),
+                (slot, 0, 0, 0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, jnp.where(mask_valid[None, :, None, None], v,
+                                  0.0).astype(vc_all.dtype),
+                (slot, 0, 0, 0))
+            return x, (kc_all, vc_all)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        last = x[0, jnp.maximum(true_len - 1, 0)]
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = (last.astype(jnp.float32) @ head.astype(jnp.float32))
+        new_len = cache["length"].at[slot].set(true_len)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    def call(cache, tokens, true_len, slot):
+        pad_len = tokens.shape[1]
+        return prefill(cache, tokens, jnp.asarray(true_len, jnp.int32),
+                       jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
+def pad_to_bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048)) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + 511) // 512) * 512
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0        # 0 → greedy
+    eos_token: Optional[int] = None
+
+
+def sample_token(logits, temperature: float, key) -> Tuple[jax.Array, any]:
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    key, sub = jax.random.split(key)
+    tok = jax.random.categorical(sub, logits / temperature, axis=-1)
+    return tok.astype(jnp.int32), key

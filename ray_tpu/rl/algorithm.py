@@ -148,7 +148,7 @@ class PPO(Algorithm):
         batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         metrics = self.learner.update(batch)
         self._weights_version += 1
-        self._return_window.extend(returns)
+        self._return_window = (self._return_window + returns)[-100:]
         return {
             "env_runners": {
                 "episode_return_mean": self.episode_return_mean(),

@@ -1,0 +1,21 @@
+"""Serve library — model serving on TPU replicas.
+
+Reference architecture (SURVEY.md §3.6, reference ``python/ray/serve/``):
+a controller reconciles target application/deployment state into replica
+actors; handles route requests with power-of-two-choices; replicas
+autoscale on queue metrics; ``@serve.batch`` coalesces requests. TPU
+divergence: replicas pin TPU chips and the LLM path
+(:mod:`ray_tpu.serve.llm`) does continuous batching over a compiled
+decode step instead of delegating to vLLM.
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401

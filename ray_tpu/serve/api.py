@@ -1,0 +1,97 @@
+"""Serve public API (reference ``python/ray/serve/api.py``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, make_deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+deployment = make_deployment
+
+_lock = threading.Lock()
+_controller = None
+
+
+def _get_or_create_controller():
+    global _controller
+    import ray_tpu
+
+    with _lock:
+        if _controller is not None:
+            return _controller
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 — not started yet
+            remote_cls = ray_tpu.remote(ServeController)
+            _controller = remote_cls.options(
+                name=CONTROLLER_NAME, max_concurrency=16).remote()
+        return _controller
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        blocking: bool = False, wait_timeout_s: float = 60.0
+        ) -> DeploymentHandle:
+    """Deploy an application; returns its handle
+    (reference ``serve.run``)."""
+    import time
+
+    import cloudpickle
+
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    dep = app.deployment
+    app_name = name or dep.name
+    ray_tpu.get([controller.deploy.remote(
+        app_name, cloudpickle.dumps(dep),
+        cloudpickle.dumps(dep.func_or_class),
+        app.init_args, app.init_kwargs)])
+    handle = DeploymentHandle(app_name, controller)
+    # wait for at least one replica
+    deadline = time.monotonic() + wait_timeout_s
+    while True:
+        _, replicas, _ = ray_tpu.get(
+            [controller.get_replicas.remote(app_name)])[0]
+        if replicas:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no replica of {app_name!r} became ready")
+        time.sleep(0.1)
+    if blocking:  # pragma: no cover — interactive use
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_or_create_controller())
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    return ray_tpu.get([_get_or_create_controller().status.remote()])[0]
+
+
+def delete(name: str):
+    import ray_tpu
+
+    ray_tpu.get([_get_or_create_controller().delete_app.remote(name)])
+
+
+def shutdown():
+    global _controller
+    import ray_tpu
+
+    with _lock:
+        if _controller is None:
+            return
+        try:
+            ray_tpu.get([_controller.shutdown.remote()], timeout=30.0)
+            ray_tpu.kill(_controller)
+        except Exception:  # noqa: BLE001 — cluster may already be down
+            pass
+        _controller = None

@@ -1,0 +1,219 @@
+"""Serve controller + replica harness.
+
+Reference: ``python/ray/serve/_private/controller.py:90`` (ServeController
+actor), ``deployment_state.py`` (replica FSM reconciliation),
+``autoscaling_state.py`` (queue-metric autoscaling). One actor owns target
+state; a reconcile thread converges actual replica actors to target and
+autoscales between min/max replicas on observed ongoing-request load.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class Replica:
+    """Replica harness actor: wraps the user callable, tracks load
+    (reference ``python/ray/serve/_private/replica.py``)."""
+
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self._user = cls(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def ping(self) -> bool:
+        return True
+
+    def get_metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {"ongoing": float(self._ongoing),
+                    "total": float(self._total)}
+
+    def handle_request(self, method: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (self._user if method == "__call__"
+                      else getattr(self._user, method))
+            if method == "__call__" and not callable(self._user):
+                raise TypeError("deployment class is not callable")
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+
+class ServeController:
+    """Target-state reconciler (runs as a detached-ish named actor)."""
+
+    RECONCILE_INTERVAL_S = 0.25
+
+    def __init__(self):
+        # name -> {"deployment": Deployment, "blob": bytes, "args", "kwargs",
+        #          "replicas": [handles], "target": int}
+        self._apps: Dict[str, dict] = {}
+        self._lock = threading.RLock()
+        self._version = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True, name="serve-reconcile")
+        self._thread.start()
+
+    # ------------------------------------------------------------- deploy
+    def deploy(self, name: str, deployment_blob: bytes, cls_blob: bytes,
+               init_args, init_kwargs) -> bool:
+        import cloudpickle
+
+        import ray_tpu
+
+        dep = cloudpickle.loads(deployment_blob)
+        with self._lock:
+            prev = self._apps.get(name)
+            self._apps[name] = {
+                "deployment": dep,
+                "cls_blob": cls_blob,
+                "args": init_args,
+                "kwargs": init_kwargs,
+                # Redeploy REPLACES replicas: old ones run old code.
+                "replicas": [],
+                "target": (dep.autoscaling_config.min_replicas
+                           if dep.autoscaling_config else dep.num_replicas),
+            }
+            self._version += 1
+        if prev:
+            for r in prev["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
+
+    def delete_app(self, name: str) -> bool:
+        import ray_tpu
+
+        with self._lock:
+            app = self._apps.pop(name, None)
+            self._version += 1
+        if app:
+            for r in app["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        for name in list(self._apps):
+            self.delete_app(name)
+        return True
+
+    # ------------------------------------------------------------- queries
+    def get_replicas(self, name: str):
+        """(version, replica handles, max_ongoing) for handle routing."""
+        with self._lock:
+            app = self._apps.get(name)
+            if app is None:
+                raise KeyError(f"no deployment named {name!r}")
+            return (self._version, list(app["replicas"]),
+                    app["deployment"].max_ongoing_requests)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": app["target"],
+                    "running_replicas": len(app["replicas"]),
+                    "autoscaling": app["deployment"].autoscaling_config
+                    is not None,
+                }
+                for name, app in self._apps.items()
+            }
+
+    # ----------------------------------------------------------- reconcile
+    def _reconcile_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.error("reconcile error:\n%s", traceback.format_exc())
+            self._stop.wait(self.RECONCILE_INTERVAL_S)
+
+    def _reconcile_once(self):
+        import ray_tpu
+
+        with self._lock:
+            apps = list(self._apps.items())
+        for name, app in apps:
+            dep = app["deployment"]
+            # health check + prune dead replicas
+            alive = []
+            for r in app["replicas"]:
+                try:
+                    ray_tpu.get([r.ping.remote()], timeout=5.0)
+                    alive.append(r)
+                except Exception:  # noqa: BLE001 — replica died
+                    logger.warning("replica of %s died; will replace", name)
+            changed = len(alive) != len(app["replicas"])
+
+            if dep.autoscaling_config is not None and alive:
+                app["target"] = self._autoscale_target(dep, alive,
+                                                       app["target"])
+
+            while len(alive) < app["target"]:
+                alive.append(self._start_replica(name, app))
+                changed = True
+            while len(alive) > app["target"]:
+                victim = alive.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:  # noqa: BLE001
+                    pass
+                changed = True
+            with self._lock:
+                if name in self._apps:
+                    self._apps[name]["replicas"] = alive
+                    if changed:
+                        self._version += 1
+
+    def _start_replica(self, name: str, app: dict):
+        import ray_tpu
+
+        dep = app["deployment"]
+        opts = dict(dep.ray_actor_options)
+        opts.setdefault("max_concurrency", dep.max_ongoing_requests)
+        remote_cls = ray_tpu.remote(Replica)
+        logger.info("starting replica of %s", name)
+        return remote_cls.options(**opts).remote(
+            app["cls_blob"], app["args"], app["kwargs"])
+
+    def _autoscale_target(self, dep, replicas: List[Any],
+                          current: int) -> int:
+        import ray_tpu
+
+        cfg = dep.autoscaling_config
+        try:
+            metrics = ray_tpu.get(
+                [r.get_metrics.remote() for r in replicas], timeout=5.0)
+        except Exception:  # noqa: BLE001 — skip this round
+            return current
+        ongoing = sum(m["ongoing"] for m in metrics)
+        per_replica = ongoing / max(len(replicas), 1)
+        if per_replica > cfg.target_ongoing_requests * cfg.upscale_threshold:
+            return min(current + 1, cfg.max_replicas)
+        if per_replica < cfg.target_ongoing_requests * cfg.downscale_threshold:
+            return max(current - 1, cfg.min_replicas)
+        return current

@@ -1,0 +1,122 @@
+"""DeploymentHandle: client-side router.
+
+Reference: ``python/ray/serve/handle.py:639`` (DeploymentHandle,
+``.remote():715``) + ``request_router/`` power-of-two-choices. The handle
+caches the replica set (version-stamped from the controller), picks the
+less-loaded of two random replicas by local outstanding counts, and
+returns an ObjectRef. ``options()`` clones share one router state so load
+accounting stays consistent across method handles.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List
+
+
+class _RouterState:
+    """Replica set + outstanding counts, shared by all handle clones."""
+
+    def __init__(self, deployment_name: str, controller):
+        self.name = deployment_name
+        self.controller = controller
+        self.lock = threading.Lock()
+        self.version = -1
+        self.replicas: List[Any] = []
+        self.outstanding: Dict[int, int] = {}
+        self.max_ongoing = 8
+        self.last_refresh = 0.0
+
+    REFRESH_INTERVAL_S = 1.0
+
+    def refresh(self, force: bool = False):
+        import ray_tpu
+
+        now = time.monotonic()
+        with self.lock:
+            fresh = (now - self.last_refresh < self.REFRESH_INTERVAL_S
+                     and self.replicas)
+        if not force and fresh:
+            return
+        version, replicas, max_ongoing = ray_tpu.get(
+            [self.controller.get_replicas.remote(self.name)])[0]
+        with self.lock:
+            if version != self.version:
+                self.version = version
+                self.replicas = replicas
+                self.outstanding = {i: 0 for i in range(len(replicas))}
+            self.max_ongoing = max_ongoing
+            self.last_refresh = now
+
+    def acquire_replica(self):
+        """Pick (power-of-two-choices) + increment under ONE lock hold;
+        returns (replica, index) or None if no replicas."""
+        with self.lock:
+            n = len(self.replicas)
+            if n == 0:
+                return None
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self.outstanding.get(a, 0) <= \
+                    self.outstanding.get(b, 0) else b
+            self.outstanding[idx] = self.outstanding.get(idx, 0) + 1
+            return self.replicas[idx], idx
+
+    def release(self, idx: int):
+        with self.lock:
+            self.outstanding[idx] = max(0, self.outstanding.get(idx, 1) - 1)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller,
+                 _state: _RouterState = None, _method: str = "__call__"):
+        self._state = _state or _RouterState(deployment_name, controller)
+        self._method = _method
+
+    @property
+    def _name(self):
+        return self._state.name
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        return DeploymentHandle(self._state.name, self._state.controller,
+                                _state=self._state, _method=method_name)
+
+    def remote(self, *args, **kwargs):
+        deadline = time.monotonic() + 30.0
+        acquired = None
+        while acquired is None:
+            self._state.refresh()
+            acquired = self._state.acquire_replica()
+            if acquired is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"deployment {self._name!r} has no running replicas")
+                time.sleep(0.1)
+                self._state.refresh(force=True)
+        replica, idx = acquired
+        try:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        except BaseException:
+            self._state.release(idx)
+            raise
+        self._attach_completion(ref, idx)
+        return ref
+
+    def _attach_completion(self, ref, idx: int):
+        """Decrement the outstanding count when the reply lands."""
+        state = self._state
+
+        def done():
+            state.release(idx)
+
+        try:
+            from ray_tpu.core_worker.worker import CoreWorker
+
+            cw = CoreWorker.current_or_raise()
+            cw.memory_store.add_done_callback(ref.object_id, done)
+        except Exception:  # noqa: BLE001 — degrade to time-based decay
+            threading.Timer(1.0, done).start()

@@ -1,0 +1,208 @@
+"""LLM serving: continuous batching on a TPU replica.
+
+Reference delegates this wholesale to vLLM
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``);
+here it's native: an Orca-style engine loop over the slot-based KV cache
+(:mod:`ray_tpu.models.decoding`) — admit waiting requests into free slots
+(bucketed prefill), then advance ALL active slots one token per jitted
+decode step. Batched decode keeps the MXU busy across requests; fixed
+shapes mean two compiled programs total (prefill per bucket + one decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: List[int]
+    max_tokens: int
+    temperature: float
+    eos_token: Optional[int]
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    output: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class LLMEngine:
+    """Single-replica continuous-batching engine."""
+
+    def __init__(self, config=None, params=None, *, num_slots: int = 8,
+                 max_seq: Optional[int] = None, model: str = "tiny",
+                 seed: int = 0):
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.models.decoding import (
+            init_cache, make_decode_step, make_prefill)
+
+        self.config = config or llama.CONFIGS[model]
+        if params is None:
+            params = llama.init_params(self.config, jax.random.key(seed))
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq = max_seq or self.config.max_seq
+        self._cache = init_cache(self.config, num_slots, self.max_seq)
+        self._decode = make_decode_step(params, self.config)
+        self._prefill = make_prefill(params, self.config)
+        self._key = jax.random.key(seed)
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._slots: List[Optional[_Request]] = [None] * num_slots
+        self._last_token = np.zeros(num_slots, np.int32)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+        self._steps = 0
+        self._tokens_generated = 0
+
+    # ------------------------------------------------------------- public
+    def generate(self, prompt: List[int], max_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_token: Optional[int] = None,
+                 timeout_s: float = 300.0) -> List[int]:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
+                f"exceeds max_seq {self.max_seq}")
+        req = _Request(list(prompt), max_tokens, temperature, eos_token)
+        self._queue.put(req)
+        if not req.done.wait(timeout_s):
+            raise TimeoutError("generation timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        return req.output
+
+    def stats(self) -> Dict[str, Any]:
+        return {"steps": self._steps,
+                "tokens_generated": self._tokens_generated,
+                "active_slots": sum(s is not None for s in self._slots),
+                "queued": self._queue.qsize()}
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- engine
+    def _admit(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import pad_to_bucket
+
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            # cap padding at max_seq: a prompt that fits must be admitted
+            P = min(pad_to_bucket(len(req.prompt)), self.max_seq)
+            tokens = np.zeros((1, P), np.int32)
+            tokens[0, :len(req.prompt)] = req.prompt
+            self._cache, logits = self._prefill(
+                self._cache, jnp.asarray(tokens), len(req.prompt), slot)
+            tok = self._sample(np.asarray(logits)[None], req.temperature)[0]
+            req.output.append(int(tok))
+            self._slots[slot] = req
+            self._last_token[slot] = tok
+            self._maybe_finish(slot)
+
+    def _sample(self, logits: np.ndarray, temperature: float) -> np.ndarray:
+        if temperature <= 0.0:
+            return logits.argmax(-1).astype(np.int32)
+        z = logits / max(temperature, 1e-5)
+        z = z - z.max(-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(-1, keepdims=True)
+        rng = np.random.default_rng(self._steps)
+        return np.array([rng.choice(p.shape[-1], p=row) for row in p],
+                        np.int32)
+
+    def _maybe_finish(self, slot: int):
+        req = self._slots[slot]
+        if req is None:
+            return
+        done = (len(req.output) >= req.max_tokens
+                or (req.eos_token is not None and req.output
+                    and req.output[-1] == req.eos_token)
+                or len(req.prompt) + len(req.output) >= self.max_seq)
+        if done:
+            req.done.set()
+            self._slots[slot] = None
+
+    def _loop(self):
+        import logging
+        import traceback
+
+        while not self._stop.is_set():
+            try:
+                self._loop_once()
+            except Exception as e:  # noqa: BLE001 — engine must survive
+                logging.getLogger(__name__).error(
+                    "engine step failed:\n%s", traceback.format_exc())
+                # fail every active request rather than hanging them
+                for slot in range(self.num_slots):
+                    req = self._slots[slot]
+                    if req is not None:
+                        req.error = f"engine step failed: {e!r}"
+                        req.done.set()
+                        self._slots[slot] = None
+
+    def _loop_once(self):
+        import jax.numpy as jnp
+
+        self._admit()
+        active = np.array([s is not None for s in self._slots])
+        if not active.any():
+            time.sleep(0.002)
+            return
+        self._cache, logits = self._decode(
+            self._cache, jnp.asarray(self._last_token),
+            jnp.asarray(active))
+        logits_np = np.asarray(logits)
+        self._steps += 1
+        for slot in range(self.num_slots):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            tok = self._sample(logits_np[slot][None], req.temperature)[0]
+            req.output.append(int(tok))
+            self._last_token[slot] = tok
+            self._tokens_generated += 1
+            self._maybe_finish(slot)
+
+
+class LLMServer:
+    """Serve deployment wrapper: one engine per replica.
+
+    Deploy with ``serve.deployment(LLMServer).options(
+    ray_actor_options={"num_tpus": N})``; requests are token-id lists
+    (tokenization is a host-side pre/post step, kept off the replica).
+    """
+
+    def __init__(self, model: str = "tiny", num_slots: int = 8,
+                 max_seq: Optional[int] = None, **engine_kwargs):
+        self.engine = LLMEngine(model=model, num_slots=num_slots,
+                                max_seq=max_seq, **engine_kwargs)
+
+    def __call__(self, prompt: List[int], max_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_token: Optional[int] = None) -> List[int]:
+        return self.engine.generate(prompt, max_tokens, temperature,
+                                    eos_token)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()

@@ -1,0 +1,145 @@
+"""Serve tests: deploy/route/batch/autoscale/failure-replace on a real
+local cluster."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestServeCore:
+    def test_deploy_and_route(self, rt):
+        @serve.deployment(num_replicas=2)
+        class Doubler:
+            def __call__(self, x):
+                return 2 * x
+
+        handle = serve.run(Doubler.bind())
+        out = rt.get([handle.remote(i) for i in range(10)])
+        assert out == [2 * i for i in range(10)]
+        st = serve.status()
+        assert st["Doubler"]["running_replicas"] == 2
+        serve.delete("Doubler")
+
+    def test_function_deployment_and_methods(self, rt):
+        @serve.deployment
+        def greet(name):
+            return f"hello {name}"
+
+        handle = serve.run(greet.bind(), name="greeter")
+        assert rt.get(handle.remote("tpu")) == "hello tpu"
+
+        @serve.deployment(name="calc")
+        class Calc:
+            def add(self, a, b):
+                return a + b
+
+            def __call__(self, x):
+                return x
+
+        h = serve.run(Calc.bind())
+        assert rt.get(h.options(method_name="add").remote(2, 3)) == 5
+        serve.delete("greeter")
+        serve.delete("calc")
+
+    def test_init_args_flow(self, rt):
+        @serve.deployment
+        class Scaled:
+            def __init__(self, k):
+                self.k = k
+
+            def __call__(self, x):
+                return self.k * x
+
+        handle = serve.run(Scaled.bind(7), name="scaled")
+        assert rt.get(handle.remote(6)) == 42
+        serve.delete("scaled")
+
+    def test_replica_death_replaced(self, rt):
+        @serve.deployment(num_replicas=1)
+        class Fragile:
+            def __call__(self, x):
+                return x + 1
+
+            def die(self):
+                import os
+
+                os._exit(1)
+
+        handle = serve.run(Fragile.bind(), name="fragile")
+        assert rt.get(handle.remote(1)) == 2
+        # kill the replica out-of-band
+        handle.options(method_name="die").remote()
+        time.sleep(1.5)  # reconcile interval + restart
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                assert rt.get(handle.remote(5), timeout=10) == 6
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        serve.delete("fragile")
+
+
+class TestBatching:
+    def test_batch_coalesces(self, rt):
+        @serve.deployment(max_ongoing_requests=16)
+        class Batched:
+            def __init__(self):
+                self.batch_sizes = []
+
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+            def __call__(self, items):
+                self.batch_sizes.append(len(items))
+                return [i * 10 for i in items]
+
+            def sizes(self):
+                return self.batch_sizes
+
+        handle = serve.run(Batched.bind(), name="batched")
+        refs = [handle.remote(i) for i in range(16)]
+        assert sorted(rt.get(refs)) == [i * 10 for i in range(16)]
+        sizes = rt.get(handle.options(method_name="sizes").remote())
+        assert max(sizes) > 1  # actually batched
+        assert sum(sizes) == 16
+        serve.delete("batched")
+
+
+class TestAutoscaling:
+    def test_scales_up_under_load(self, rt):
+        @serve.deployment(max_ongoing_requests=4,
+                          autoscaling_config={"min_replicas": 1,
+                                              "max_replicas": 3,
+                                              "target_ongoing_requests": 1.0})
+        class Slow:
+            def __call__(self, x):
+                time.sleep(0.4)
+                return x
+
+        handle = serve.run(Slow.bind(), name="slow")
+        assert serve.status()["slow"]["running_replicas"] == 1
+        # sustain load; autoscaler should add replicas
+        refs = []
+        deadline = time.monotonic() + 30
+        scaled = False
+        while time.monotonic() < deadline:
+            refs.extend(handle.remote(i) for i in range(6))
+            time.sleep(0.3)
+            if serve.status()["slow"]["running_replicas"] >= 2:
+                scaled = True
+                break
+        assert scaled, serve.status()
+        rt.get(refs)
+        serve.delete("slow")
