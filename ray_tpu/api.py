@@ -133,9 +133,13 @@ def shutdown() -> None:
                 pass
             cw.shutdown()
         if _head is not None:
+            node_id = _head["raylet"].node_id
             _head["raylet"].stop()
             _head["gcs"].stop()
             _head = None
+            from ray_tpu.object_store.shm import unlink as shm_unlink
+
+            shm_unlink(f"/rtshm_{node_id.hex()[:12]}")
 
 
 def is_initialized() -> bool:

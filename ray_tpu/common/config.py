@@ -145,6 +145,8 @@ _D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep"
 _D("testing_rpc_failure_seed", int, 0, "deterministic chaos seed")
 
 # --- TPU ---------------------------------------------------------------------
+_D("shm_store_enabled", bool, True, "node-local shared-memory object store")
+_D("shm_store_bytes", int, 256 * 1024 * 1024, "shm object store capacity")
 _D("tpu_chips_per_host", int, 4, "chips exposed per raylet when unprobed")
 _D("tpu_topology", str, "", "slice topology label, e.g. v5e-32")
 

@@ -141,8 +141,56 @@ class CoreWorker:
         self._actor_concurrency: Optional[threading.Semaphore] = None
         self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
 
+        self._shm = False  # False = not probed yet; None = unavailable
+        self._shm_probe_lock = threading.Lock()
+        self._task_events: list = []
+        self._task_events_lock = threading.Lock()
+        self._task_events_stop = threading.Event()
+        threading.Thread(target=self._task_event_flusher, daemon=True,
+                         name="task-event-flush").start()
         install_release_sink(self._on_ref_deleted)
         CoreWorker._current = self
+
+    def _task_event_flusher(self):
+        """Periodic flush so idle workers' buffered events still reach the
+        GCS (reference: task_event_buffer.cc periodic flush)."""
+        while not self._task_events_stop.wait(1.0):
+            if self._task_events:
+                self._flush_task_events()
+
+    @property
+    def shm(self):
+        """Node-local shared-memory object store (plasma equivalent, C++):
+        all workers on this node map the same segment — large objects move
+        between same-node processes with zero RPC and zero-copy reads."""
+        if self._shm is False:
+            with self._shm_probe_lock:
+                if self._shm is not False:  # lost the probe race
+                    return self._shm
+                probed = None
+                if GLOBAL_CONFIG.get("shm_store_enabled"):
+                    try:
+                        from ray_tpu.object_store.shm import ShmObjectStore
+
+                        probed = ShmObjectStore(
+                            f"/rtshm_{self.node_id.hex()[:12]}",
+                            capacity=GLOBAL_CONFIG.get("shm_store_bytes"))
+                    except Exception as e:  # noqa: BLE001 — degrade to RPC
+                        logger.warning("shm object store unavailable: %s", e)
+                self._shm = probed
+        return self._shm
+
+    def _shm_read(self, oid: ObjectID) -> Optional[bytes]:
+        store = self.shm
+        if store is None:
+            return None
+        view = store.get(oid.binary())
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            store.release(oid.binary())
 
     # ------------------------------------------------------------- contexts
     def current_task_id(self) -> TaskID:
@@ -232,6 +280,13 @@ class CoreWorker:
     async def _fetch_async(self, ref: ObjectRef, allow_reconstruct: bool = True) -> bytes:
         """Ask the owner for value-or-location; chase the location; on holder
         death ask the owner to reconstruct from lineage."""
+        # same-node shm fast path — off-loop (the first probe may compile
+        # the native lib, and big reads memcpy) and only if already probed
+        if self._shm not in (False, None):
+            blob = await asyncio.get_running_loop().run_in_executor(
+                None, self._shm_read, ref.object_id)
+            if blob is not None:
+                return blob
         owner = RetryableRpcClient(ref.owner_address, deadline_s=30.0)
         try:
             reply = await owner.call_async(
@@ -261,6 +316,15 @@ class CoreWorker:
             owner.close()
 
     def _fetch_from_location(self, ref: ObjectRef, location, timeout) -> bytes:
+        # same-node fast path: the holder also sealed it into the node's
+        # shm store — read it from shared pages, no RPC
+        blob = self._shm_read(ref.object_id)
+        if blob is not None:
+            return blob
+        return self._fetch_from_location_rpc(ref, location, timeout)
+
+    def _fetch_from_location_rpc(self, ref: ObjectRef, location,
+                                 timeout) -> bytes:
         """Owner-side blocking fetch of a large result held by the executor."""
         async def go():
             holder = RpcClient(tuple(location))
@@ -475,6 +539,8 @@ class CoreWorker:
             with self._lineage_lock:
                 self.lineage.pop(ref.object_id, None)
             self.memory_store.free([ref.object_id])
+            if self._shm not in (False, None):
+                self._shm.delete(ref.object_id.binary())
         elif getattr(ref, "_borrowed", False) and ref.owner_address is not None:
             # fire-and-forget decref to owner
             async def dec():
@@ -596,9 +662,45 @@ class CoreWorker:
 
     def _execute_task(self, task: TaskSpec) -> dict:
         """Runs on an executor thread."""
+        start = time.time()
         if task.is_actor_task():
-            return self._execute_actor_task(task)
-        return self._execute_fn_task(task)
+            reply = self._execute_actor_task(task)
+        else:
+            reply = self._execute_fn_task(task)
+        self._record_task_event(task, start, time.time(), reply)
+        return reply
+
+    def _record_task_event(self, task: TaskSpec, start: float, end: float,
+                           reply: dict):
+        """Buffer + batch-flush task events to the GCS task store
+        (reference: core_worker/task_event_buffer.cc → gcs_task_manager)."""
+        failed = any("error" in p for p in reply.get("results", {}).values())
+        event = {
+            "task_id": task.task_id.hex(),
+            "name": (task.actor_method_name if task.is_actor_task()
+                     else task.name) or "task",
+            "job_id": task.job_id.hex() if task.job_id else "",
+            "worker_id": self.worker_id.hex(),
+            "node_id": self.node_id.hex(),
+            "state": "FAILED" if failed else "FINISHED",
+            "start_ts": start,
+            "end_ts": end,
+            "actor_task": task.is_actor_task(),
+        }
+        # append only — the flusher thread owns the (blocking) GCS RPC, so
+        # the task critical path never waits on observability
+        with self._task_events_lock:
+            self._task_events.append(event)
+
+    def _flush_task_events(self):
+        with self._task_events_lock:
+            events, self._task_events = self._task_events, []
+        if not events:
+            return
+        try:
+            self.gcs.call("add_task_events", events=events)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
 
     def _execute_fn_task(self, task: TaskSpec) -> dict:
         self._ctx.task_id = task.task_id
@@ -719,6 +821,11 @@ class CoreWorker:
                 results[oid.binary()] = {"value": blob}
             else:
                 self.memory_store.put(oid, value=blob)
+                if self.shm is not None:
+                    try:
+                        self.shm.put(oid.binary(), blob)
+                    except OSError:  # store full → RPC path still works
+                        pass
                 results[oid.binary()] = {"location": self.server.address}
         return {"results": results}
 
@@ -735,6 +842,11 @@ class CoreWorker:
     def shutdown(self):
         CoreWorker._current = None
         install_release_sink(None)
+        self._task_events_stop.set()
+        try:
+            self._flush_task_events()
+        except Exception:  # noqa: BLE001
+            pass
         try:
             self.gcs.close()
         except Exception:  # noqa: BLE001

@@ -1,0 +1,19 @@
+"""Data library — distributed datasets (reference ``python/ray/data/``).
+
+Lazy plans over Arrow blocks; per-block transforms pipeline through
+ref-chaining (the owner/scheduler overlap stages automatically), barrier
+ops (shuffle/sort/repartition) materialize. ``iter_batches``/``split``
+are the training-ingest path feeding JaxTrainer workers.
+"""
+
+from ray_tpu.data.dataset import Dataset, GroupedDataset  # noqa: F401
+from ray_tpu.data.datasource import (  # noqa: F401
+    from_items,
+    from_numpy,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+    write_csv,
+    write_parquet,
+)

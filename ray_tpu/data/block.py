@@ -1,0 +1,52 @@
+"""Blocks: the unit of distributed data (reference ``python/ray/data/
+block.py`` — Arrow tables in the object store).
+
+A block is a ``pyarrow.Table``; helpers convert rows (list of dicts) and
+batches (dict of numpy arrays) at the operator boundary. Block *refs* flow
+through the plan; block payloads live in the object plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+import numpy as np
+import pyarrow as pa
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
+    if not rows:
+        return pa.table({})
+    return pa.Table.from_pylist(rows)
+
+
+def block_from_batch(batch: Dict[str, np.ndarray]) -> pa.Table:
+    return pa.table({k: pa.array(np.asarray(v)) for k, v in batch.items()})
+
+
+def block_to_rows(block: pa.Table) -> List[Dict[str, Any]]:
+    return block.to_pylist()
+
+
+def block_to_batch(block: pa.Table) -> Dict[str, np.ndarray]:
+    return {name: np.asarray(col.to_numpy(zero_copy_only=False))
+            for name, col in zip(block.column_names, block.columns)}
+
+
+def block_num_rows(block: pa.Table) -> int:
+    return block.num_rows
+
+
+def block_size_bytes(block: pa.Table) -> int:
+    return block.nbytes
+
+
+def concat_blocks(blocks: Iterable[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def slice_block(block: pa.Table, start: int, length: int) -> pa.Table:
+    return block.slice(start, length)

@@ -1,0 +1,402 @@
+"""Dataset: lazy logical plan over distributed blocks.
+
+Reference: ``python/ray/data/dataset.py`` (lazy plan → physical operators,
+``_internal/execution/streaming_executor.py`` pull-based streaming with
+backpressure). This executor gets pipelining from ownership/ref-chaining:
+each stage's task takes the upstream block *ref* as an argument, so
+stage k+1 of block i runs as soon as that block exists while block i+1 is
+still in stage k — no driver-side barriers. Driver-side backpressure caps
+how many block chains are in flight at once.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+# --------------------------------------------------------------- plan ops
+
+
+class _Op:
+    pass
+
+
+class _Read(_Op):
+    def __init__(self, read_tasks: List[Callable[[], Any]]):
+        self.read_tasks = read_tasks      # each returns a block
+
+
+class _FromRefs(_Op):
+    """Source op over already-materialized block refs (union/split)."""
+
+    def __init__(self, refs: List):
+        self.refs = refs
+
+
+class _MapBlock(_Op):
+    """Any block→block transform (map/map_batches/filter/flat_map)."""
+
+    def __init__(self, fn: Callable, name: str):
+        self.fn = fn
+        self.name = name
+
+
+class _AllToAll(_Op):
+    """Barrier op (repartition/shuffle/sort): needs all upstream blocks."""
+
+    def __init__(self, fn: Callable[[List], List], name: str):
+        self.fn = fn                      # List[block_ref] -> List[block]
+        self.name = name
+
+
+class Dataset:
+    """Lazy, immutable; every transform returns a new Dataset
+    (reference ``Dataset`` semantics)."""
+
+    def __init__(self, ops: List[_Op], max_inflight: int = 16):
+        self._ops = ops
+        self._max_inflight = max_inflight
+        self._cached_refs: Optional[List] = None
+
+    # ------------------------------------------------------------ lineage
+    def _with(self, op: _Op) -> "Dataset":
+        return Dataset(self._ops + [op], self._max_inflight)
+
+    # --------------------------------------------------------- transforms
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def do(block):
+            return B.block_from_rows([fn(r) for r in B.block_to_rows(block)])
+
+        return self._with(_MapBlock(do, "map"))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def do(block):
+            return B.block_from_rows(
+                [r for r in B.block_to_rows(block) if fn(r)])
+
+        return self._with(_MapBlock(do, "filter"))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def do(block):
+            out: List[Dict] = []
+            for r in B.block_to_rows(block):
+                out.extend(fn(r))
+            return B.block_from_rows(out)
+
+        return self._with(_MapBlock(do, "flat_map"))
+
+    def map_batches(self, fn: Callable[[Dict[str, np.ndarray]],
+                                       Dict[str, np.ndarray]],
+                    batch_size: Optional[int] = None,
+                    **unknown) -> "Dataset":
+        if unknown:
+            import warnings
+
+            warnings.warn(f"map_batches: ignoring unsupported options "
+                          f"{sorted(unknown)}", stacklevel=2)
+
+        def do(block):
+            batch = B.block_to_batch(block)
+            if not batch:
+                return block
+            n = len(next(iter(batch.values())))
+            size = batch_size or n
+            outs = []
+            for lo in builtins.range(0, n, size):
+                sub = {k: v[lo:lo + size] for k, v in batch.items()}
+                outs.append(B.block_from_batch(fn(sub)))
+            return B.concat_blocks(outs)
+
+        return self._with(_MapBlock(do, "map_batches"))
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]],
+                                                 np.ndarray]) -> "Dataset":
+        def do(batch):
+            batch = dict(batch)
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(do)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def do(blocks: List):
+            merged = B.concat_blocks(blocks)
+            n = merged.num_rows
+            if n == 0:
+                return [merged]
+            per = -(-n // num_blocks)
+            return [B.slice_block(merged, i * per, builtins.min(per, n - i * per))
+                    for i in range(num_blocks) if i * per < n]
+
+        return self._with(_AllToAll(do, "repartition"))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        def do(blocks: List):
+            merged = B.concat_blocks(blocks)
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(merged.num_rows)
+            import pyarrow as pa
+
+            return [merged.take(pa.array(perm))]
+
+        return self._with(_AllToAll(do, "random_shuffle"))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        def do(blocks: List):
+            merged = B.concat_blocks(blocks)
+            order = "descending" if descending else "ascending"
+            return [merged.sort_by([(key, order)])]
+
+        return self._with(_AllToAll(do, "sort"))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        # executes both sides; downstream transforms chain off the refs
+        refs = self._execute() + other._execute()
+        return Dataset([_FromRefs(refs)], self._max_inflight)
+
+    def limit(self, n: int) -> "Dataset":
+        def do(blocks: List):
+            out, taken = [], 0
+            for b in blocks:
+                if taken >= n:
+                    break
+                take = builtins.min(b.num_rows, n - taken)
+                out.append(B.slice_block(b, 0, take))
+                taken += take
+            return out or [B.block_from_rows([])]
+
+        return self._with(_AllToAll(do, "limit"))
+
+    # --------------------------------------------------------- execution
+    def _execute(self) -> List:
+        """Run the plan; returns block refs (cached — plans are
+        deterministic)."""
+        import ray_tpu
+
+        if self._cached_refs is not None:
+            return self._cached_refs
+
+        @ray_tpu.remote
+        def _run_read(task):
+            return task()
+
+        @ray_tpu.remote
+        def _run_map(fn, block):
+            return fn(block)
+
+        @ray_tpu.remote
+        def _run_all(fn, *blocks):
+            return fn(list(blocks))
+
+        refs: List = []
+        ops = self._ops
+        assert isinstance(ops[0], (_Read, _FromRefs))
+        if isinstance(ops[0], _FromRefs):
+            source_refs = list(ops[0].refs)
+            read = False
+        else:
+            source_refs = ops[0].read_tasks
+            read = True
+        pending_chains: List = []
+        for src in source_refs:
+            ref = _run_read.remote(src) if read else src
+            # chain per-block map stages immediately (streaming)
+            j = 1
+            while j < len(ops) and isinstance(ops[j], _MapBlock):
+                ref = _run_map.remote(ops[j].fn, ref)
+                j += 1
+            refs.append(ref)
+            pending_chains.append(ref)
+            if len(pending_chains) >= self._max_inflight:
+                ray_tpu.wait(pending_chains, num_returns=1, timeout=None)
+                pending_chains = [r for r in pending_chains
+                                  if not _is_ready(r)]
+        i = 1
+        while i < len(ops) and isinstance(ops[i], _MapBlock):
+            i += 1
+        # remaining ops: alternating barriers + map chains
+        while i < len(ops):
+            op = ops[i]
+            if isinstance(op, _AllToAll):
+                out = ray_tpu.get(
+                    [_run_all.remote(_wrap_list(op.fn), *refs)])[0]
+                # out is a list of blocks — re-put as individual refs
+                refs = [ray_tpu.put(b) for b in out]
+                i += 1
+            else:
+                while i < len(ops) and isinstance(ops[i], _MapBlock):
+                    refs = [_run_map.remote(ops[i].fn, ref) for ref in refs]
+                    i += 1
+        self._cached_refs = refs
+        return refs
+
+    # -------------------------------------------------------- consumption
+    def materialize(self) -> "Dataset":
+        self._execute()
+        return self
+
+    def take(self, n: int = 20) -> List[Dict]:
+        import ray_tpu
+
+        out: List[Dict] = []
+        for ref in self._execute():
+            block = ray_tpu.get([ref])[0]
+            out.extend(B.block_to_rows(block))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> List[Dict]:
+        import ray_tpu
+
+        out: List[Dict] = []
+        for ref in self._execute():
+            out.extend(B.block_to_rows(ray_tpu.get([ref])[0]))
+        return out
+
+    def count(self) -> int:
+        import ray_tpu
+
+        blocks = ray_tpu.get(self._execute())
+        return sum(B.block_num_rows(b) for b in blocks)
+
+    def schema(self) -> Optional[List[str]]:
+        import ray_tpu
+
+        for ref in self._execute():
+            block = ray_tpu.get([ref])[0]
+            if block.num_rows:
+                return block.column_names
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def size_bytes(self) -> int:
+        import ray_tpu
+
+        return sum(B.block_size_bytes(b)
+                   for b in ray_tpu.get(self._execute()))
+
+    # aggregations
+    def sum(self, on: str) -> float:
+        return float(builtins.sum(
+            b[on].sum() for b in self._batches() if on in b and len(b[on])))
+
+    def min(self, on: str) -> float:
+        return float(builtins.min(b[on].min() for b in self._batches()
+                                  if on in b and len(b[on])))
+
+    def max(self, on: str) -> float:
+        return float(builtins.max(b[on].max() for b in self._batches()
+                                  if on in b and len(b[on])))
+
+    def mean(self, on: str) -> float:
+        total, count = 0.0, 0
+        for b in self._batches():
+            if on in b and len(b[on]):
+                total += float(b[on].sum())
+                count += len(b[on])
+        return total / builtins.max(count, 1)
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        import ray_tpu
+
+        for ref in self._execute():
+            yield B.block_to_batch(ray_tpu.get([ref])[0])
+
+    def iter_rows(self) -> Iterator[Dict]:
+        import ray_tpu
+
+        for ref in self._execute():
+            yield from B.block_to_rows(ray_tpu.get([ref])[0])
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+        """Re-batch across block boundaries into fixed-size numpy dicts —
+        the training-ingest path (feeds JaxTrainer data loaders)."""
+        import ray_tpu
+
+        carry: Optional[Dict[str, np.ndarray]] = None
+        for ref in self._execute():
+            batch = B.block_to_batch(ray_tpu.get([ref])[0])
+            if not batch:
+                continue
+            if carry:
+                batch = {k: np.concatenate([carry[k], batch[k]])
+                         for k in batch}
+            n = len(next(iter(batch.values())))
+            lo = 0
+            while n - lo >= batch_size:
+                yield {k: v[lo:lo + batch_size] for k, v in batch.items()}
+                lo += batch_size
+            carry = ({k: v[lo:] for k, v in batch.items()}
+                     if lo < n else None)
+        if carry and not drop_last:
+            yield carry
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split block refs into n datasets (per-worker shards)."""
+        refs = self._execute()
+        return [Dataset([_FromRefs(refs[i::n])], self._max_inflight)
+                for i in range(n)]
+
+
+class GroupedDataset:
+    """Hash-free groupby: sort-merge per key (reference
+    ``grouped_data.py``); aggregations run on the driver over batches."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _groups(self) -> Dict[Any, List[Dict]]:
+        groups: Dict[Any, List[Dict]] = {}
+        for row in self._ds.iter_rows():
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def count(self) -> Dataset:
+        rows = [{self._key: k, "count()": len(v)}
+                for k, v in sorted(self._groups().items())]
+        return from_items_rows(rows)
+
+    def sum(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"sum({on})": builtins.sum(r[on] for r in v)}
+                for k, v in sorted(self._groups().items())]
+        return from_items_rows(rows)
+
+    def mean(self, on: str) -> Dataset:
+        rows = [{self._key: k,
+                 f"mean({on})": builtins.sum(r[on] for r in v) / len(v)}
+                for k, v in sorted(self._groups().items())]
+        return from_items_rows(rows)
+
+
+def _is_ready(ref) -> bool:
+    from ray_tpu.core_worker.worker import CoreWorker
+
+    cw = CoreWorker.current_or_raise()
+    return cw.memory_store.contains(ref.object_id)
+
+
+def _wrap_list(fn):
+    @functools.wraps(fn)
+    def inner(blocks):
+        out = fn(blocks)
+        return out if isinstance(out, list) else [out]
+
+    return inner
+
+
+def from_items_rows(rows: List[Dict]) -> Dataset:
+    ds = Dataset([_Read([lambda rows=rows: B.block_from_rows(rows)])])
+    return ds

@@ -1,0 +1,130 @@
+"""Read/write API (reference ``python/ray/data/read_api.py`` +
+``datasource/`` connectors). Each read produces independent read tasks —
+one per file / range shard — that execute as distributed tasks.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import Dataset, _Read
+
+
+def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    num_blocks = num_blocks or min(max(1, n // 1000), 64)
+    per = -(-n // num_blocks)
+    tasks = []
+    for i in builtins.range(num_blocks):
+        lo, hi = i * per, min((i + 1) * per, n)
+        if lo >= hi:
+            break
+        tasks.append(lambda lo=lo, hi=hi: B.block_from_batch(
+            {"id": np.arange(lo, hi)}))
+    return Dataset([_Read(tasks)])
+
+
+def from_items(items: List[Any], *, num_blocks: int = 1) -> Dataset:
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    per = -(-len(rows) // num_blocks) if rows else 1
+    tasks = []
+    for i in builtins.range(num_blocks):
+        chunk = rows[i * per:(i + 1) * per]
+        if not chunk and i > 0:
+            break
+        tasks.append(lambda chunk=chunk: B.block_from_rows(chunk))
+    return Dataset([_Read(tasks)])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray]) -> Dataset:
+    return Dataset([_Read([lambda: B.block_from_batch(arrays)])])
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(task_path)
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def read_csv(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            import pyarrow.csv as pcsv
+
+            return pcsv.read_csv(task_path)
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def read_json(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            import pyarrow.json as pjson
+
+            return pjson.read_json(task_path)
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def _write(ds: Dataset, path: str, ext: str, write_fn) -> List[str]:
+    import ray_tpu
+
+    os.makedirs(path, exist_ok=True)
+    out = []
+    for idx, ref in enumerate(ds._execute()):
+        block = ray_tpu.get([ref])[0]
+        fname = os.path.join(path, f"part-{idx:05d}.{ext}")
+        write_fn(block, fname)
+        out.append(fname)
+    return out
+
+
+def write_parquet(ds: Dataset, path: str) -> List[str]:
+    import pyarrow.parquet as pq
+
+    return _write(ds, path, "parquet", pq.write_table)
+
+
+def write_csv(ds: Dataset, path: str) -> List[str]:
+    import pyarrow.csv as pcsv
+
+    return _write(ds, path, "csv", pcsv.write_csv)

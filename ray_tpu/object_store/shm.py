@@ -1,0 +1,131 @@
+"""ctypes binding for the native shared-memory object store.
+
+Builds ``libshm_store.so`` on first use (g++ is in the image; the build is
+cached next to the source). ``get()`` returns a zero-copy memoryview over
+the shared pages — numpy arrays deserialize without a copy, the plasma
+property that matters for feeding TPU hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_SRC_DIR, "libshm_store.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _ensure_built() -> str:
+    src = os.path.join(_SRC_DIR, "shm_store.cc")
+    with _build_lock:
+        if (not os.path.exists(_SO_PATH)
+                or os.path.getmtime(_SO_PATH) < os.path.getmtime(src)):
+            tmp = _SO_PATH + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                 "-o", tmp, src, "-lpthread", "-lrt"],
+                check=True, capture_output=True)
+            os.replace(tmp, _SO_PATH)  # atomic: concurrent builders race ok
+    return _SO_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_ensure_built())
+    lib.rts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rts_create.restype = ctypes.c_int
+    lib.rts_open.argtypes = [ctypes.c_char_p]
+    lib.rts_open.restype = ctypes.c_int
+    lib.rts_put.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                            ctypes.c_char_p, ctypes.c_uint64]
+    lib.rts_put.restype = ctypes.c_int
+    lib.rts_get.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                            ctypes.POINTER(ctypes.c_uint64)]
+    lib.rts_get.restype = ctypes.POINTER(ctypes.c_ubyte)
+    for name in ("rts_release", "rts_contains", "rts_delete"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
+        fn.restype = ctypes.c_int
+    lib.rts_stats.argtypes = [ctypes.c_int] + \
+        [ctypes.POINTER(ctypes.c_uint64)] * 3
+    lib.rts_stats.restype = ctypes.c_int
+    lib.rts_unlink.argtypes = [ctypes.c_char_p]
+    lib.rts_unlink.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class ShmObjectStore:
+    """One node-local store; any process opening the same name shares it."""
+
+    def __init__(self, name: str, capacity: int = 256 * 1024 * 1024,
+                 create: bool = True):
+        self._lib = _load()
+        self.name = name.encode() if isinstance(name, str) else name
+        if create:
+            h = self._lib.rts_create(self.name, capacity)
+        else:
+            h = self._lib.rts_open(self.name)
+        if h < 0:
+            raise OSError(-h, f"shm store {name!r}: {os.strerror(-h)}")
+        self._h = h
+
+    def put(self, object_id: bytes, data) -> bool:
+        """False if it already exists; raises on out-of-space."""
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        rc = self._lib.rts_put(self._h, object_id, len(object_id), data,
+                               len(data))
+        if rc == 0:
+            return True
+        if rc == -17:      # EEXIST
+            return False
+        raise OSError(-rc, f"shm put failed: {os.strerror(-rc)}")
+
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy view, pinned until :meth:`release`."""
+        size = ctypes.c_uint64()
+        ptr = self._lib.rts_get(self._h, object_id, len(object_id),
+                                ctypes.byref(size))
+        if not ptr:
+            return None
+        return memoryview((ctypes.c_ubyte * size.value).from_address(
+            ctypes.addressof(ptr.contents))).cast("B")
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.rts_release(self._h, object_id, len(object_id))
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rts_contains(self._h, object_id,
+                                           len(object_id)))
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.rts_delete(self._h, object_id, len(object_id)) == 0
+
+    def stats(self) -> Tuple[int, int, int]:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self._lib.rts_stats(self._h, ctypes.byref(cap), ctypes.byref(used),
+                            ctypes.byref(num))
+        return cap.value, used.value, num.value
+
+    def unlink(self):
+        self._lib.rts_unlink(self.name)
+
+
+def unlink(name) -> bool:
+    """Unlink a segment by name WITHOUT opening it (no handle-slot cost)."""
+    if isinstance(name, str):
+        name = name.encode()
+    try:
+        return _load().rts_unlink(name) == 0
+    except Exception:  # noqa: BLE001 — lib unbuildable → nothing to unlink
+        return False

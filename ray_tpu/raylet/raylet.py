@@ -156,6 +156,14 @@ class Raylet:
                     w.proc.kill()
         self.gcs.close()
         self.server.stop()
+        # reclaim this node's shm object-store segment (every raylet owns
+        # its node's segment — not just the head; tmpfs leaks are RAM leaks)
+        try:
+            from ray_tpu.object_store.shm import unlink as shm_unlink
+
+            shm_unlink(f"/rtshm_{self.node_id.hex()[:12]}")
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------- cluster view sync
     def _on_resources_update(self, node_hex: str, msg: dict):

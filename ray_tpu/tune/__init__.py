@@ -1,0 +1,22 @@
+"""Tune library — hyperparameter search (reference ``python/ray/tune/``).
+
+Thin but real: Tuner drives trial actors with a concurrency cap, grid /
+random search spaces, ASHA early stopping, and a ResultGrid. Trials report
+through the same worker harness the Train library uses.
+"""
+
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ASHAScheduler,
+    Result,
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    report,
+)

@@ -1,0 +1,268 @@
+"""Tuner + trial execution + ASHA.
+
+Reference: ``python/ray/tune/tuner.py:43`` (Tuner.fit),
+``execution/tune_controller.py:68`` (trial event loop),
+``schedulers/async_hyperband.py`` (ASHA). Trials are actors (same harness
+shape as Train workers); the controller polls reports, applies the
+scheduler's stop decisions, and backfills from the pending queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.search import expand_param_space
+
+# --------------------------------------------------------- trial harness
+
+_trial_ctx = threading.local()
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """Report metrics from inside a trial (reference ``tune.report``)."""
+    sink = getattr(_trial_ctx, "sink", None)
+    if sink is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    sink(metrics)
+
+
+class TrialActor:
+    """Runs the trainable in a thread; controller polls for reports."""
+
+    def __init__(self):
+        self._reports: List[dict] = []
+        self._lock = threading.Lock()
+        self._status = "idle"
+        self._error: Optional[str] = None
+
+    def run(self, fn_blob: bytes, config: dict) -> bool:
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_blob)
+
+        def sink(metrics):
+            with self._lock:
+                self._reports.append(dict(metrics))
+
+        def target():
+            _trial_ctx.sink = sink
+            try:
+                out = fn(config)
+                if isinstance(out, dict):
+                    sink(out)
+                self._status = "finished"
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+                self._status = "error"
+            finally:
+                _trial_ctx.sink = None
+
+        self._status = "running"
+        threading.Thread(target=target, daemon=True, name="trial").start()
+        return True
+
+    def poll(self):
+        status, error = self._status, self._error
+        with self._lock:
+            reports, self._reports = self._reports, []
+        return {"status": status, "error": error, "reports": reports}
+
+
+# ------------------------------------------------------------ scheduler
+
+
+@dataclasses.dataclass
+class ASHAScheduler:
+    """Async successive halving (reference ASHA): a trial reaching rung r
+    must be in the top 1/reduction_factor of completed-rung trials to
+    continue."""
+
+    time_attr: str = "training_iteration"
+    max_t: int = 100
+    grace_period: int = 1
+    reduction_factor: int = 3
+
+    def __post_init__(self):
+        self._rungs: List[int] = []
+        t = self.grace_period
+        while t < self.max_t:
+            self._rungs.append(t)
+            t *= self.reduction_factor
+        # rung -> {trial_id: score}
+        self._scores: Dict[int, Dict[int, float]] = {r: {}
+                                                     for r in self._rungs}
+
+    def on_result(self, trial_id: int, step: int, score: float) -> str:
+        """Returns "continue" or "stop".
+
+        Decisions are *retroactive*: every report re-checks the trial's
+        recorded score at its highest reached rung against the rung's
+        CURRENT population, so an early arrival at an empty rung (whose
+        score looked fine against no competition) still gets cut once
+        better trials fill the rung in.
+        """
+        # milestone CROSSING (step >= rung), not equality: trainables may
+        # report non-consecutive training_iterations
+        for rung in self._rungs:
+            if step >= rung and trial_id not in self._scores[rung]:
+                self._scores[rung][trial_id] = score
+        # A trial must clear the bar at EVERY rung it has passed (checking
+        # only the newest rung would shield it while that rung is empty).
+        for rung in self._rungs:
+            if rung > step or trial_id not in self._scores[rung]:
+                continue
+            population = self._scores[rung]
+            k = max(1, math.ceil(len(population) / self.reduction_factor))
+            cutoff = sorted(population.values(), reverse=True)[:k][-1]
+            if population[trial_id] < cutoff:
+                return "stop"
+        if step >= self.max_t:
+            return "stop"
+        return "continue"
+
+
+# ---------------------------------------------------------------- tuner
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "score"
+    mode: str = "max"                  # "max" | "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[ASHAScheduler] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Result:
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def get_best_result(self) -> Result:
+        ok = [r for r in self._results
+              if r.error is None and self._metric in r.metrics]
+        if not ok:
+            raise RuntimeError("no successful trials")
+        sign = 1 if self._mode == "max" else -1
+        return max(ok, key=lambda r: sign * r.metrics[self._metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {**r.config, **r.metrics,
+             "error": bool(r.error)} for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 param_space: Dict[str, Any],
+                 tune_config: Optional[TuneConfig] = None):
+        self._trainable = trainable
+        self._space = param_space
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self, timeout_s: float = 600.0) -> ResultGrid:
+        import cloudpickle
+
+        import ray_tpu
+
+        cfg = self._cfg
+        configs = expand_param_space(self._space, cfg.num_samples, cfg.seed)
+        fn_blob = cloudpickle.dumps(self._trainable)
+        remote_cls = ray_tpu.remote(TrialActor)
+        sign = 1 if cfg.mode == "max" else -1
+
+        pending = list(enumerate(configs))
+        running: Dict[int, dict] = {}   # trial_id -> {actor, config, ...}
+        results: Dict[int, Result] = {}
+        steps: Dict[int, int] = {}
+        last_metrics: Dict[int, dict] = {}
+        deadline = time.monotonic() + timeout_s
+
+        def launch():
+            # start the whole wave in parallel: sequential worker spawn
+            # (~0.5s each) would stagger trials against the poll loop
+            started = []
+            while pending and len(running) < cfg.max_concurrent_trials:
+                tid, config = pending.pop(0)
+                actor = remote_cls.remote()
+                started.append(actor.run.remote(fn_blob, config))
+                running[tid] = {"actor": actor, "config": config}
+                steps[tid] = 0
+            if started:
+                ray_tpu.get(started)
+
+        launch()
+        while running:
+            if time.monotonic() > deadline:
+                for tid, tr in running.items():
+                    results[tid] = Result(tr["config"],
+                                          last_metrics.get(tid, {}),
+                                          error="tune timeout")
+                    ray_tpu.kill(tr["actor"])
+                break
+            time.sleep(0.05)
+            for tid in list(running):
+                tr = running[tid]
+                try:
+                    st = ray_tpu.get([tr["actor"].poll.remote()],
+                                     timeout=30.0)[0]
+                except Exception as e:  # noqa: BLE001 — trial actor died
+                    results[tid] = Result(tr["config"],
+                                          last_metrics.get(tid, {}),
+                                          error=f"trial actor died: {e}")
+                    del running[tid]
+                    continue
+                stopped = False
+                for rep in st["reports"]:
+                    steps[tid] += 1
+                    rep.setdefault("training_iteration", steps[tid])
+                    last_metrics[tid] = rep
+                    if cfg.scheduler and cfg.metric in rep:
+                        decision = cfg.scheduler.on_result(
+                            tid, rep["training_iteration"],
+                            sign * rep[cfg.metric])
+                        if decision == "stop":
+                            stopped = True
+                            break  # later reports are past the stop point
+                if stopped:
+                    results[tid] = Result(tr["config"],
+                                          last_metrics.get(tid, {}))
+                    ray_tpu.kill(tr["actor"])
+                    del running[tid]
+                elif st["status"] == "finished":
+                    results[tid] = Result(tr["config"],
+                                          last_metrics.get(tid, {}))
+                    ray_tpu.kill(tr["actor"])
+                    del running[tid]
+                elif st["status"] == "error":
+                    results[tid] = Result(tr["config"],
+                                          last_metrics.get(tid, {}),
+                                          error=st["error"])
+                    ray_tpu.kill(tr["actor"])
+                    del running[tid]
+            launch()
+
+        ordered = [results[tid] for tid in sorted(results)]
+        return ResultGrid(ordered, cfg.metric, cfg.mode)

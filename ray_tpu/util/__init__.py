@@ -1,0 +1,4 @@
+"""Utilities: state API, metrics, misc helpers."""
+
+from ray_tpu.util import state  # noqa: F401
+from ray_tpu.util.metrics import Counter, Gauge, Histogram  # noqa: F401

@@ -1,0 +1,109 @@
+"""Data library tests on a real local cluster."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestBasics:
+    def test_range_count_take(self, rt):
+        ds = rd.range(1000, num_blocks=4)
+        assert ds.count() == 1000
+        assert ds.num_blocks() == 4
+        assert [r["id"] for r in ds.take(3)] == [0, 1, 2]
+        assert ds.schema() == ["id"]
+
+    def test_map_filter_flatmap_chain(self, rt):
+        ds = (rd.range(100, num_blocks=4)
+              .map(lambda r: {"x": r["id"] * 2})
+              .filter(lambda r: r["x"] % 4 == 0)
+              .flat_map(lambda r: [r, {"x": r["x"] + 1}]))
+        rows = ds.take_all()
+        assert len(rows) == 100
+        assert rows[0] == {"x": 0} and rows[1] == {"x": 1}
+
+    def test_map_batches_and_add_column(self, rt):
+        ds = (rd.range(256, num_blocks=2)
+              .map_batches(lambda b: {"y": b["id"].astype(np.float64) * 0.5})
+              .add_column("z", lambda b: b["y"] + 1))
+        batch = next(ds.iter_batches(batch_size=10))
+        np.testing.assert_allclose(batch["z"], batch["y"] + 1)
+        assert ds.count() == 256
+
+    def test_aggregations(self, rt):
+        ds = rd.range(101, num_blocks=3)  # 0..100
+        assert ds.sum("id") == 5050
+        assert ds.min("id") == 0
+        assert ds.max("id") == 100
+        assert ds.mean("id") == 50.0
+
+    def test_sort_and_limit(self, rt):
+        ds = rd.from_items([{"v": x} for x in [5, 3, 9, 1]], num_blocks=2)
+        assert [r["v"] for r in ds.sort("v").take_all()] == [1, 3, 5, 9]
+        assert [r["v"] for r in ds.sort("v", descending=True).limit(2)
+                .take_all()] == [9, 5]
+
+    def test_repartition_and_union(self, rt):
+        ds = rd.range(100, num_blocks=2).repartition(5)
+        assert ds.num_blocks() == 5
+        assert ds.count() == 100
+        u = rd.range(10).union(rd.range(5))
+        assert u.count() == 15
+
+    def test_random_shuffle_preserves_rows(self, rt):
+        ds = rd.range(50, num_blocks=2).random_shuffle(seed=4)
+        vals = sorted(r["id"] for r in ds.take_all())
+        assert vals == list(range(50))
+        first = [r["id"] for r in rd.range(50).random_shuffle(seed=4)
+                 .take(5)]
+        assert first != [0, 1, 2, 3, 4]
+
+    def test_groupby(self, rt):
+        ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+        counts = {r["k"]: r["count()"] for r in ds.groupby("k").count()
+                  .take_all()}
+        assert counts == {0: 4, 1: 4, 2: 4}
+        means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v")
+                 .take_all()}
+        assert means[0] == pytest.approx(4.5)  # 0,3,6,9
+
+
+class TestIngest:
+    def test_iter_batches_across_blocks(self, rt):
+        ds = rd.range(100, num_blocks=7)
+        batches = list(ds.iter_batches(batch_size=32))
+        sizes = [len(b["id"]) for b in batches]
+        assert sizes == [32, 32, 32, 4]
+        all_ids = np.concatenate([b["id"] for b in batches])
+        np.testing.assert_array_equal(np.sort(all_ids), np.arange(100))
+
+    def test_split_shards(self, rt):
+        shards = rd.range(100, num_blocks=6).split(3)
+        assert len(shards) == 3
+        assert sum(s.count() for s in shards) == 100
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, rt, tmp_path):
+        ds = rd.range(64, num_blocks=2).map(
+            lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+        files = rd.write_parquet(ds, str(tmp_path / "pq"))
+        assert len(files) == 2
+        back = rd.read_parquet(str(tmp_path / "pq"))
+        assert back.count() == 64
+        assert back.sum("sq") == sum(i * i for i in range(64))
+
+    def test_csv_roundtrip(self, rt, tmp_path):
+        ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+        rd.write_csv(ds, str(tmp_path / "csv"))
+        back = rd.read_csv(str(tmp_path / "csv"))
+        assert back.take_all() == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]

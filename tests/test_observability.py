@@ -1,0 +1,141 @@
+"""State API, task events/timeline, metrics, shm-store integration tests."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestTaskEvents:
+    def test_task_events_and_timeline(self, rt, tmp_path):
+        @rt.remote
+        def traced_fn(x):
+            return x + 1
+
+        @rt.remote
+        class A:
+            def m(self):
+                return 1
+
+        rt.get([traced_fn.remote(i) for i in range(70)])  # > flush batch
+        a = A.remote()
+        rt.get(a.m.remote())
+        import time
+
+        time.sleep(1.6)  # periodic flusher interval
+        events = state.list_tasks()
+        names = {e["name"] for e in events}
+        assert "traced_fn" in names
+        fn_events = [e for e in events if e["name"] == "traced_fn"]
+        assert len(fn_events) >= 64
+        assert all(e["end_ts"] >= e["start_ts"] for e in fn_events)
+
+        trace = state.chrome_tracing_dump(str(tmp_path / "t.json"))
+        assert (tmp_path / "t.json").exists()
+        assert any(ev["ph"] == "X" for ev in trace)
+
+        summary = state.summarize_tasks()
+        assert summary["traced_fn"]["count"] >= 64
+        assert summary["traced_fn"]["failed"] == 0
+
+    def test_failed_task_recorded(self, rt):
+        @rt.remote
+        def dies():
+            raise RuntimeError("x")
+
+        from ray_tpu.common.status import TaskError
+
+        with pytest.raises(TaskError):
+            rt.get(dies.remote())
+        # force flush by running enough tasks
+        @rt.remote
+        def ok():
+            return 1
+
+        rt.get([ok.remote() for _ in range(70)])
+        import time
+
+        time.sleep(1.6)
+        events = [e for e in state.list_tasks() if e["name"] == "dies"]
+        assert events and events[0]["state"] == "FAILED"
+
+
+class TestStateApi:
+    def test_list_nodes_actors_jobs(self, rt):
+        @rt.remote
+        class Pinger:
+            def ping(self):
+                return True
+
+        p = Pinger.remote()
+        rt.get(p.ping.remote())
+        nodes = state.list_nodes()
+        assert nodes and nodes[0]["state"] == "ALIVE"
+        actors = state.list_actors()
+        assert any(a["state"] == "ALIVE" for a in actors)
+        assert state.list_jobs()
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self, rt):
+        c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+        c.inc(tags={"route": "/a"})
+        c.inc(2.0, tags={"route": "/a"})
+        g = metrics.Gauge("queue_len")
+        g.set(7)
+        h = metrics.Histogram("lat_s", boundaries=[0.01, 0.1, 1.0])
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+
+        snaps = {m["name"]: m for m in metrics.local_snapshots()}
+        assert snaps["req_total"]["values"]["/a"] == 3.0
+        assert snaps["queue_len"]["values"][""] == 7.0
+        assert snaps["lat_s"]["counts"][""] == [1, 1, 1, 1]
+
+        text = metrics.prometheus_text()
+        assert 'req_total{route="/a"} 3.0' in text
+        assert 'lat_s_bucket{le="+Inf"} 4' in text
+
+        metrics.push_metrics()
+        cluster = metrics.collect_cluster_metrics()
+        assert "req_total" in cluster
+
+    def test_counter_rejects_negative(self, rt):
+        c = metrics.Counter("neg_test")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+
+class TestShmIntegration:
+    def test_large_object_roundtrip_via_shm(self, rt):
+        @rt.remote
+        def big():
+            return np.arange(500_000, dtype=np.float64)  # 4 MB > inline
+
+        ref = big.remote()  # hold the ref: GC would delete from shm
+        arr = rt.get(ref, timeout=60)
+        assert arr.shape == (500_000,)
+        # the object should be visible in the node's shm store
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        cw = CoreWorker.current_or_raise()
+        assert cw.shm is not None
+        _, used, num = cw.shm.stats()
+        assert num >= 1 and used >= 4_000_000
+        # dropping the last ref GCs the shm copy too
+        oid = ref.object_id
+        del ref
+        import gc
+        import time
+
+        gc.collect()
+        time.sleep(0.2)
+        assert not cw.shm.contains(oid.binary())

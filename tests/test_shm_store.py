@@ -1,0 +1,162 @@
+"""Native shared-memory object store tests: single- and multi-process,
+zero-copy reads, eviction, robust-lock crash recovery."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu.object_store.shm import ShmObjectStore
+
+
+@pytest.fixture
+def store():
+    name = f"/rt_test_{os.getpid()}"
+    s = ShmObjectStore(name, capacity=4 * 1024 * 1024)
+    yield s
+    s.unlink()
+
+
+class TestBasics:
+    def test_put_get_roundtrip(self, store):
+        oid = b"a" * 28
+        payload = os.urandom(100_000)
+        assert store.put(oid, payload)
+        view = store.get(oid)
+        assert bytes(view) == payload
+        store.release(oid)
+
+    def test_duplicate_put_and_contains(self, store):
+        oid = b"b" * 28
+        assert store.put(oid, b"x")
+        assert not store.put(oid, b"y")       # EEXIST → False
+        assert store.contains(oid)
+        assert not store.contains(b"c" * 28)
+
+    def test_delete_and_refcount_pinning(self, store):
+        oid = b"d" * 28
+        store.put(oid, b"data")
+        store.get(oid)                         # pin
+        assert not store.delete(oid)           # EBUSY while pinned
+        store.release(oid)
+        assert store.delete(oid)
+        assert store.get(oid) is None
+
+    def test_zero_copy_numpy(self, store):
+        oid = b"e" * 28
+        arr = np.arange(10000, dtype=np.float32)
+        store.put(oid, arr.tobytes())
+        view = store.get(oid)
+        back = np.frombuffer(view, dtype=np.float32)  # no copy
+        np.testing.assert_array_equal(back, arr)
+        store.release(oid)
+
+    def test_zero_length_object(self, store):
+        oid = b"z" * 28
+        assert store.put(oid, b"")
+        view = store.get(oid)
+        assert view is not None and bytes(view) == b""
+        store.release(oid)
+        assert store.delete(oid)
+
+    def test_stats(self, store):
+        cap, used0, num0 = store.stats()
+        store.put(b"f" * 28, b"z" * 1000)
+        cap2, used, num = store.stats()
+        assert cap == cap2 == 4 * 1024 * 1024
+        assert used == used0 + 1000
+        assert num == num0 + 1
+
+
+class TestEviction:
+    def test_lru_eviction_on_pressure(self, store):
+        # fill with 1 MiB objects; capacity 4 MiB
+        for i in range(4):
+            assert store.put(f"obj{i:025d}".encode(), b"x" * (1024 * 1024))
+        # 5th forces eviction of the LRU (obj0)
+        assert store.put(b"obj_new" + b"0" * 21, b"y" * (1024 * 1024))
+        assert not store.contains(f"obj{0:025d}".encode())
+        assert store.contains(f"obj{3:025d}".encode())
+
+    def test_pinned_objects_never_evicted(self, store):
+        pinned = f"pin{0:025d}".encode()
+        store.put(pinned, b"x" * (3 * 1024 * 1024))
+        store.get(pinned)  # pin
+        # cannot fit another 3MiB: pinned object can't be evicted
+        with pytest.raises(OSError):
+            store.put(b"big" + b"0" * 25, b"y" * (3 * 1024 * 1024))
+        store.release(pinned)
+        # now eviction can reclaim it
+        assert store.put(b"big" + b"0" * 25, b"y" * (3 * 1024 * 1024))
+
+
+class TestMultiProcess:
+    def test_cross_process_visibility(self, store):
+        oid = b"x" * 28
+        payload = os.urandom(65536)
+        store.put(oid, payload)
+        code = f"""
+import sys
+from ray_tpu.object_store.shm import ShmObjectStore
+s = ShmObjectStore({store.name!r}, create=False)
+v = s.get({oid!r})
+assert v is not None, "object not visible cross-process"
+sys.stdout.buffer.write(bytes(v))
+s.release({oid!r})
+"""
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, cwd="/root/repo",
+                             env={**os.environ, "PYTHONPATH": "/root/repo"})
+        assert out.returncode == 0, out.stderr.decode()
+        assert out.stdout == payload
+
+    def test_child_writes_parent_reads(self, store):
+        oid = b"y" * 28
+        code = f"""
+from ray_tpu.object_store.shm import ShmObjectStore
+s = ShmObjectStore({store.name!r}, create=False)
+s.put({oid!r}, b"from-child" * 100)
+"""
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, cwd="/root/repo",
+                             env={**os.environ, "PYTHONPATH": "/root/repo"})
+        assert out.returncode == 0, out.stderr.decode()
+        view = store.get(oid)
+        assert bytes(view) == b"from-child" * 100
+        store.release(oid)
+
+    def test_robust_lock_survives_holder_crash(self, store):
+        """A process killed mid-put must not wedge the store."""
+        code = f"""
+import ctypes, os
+from ray_tpu.object_store import shm
+lib = shm._load()
+h = lib.rts_create({store.name!r}, 0)
+# grab the internal lock directly, then die without releasing
+class Header(ctypes.Structure): pass
+# simulate death-while-holding by taking the pthread lock via a put that
+# we interrupt: simplest faithful version — acquire through the C API on a
+# thread then _exit. We approximate by calling rts_get (which locks and
+# unlocks) then killing ourselves mid-loop of puts.
+import threading
+def spam():
+    i = 0
+    while True:
+        lib.rts_put(h, b"spam%020d" % i, 25, b"z" * 1000, 1000)
+        i += 1
+threading.Thread(target=spam, daemon=True).start()
+import time
+time.sleep(0.2)
+os._exit(9)
+"""
+        subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       cwd="/root/repo",
+                       env={**os.environ, "PYTHONPATH": "/root/repo"})
+        # the store must still be fully operational from this process
+        assert store.put(b"after-crash" + b"0" * 17, b"ok")
+        view = store.get(b"after-crash" + b"0" * 17)
+        assert bytes(view) == b"ok"
+        store.release(b"after-crash" + b"0" * 17)

@@ -1,0 +1,105 @@
+"""Tune tests: search spaces, Tuner over trial actors, ASHA stopping."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestSearchSpace:
+    def test_expand_grid_and_random(self):
+        from ray_tpu.tune.search import expand_param_space
+
+        space = {"a": tune.grid_search([1, 2, 3]),
+                 "b": tune.uniform(0.0, 1.0),
+                 "c": 42}
+        configs = expand_param_space(space, num_samples=2, seed=0)
+        assert len(configs) == 6  # 3 grid × 2 samples
+        assert {c["a"] for c in configs} == {1, 2, 3}
+        assert all(0.0 <= c["b"] <= 1.0 for c in configs)
+        assert all(c["c"] == 42 for c in configs)
+
+    def test_domains(self):
+        import numpy as np
+
+        from ray_tpu.tune.search import choice, loguniform, randint
+
+        rng = np.random.default_rng(0)
+        assert 1e-4 <= loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+        assert randint(3, 7).sample(rng) in (3, 4, 5, 6)
+        assert choice(["x", "y"]).sample(rng) in ("x", "y")
+
+
+class TestTuner:
+    def test_fit_finds_best(self, rt):
+        def trainable(config):
+            # quadratic with max at x=3
+            score = -(config["x"] - 3) ** 2
+            tune.report({"score": score})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        max_concurrent_trials=3))
+        grid = tuner.fit(timeout_s=120)
+        assert len(grid) == 6
+        best = grid.get_best_result()
+        assert best.config["x"] == 3
+        assert best.metrics["score"] == 0
+
+    def test_trial_error_isolated(self, rt):
+        def trainable(config):
+            if config["x"] == 1:
+                raise ValueError("boom")
+            tune.report({"score": config["x"]})
+
+        grid = tune.Tuner(
+            trainable, param_space={"x": tune.grid_search([0, 1, 2])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+        ).fit(timeout_s=120)
+        errs = [r for r in grid if r.error]
+        assert len(errs) == 1 and "boom" in errs[0].error
+        assert grid.get_best_result().config["x"] == 2
+
+    def test_asha_stops_bad_trials(self, rt):
+        def trainable(config):
+            import time
+
+            for step in range(20):
+                tune.report({"score": config["slope"] * (step + 1)})
+                # slow enough that polls interleave trials even after the
+                # ~2s parallel fleet startup
+                time.sleep(0.3)
+
+        sched = tune.ASHAScheduler(max_t=20, grace_period=2,
+                                   reduction_factor=2)
+        grid = tune.Tuner(
+            trainable,
+            param_space={"slope": tune.grid_search([0.1, 0.5, 1.0, 2.0])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        scheduler=sched,
+                                        max_concurrent_trials=4),
+        ).fit(timeout_s=180)
+        best = grid.get_best_result()
+        assert best.config["slope"] == 2.0
+        # at least one weak trial stopped before max_t iterations
+        iters = [r.metrics.get("training_iteration", 0) for r in grid]
+        assert min(iters) < 20
+
+    def test_min_mode(self, rt):
+        def trainable(config):
+            tune.report({"loss": abs(config["x"] - 2)})
+
+        grid = tune.Tuner(
+            trainable, param_space={"x": tune.grid_search([0, 2, 5])},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        ).fit(timeout_s=120)
+        assert grid.get_best_result().config["x"] == 2
