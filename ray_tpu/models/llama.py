@@ -48,6 +48,9 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16       # activation / weight dtype
     remat: bool = True              # checkpoint each layer under scan
+    # "nothing" (max recompute, min HBM), "dots" (save matmul outputs —
+    # fewer recomputed FLOPs, more HBM), "none" alias of remat=False
+    remat_policy: str = "nothing"
     attn_block: int = 512           # flash attention tile size
     # Ring/sequence-parallel attention: set by the trainer when sp > 1.
     sp_axis: Optional[str] = None
@@ -215,8 +218,10 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
         return x, None
 
     if c.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if c.remat_policy == "dots"
+                  else jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(block, policy=policy)
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
