@@ -1,0 +1,266 @@
+"""Core-runtime microbenchmarks vs the reference's published numbers.
+
+Mirrors the reference's ``python/ray/_private/ray_perf.py`` workloads (see
+methodology in ``ray_microbenchmark_helpers.py``: warmup pass, then timed
+trials of ~2 s each) against the numbers snapshotted in
+``release/perf_metrics/microbenchmark.json`` + ``benchmarks/*.json``
+(tabulated in BASELINE.md §"Core throughput").
+
+Prints one JSON line per metric and writes the full set to
+``BENCH_core.json``. ``vs_baseline`` = ours / reference (higher is better);
+null where the reference publishes no comparable number.
+
+Run: python bench_core.py [filter_substring]
+"""
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu
+
+# Reference values: release/perf_metrics/microbenchmark.json (calls/s),
+# benchmarks/many_{actors,pgs,tasks}.json (rates), BASELINE.md.
+BASELINES = {
+    "single_client_get_calls": None,
+    "single_client_put_calls": None,
+    "single_client_tasks_sync": None,
+    "single_client_tasks_async": None,
+    "multi_client_tasks_async": 21229.8,
+    "1_1_actor_calls_sync": 2011.9,
+    "1_1_actor_calls_async": 8663.7,
+    "1_1_actor_calls_concurrent": 5775.0,
+    "1_n_actor_calls_async": 8038.2,
+    "n_n_actor_calls_async": 27375.6,
+    "1_1_async_actor_calls_sync": 1459.7,
+    "1_1_async_actor_calls_async": 4259.8,
+    "1_1_async_actor_calls_with_args_async": 2836.3,
+    "1_n_async_actor_calls_async": 7382.7,
+    "n_n_async_actor_calls_async": 23674.5,
+    "put_gigabytes_per_s": None,
+    "get_gigabytes_per_s": None,
+    "actors_per_second": 657.0,
+    "pgs_per_second": 13.2,
+    "tasks_per_second_10k_pending": 364.0,
+}
+
+RESULTS = []
+FILTER = sys.argv[1] if len(sys.argv) > 1 else ""
+
+
+def timeit(name, fn, multiplier=1, trials=3, trial_s=2.0, unit="calls/s"):
+    if FILTER and FILTER not in name:
+        return
+    # Warmup: size the step so each trial checks the clock rarely.
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < 1.0:
+        fn()
+        count += 1
+    step = count // 10 + 1
+    stats = []
+    for _ in range(trials):
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < trial_s:
+            for _ in range(step):
+                fn()
+            count += step
+        stats.append(multiplier * count / (time.perf_counter() - start))
+    rec = {
+        "metric": name,
+        "value": round(statistics.mean(stats), 1),
+        "stddev": round(statistics.pstdev(stats), 1),
+        "unit": unit,
+        "baseline": BASELINES.get(name),
+        "vs_baseline": (round(statistics.mean(stats) / BASELINES[name], 2)
+                        if BASELINES.get(name) else None),
+    }
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+# ---------------------------------------------------------------- workloads
+
+@ray_tpu.remote
+def small_value():
+    return b"ok"
+
+
+@ray_tpu.remote
+def small_value_batch(n):
+    ray_tpu.get([small_value.options(num_cpus=0).remote() for _ in range(n)])
+    return 0
+
+
+@ray_tpu.remote(num_cpus=0)
+class Actor:
+    def small_value(self):
+        return b"ok"
+
+    def small_value_arg(self, x):
+        return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+class AsyncActor:
+    async def small_value(self):
+        return b"ok"
+
+    async def small_value_with_arg(self, x):
+        return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+class Client:
+    def __init__(self, servers):
+        self.servers = servers if isinstance(servers, list) else [servers]
+
+    def small_value_batch(self, n):
+        results = []
+        for s in self.servers:
+            results.extend([s.small_value.remote() for _ in range(n)])
+        ray_tpu.get(results)
+
+
+@ray_tpu.remote
+def fanout_work(actors, n):
+    ray_tpu.get([actors[i % len(actors)].small_value.remote()
+                 for i in range(n)])
+
+
+def main():
+    ray_tpu.init(num_cpus=16, num_tpus=0)
+
+    value = ray_tpu.put(0)
+    timeit("single_client_get_calls", lambda: ray_tpu.get(value))
+    timeit("single_client_put_calls", lambda: ray_tpu.put(0))
+
+    def small_task():
+        ray_tpu.get(small_value.remote())
+
+    timeit("single_client_tasks_sync", small_task)
+
+    def small_task_async():
+        ray_tpu.get([small_value.remote() for _ in range(300)])
+
+    timeit("single_client_tasks_async", small_task_async, 300)
+
+    n, m = 300, 4
+    batchers = [small_value_batch for _ in range(m)]
+    timeit("multi_client_tasks_async",
+           lambda: ray_tpu.get([b.remote(n) for b in batchers]), n * m)
+
+    a = Actor.remote()
+    timeit("1_1_actor_calls_sync", lambda: ray_tpu.get(a.small_value.remote()))
+
+    a = Actor.remote()
+    timeit("1_1_actor_calls_async",
+           lambda: ray_tpu.get(
+               [a.small_value.remote() for _ in range(500)]), 500)
+
+    a = Actor.options(max_concurrency=16).remote()
+    timeit("1_1_actor_calls_concurrent",
+           lambda: ray_tpu.get(
+               [a.small_value.remote() for _ in range(500)]), 500)
+
+    n, k = 1000, 4
+    servers = [Actor.remote() for _ in range(k)]
+    client = Client.remote(servers)
+    timeit("1_n_actor_calls_async",
+           lambda: ray_tpu.get(client.small_value_batch.remote(n)), n * k)
+
+    n, m, k = 1000, 4, 4
+    servers = [Actor.remote() for _ in range(k)]
+    timeit("n_n_actor_calls_async",
+           lambda: ray_tpu.get(
+               [fanout_work.remote(servers, n) for _ in range(m)]), m * n)
+
+    aa = AsyncActor.remote()
+    timeit("1_1_async_actor_calls_sync",
+           lambda: ray_tpu.get(aa.small_value.remote()))
+
+    aa = AsyncActor.remote()
+    timeit("1_1_async_actor_calls_async",
+           lambda: ray_tpu.get(
+               [aa.small_value.remote() for _ in range(500)]), 500)
+
+    aa = AsyncActor.remote()
+    timeit("1_1_async_actor_calls_with_args_async",
+           lambda: ray_tpu.get(
+               [aa.small_value_with_arg.remote(i) for i in range(500)]), 500)
+
+    n, k = 1000, 4
+    servers = [AsyncActor.remote() for _ in range(k)]
+    client = Client.remote(servers)
+    timeit("1_n_async_actor_calls_async",
+           lambda: ray_tpu.get(client.small_value_batch.remote(n)), n * k)
+
+    n, m, k = 1000, 4, 4
+    servers = [AsyncActor.remote() for _ in range(k)]
+    timeit("n_n_async_actor_calls_async",
+           lambda: ray_tpu.get(
+               [fanout_work.remote(servers, n) for _ in range(m)]), m * n)
+
+    # Object-plane bandwidth through the shm store (100 MiB numpy arrays).
+    arr = np.zeros(100 * 1024 * 1024 // 8, dtype=np.int64)
+    gb = arr.nbytes / 1e9
+    last = {}
+
+    def put_large():
+        # keep exactly one live ref: accumulating them would overflow the
+        # in-process store and measure disk spilling instead of put
+        last["ref"] = ray_tpu.put(arr)
+
+    timeit("put_gigabytes_per_s", put_large, gb, trials=2, trial_s=1.5,
+           unit="GB/s")
+    big = last["ref"]
+    timeit("get_gigabytes_per_s", lambda: ray_tpu.get(big), gb,
+           trials=2, trial_s=1.5, unit="GB/s")
+    del big, last
+
+    # Actor creation rate (reference many_actors.json: trivial actors).
+    def create_actors():
+        made = [Actor.remote() for _ in range(20)]
+        ray_tpu.get([x.small_value.remote() for x in made])
+
+    timeit("actors_per_second", create_actors, 20, trials=2, unit="actors/s")
+
+    # PG create+remove rate (reference many_pgs.json).
+    from ray_tpu import placement_group, remove_placement_group
+
+    def pg_cycle():
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        pg.wait(timeout_seconds=30)
+        remove_placement_group(pg)
+
+    timeit("pgs_per_second", pg_cycle, 1, trials=2, unit="pgs/s")
+
+    # Sustained task throughput with a deep backlog (many_tasks.json is
+    # 10k pending cluster-wide; same shape single-node here).
+    def backlog():
+        ray_tpu.get([small_value.remote() for _ in range(2000)])
+
+    t0 = time.perf_counter()
+    backlog()
+    rate = 2000 / (time.perf_counter() - t0)
+    rec = {"metric": "tasks_per_second_10k_pending", "value": round(rate, 1),
+           "stddev": 0.0, "unit": "tasks/s",
+           "baseline": BASELINES["tasks_per_second_10k_pending"],
+           "vs_baseline": round(rate / 364.0, 2)}
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+    ray_tpu.shutdown()
+    with open("BENCH_core.json", "w") as f:
+        json.dump({"results": RESULTS,
+                   "source": "bench_core.py vs BASELINE.md core rows"}, f,
+                  indent=2)
+    print(f"# wrote BENCH_core.json ({len(RESULTS)} metrics)")
+
+
+if __name__ == "__main__":
+    main()

@@ -168,6 +168,18 @@ class KVGroup:
         return out
 
     def destroy(self):
+        # Exit barrier first: rank 0 must not delete op keys while a slower
+        # member is still reading its parts of the final op (deleting early
+        # strands that member in _wait_key until timeout). If a member died
+        # and never reaches the barrier, time out and clean up anyway.
+        try:
+            self._barrier_at(f"destroy:{self._seq}")
+        except TimeoutError:
+            pass
         if self.rank == 0:
+            # Delete only data-plane keys. member:/destroy: barrier keys stay:
+            # a slower rank may still be polling them inside _barrier_at, and
+            # deleting underneath it would stall that rank until timeout.
             for key in self._kv.kv_keys(self._ns):
-                self._kv.kv_del(self._ns, key)
+                if key.startswith((b"op:", b"p2p:", b"gcb:")):
+                    self._kv.kv_del(self._ns, key)

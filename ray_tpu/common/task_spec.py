@@ -78,6 +78,10 @@ class TaskArg:
     object_id: Optional[ObjectID] = None
     owner: Optional[WorkerID] = None
     owner_address: Optional[Tuple[str, int]] = None
+    # Unique id of this by-ref handoff; the owner's transit guard is keyed on
+    # it so acks are idempotent under retries/races (see worker.py borrow
+    # protocol).
+    handoff_token: Optional[bytes] = None
 
     @classmethod
     def inline(cls, value: bytes) -> "TaskArg":

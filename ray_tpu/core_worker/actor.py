@@ -79,13 +79,22 @@ class ActorClass:
         if cw is None:
             raise RuntimeError("ray_tpu.init() must be called first")
         sched = _strategy_from_options(opts)
+        # Async actors (any ``async def`` method) default to high concurrency:
+        # calls interleave on the actor's event loop rather than queueing
+        # (reference python/ray/actor.py DEFAULT_MAX_CONCURRENCY_ASYNC=1000).
+        import inspect
+
+        default_concurrency = 1
+        if any(inspect.iscoroutinefunction(getattr(self._cls, m, None))
+               for m in dir(self._cls) if not m.startswith("__")):
+            default_concurrency = 1000
         actor_id = cw.create_actor(
             self._cls, args, kwargs,
             resources=_resources_from_options(opts, for_actor=True),
             label_selector=opts.get("label_selector"),
             scheduling_strategy=sched,
             max_restarts=opts.get("max_restarts", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
+            max_concurrency=opts.get("max_concurrency", default_concurrency),
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
         )

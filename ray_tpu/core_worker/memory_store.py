@@ -5,10 +5,18 @@ Holds serialized objects owned by or cached in this worker: task returns,
 (bytes or an error) or *pending* (a future a ``get`` can block on).  Large
 objects additionally live in the node's shared-memory store once the native
 object plane is attached (see ray_tpu.object_store).
+
+Spilling (reference: raylet/local_object_manager.h:43): when a put would
+exceed the store cap, ready values are spilled largest-first to the external
+storage dir and restored transparently on access
+(AsyncRestoreSpilledObject:125 equivalent).
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import tempfile
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -16,6 +24,8 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu.common.config import GLOBAL_CONFIG
 from ray_tpu.common.ids import ObjectID
 from ray_tpu.common.status import ObjectStoreFullError, RtTimeoutError
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -25,6 +35,7 @@ class Entry:
     location: Optional[Tuple[str, int]] = None  # remote holder (large objects)
     is_ready: bool = False
     size: int = 0
+    spilled_path: Optional[str] = None  # on-disk value (spilled)
 
 
 class MemoryStore:
@@ -33,6 +44,69 @@ class MemoryStore:
         self._cv = threading.Condition()
         self._bytes_used = 0
         self._done_callbacks: Dict[ObjectID, list] = {}
+        self._spill_dir: Optional[str] = None
+
+    # ------------------------------------------------------------- spilling
+    def _ensure_spill_dir(self) -> str:
+        if self._spill_dir is None:
+            base = GLOBAL_CONFIG.get("object_spilling_dir") or None
+            self._spill_dir = tempfile.mkdtemp(prefix="rt_spill_", dir=base)
+        return self._spill_dir
+
+    def _spill_locked(self, need_bytes: int) -> None:
+        """Spill ready values, largest first, until `need_bytes` are freed.
+        Called under self._cv."""
+        candidates = sorted(
+            ((e.size, oid) for oid, e in self._entries.items()
+             if e.is_ready and e.value is not None and e.size > 0),
+            key=lambda t: t[0], reverse=True)
+        spill_dir = self._ensure_spill_dir()
+        for size, oid in candidates:
+            if need_bytes <= 0:
+                return
+            e = self._entries[oid]
+            path = os.path.join(spill_dir, oid.hex())
+            try:
+                with open(path, "wb") as f:
+                    f.write(e.value)
+            except OSError as err:
+                logger.warning("spill of %s failed: %s", oid.hex()[:12], err)
+                continue
+            # Replace rather than mutate: readers that already hold the old
+            # Entry (handlers read entry.value after releasing the lock)
+            # keep a value-bearing snapshot; the bytes are reclaimed when
+            # the last such reader drops it.
+            import dataclasses as _dc
+            self._entries[oid] = _dc.replace(e, value=None, spilled_path=path)
+            self._bytes_used -= size
+            need_bytes -= size
+            logger.debug("spilled %s (%d bytes) to %s",
+                         oid.hex()[:12], size, path)
+
+    def _restore_locked(self, e: Entry) -> bool:
+        """Load a spilled value back into memory (spilling others if the
+        restore itself overflows the cap). Returns False if the spill file
+        is gone/unreadable — the entry is then lost, not an I/O crash."""
+        if e.spilled_path is None or e.value is not None:
+            return True
+        try:
+            with open(e.spilled_path, "rb") as f:
+                value = f.read()
+        except OSError as err:
+            logger.warning("restore of spilled %s failed: %s",
+                           e.spilled_path, err)
+            return False
+        cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
+        if self._bytes_used + len(value) > cap:
+            self._spill_locked(self._bytes_used + len(value) - cap)
+        e.value = value
+        self._bytes_used += len(value)
+        try:
+            os.unlink(e.spilled_path)
+        except OSError:
+            pass
+        e.spilled_path = None
+        return True
 
     def put(self, object_id: ObjectID, value: Optional[bytes] = None,
             error: Optional[bytes] = None,
@@ -40,9 +114,14 @@ class MemoryStore:
         size = len(value) if value else 0
         with self._cv:
             cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
+            high = cap * GLOBAL_CONFIG.get("object_spilling_threshold")
             existing = self._entries.get(object_id)
             if existing is not None and existing.is_ready:
                 return  # idempotent: first write wins (retries may re-store)
+            if self._bytes_used + size > high:
+                # spill down to the configured fullness ratio so later puts
+                # are less likely to pay the spill on their critical path
+                self._spill_locked(int(self._bytes_used + size - high))
             if self._bytes_used + size > cap:
                 raise ObjectStoreFullError(
                     f"memory store full: {self._bytes_used + size} > {cap}")
@@ -82,7 +161,12 @@ class MemoryStore:
     def get_if_ready(self, object_id: ObjectID) -> Optional[Entry]:
         with self._cv:
             e = self._entries.get(object_id)
-            return e if e is not None and e.is_ready else None
+            if e is None or not e.is_ready:
+                return None
+            if e.spilled_path is not None and not self._restore_locked(e):
+                del self._entries[object_id]  # spill file lost
+                return None
+            return e
 
     def wait_ready(self, object_ids: List[ObjectID], num_ready: int,
                    timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
@@ -107,14 +191,52 @@ class MemoryStore:
         if not ready:
             raise RtTimeoutError(f"timed out waiting for {object_id}")
         with self._cv:
-            return self._entries[object_id]
+            e = self._entries[object_id]
+            if e.spilled_path is not None and not self._restore_locked(e):
+                del self._entries[object_id]  # lost: let callers reconstruct
+                raise RtTimeoutError(
+                    f"spilled value for {object_id} lost from disk")
+            return e
+
+    def read_range(self, object_id: ObjectID, offset: int, length: int):
+        """Byte range of a ready value; spilled values are read directly
+        from the spill file WITHOUT restoring (chunked pulls of a spilled
+        object stay O(total size) in disk I/O)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.is_ready:
+                return None
+            if e.value is not None:
+                return bytes(memoryview(e.value)[offset:offset + length])
+            path = e.spilled_path
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except OSError:
+            return None
+
+    def peek_location(self, object_id: ObjectID):
+        """Location of a ready entry WITHOUT restoring a spilled value
+        (used on free paths, where restoring would be wasted I/O)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e.location if e is not None and e.is_ready else None
 
     def free(self, object_ids: List[ObjectID]) -> None:
         with self._cv:
             for oid in object_ids:
                 e = self._entries.pop(oid, None)
                 if e is not None:
-                    self._bytes_used -= e.size
+                    if e.value is not None:
+                        self._bytes_used -= e.size
+                    if e.spilled_path is not None:
+                        try:
+                            os.unlink(e.spilled_path)
+                        except OSError:
+                            pass
                 # a freed-before-ready object will never fire its callbacks
                 self._done_callbacks.pop(oid, None)
 

@@ -157,6 +157,9 @@ class NormalTaskSubmitter:
         blob = pickle.dumps(error)
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error=blob)
+        # Terminal failure still completes the task: release the handoff
+        # guards on its by-ref args or their owners leak them forever.
+        self._cw.ack_args_handoffs(spec)
 
 
 class ActorTaskSubmitter:
@@ -292,6 +295,7 @@ class ActorTaskSubmitter:
         blob = pickle.dumps(error)
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error=blob)
+        self._cw.ack_args_handoffs(spec)
 
     def notify_actor_state(self, view: dict):
         """Pubsub-driven: DEAD → fail; ALIVE after restart → reconnect."""

@@ -94,6 +94,23 @@ def _flash_lse_fwd(q, k, v, causal, scale, block):
 
 
 def _flash_lse_bwd(causal, scale, block, res, cotangents):
+    """Backward dispatch: Pallas TPU kernels on TPU, blockwise XLA scan
+    elsewhere. Both compute the standard recompute-form flash backward."""
+    if _use_pallas():
+        from ray_tpu.ops.pallas.flash_attention import flash_attention_bwd_pallas
+
+        dout, dlse = cotangents
+        q, k, v, out, lse = res
+        delta = (jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                         axis=-1) - dlse.astype(jnp.float32))
+        dq, dk, dv = flash_attention_bwd_pallas(
+            q, k, v, lse, delta, dout, causal=causal, scale=scale,
+            block_q=block, block_kv=block)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_bwd_xla(causal, scale, block, res, cotangents)
+
+
+def _flash_bwd_xla(causal, scale, block, res, cotangents):
     """Blockwise flash backward, (B, H, S, D) layout.
 
     Standard recompute formulation: with P = exp(S·scale − lse) and

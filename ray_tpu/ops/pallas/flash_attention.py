@@ -152,3 +152,241 @@ def flash_attention_fwd_pallas(q, k, v, *, causal: bool, scale: float,
     out = out.reshape(b, hq, sq_p, d)[:, :, :sq]
     lse = lse[:, :, 0].reshape(b, hq, sq_p)[:, :, :sq]
     return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels.
+#
+# Both kernels keep the score matrix *transposed* relative to the forward:
+# s_t = K·Qᵀ of shape (block_kv, block_q). With q as the lane (minor)
+# dimension, the per-q-row vectors lse and delta — stored as (1, block_q)
+# tiles — broadcast against s_t without any in-kernel transpose; every
+# contraction is a plain MXU dot_general.
+#
+# Standard recompute formulation (P recomputed from q, k, lse):
+#   P   = exp(S·scale − lse)
+#   dV  = Pᵀ·dO
+#   dS  = P ∘ (dO·Vᵀ − Δ)   with Δ = Σ_d dO·O − dlse (precomputed, f32)
+#   dQ  = scale·dS·K          dK = scale·dSᵀ·Q
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc_ref,
+                   *, scale: float, causal: bool, block_q: int,
+                   block_kv: int, q_len: int, kv_len: int,
+                   num_kv_blocks: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    should_run = (ik * block_kv < (iq + 1) * block_q) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0]                       # (Bq, D)
+        k = k_ref[0]                       # (Bkv, D)
+        v = v_ref[0]
+        do = do_ref[0]                     # (Bq, D)
+        lse = lse_ref[0]                   # (1, Bq) f32
+        delta = delta_ref[0]               # (1, Bq) f32
+
+        s_t = jax.lax.dot_general(         # (Bkv, Bq) = K·Qᵀ
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
+        kpos = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
+        mask = jnp.logical_and(qpos < q_len, kpos < kv_len)
+        if causal:
+            mask = jnp.logical_and(mask, qpos >= kpos)
+        p_t = jnp.where(mask, jnp.exp(s_t - lse), 0.0)        # (Bkv, Bq)
+        dp_t = jax.lax.dot_general(        # (Bkv, Bq) = V·dOᵀ
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta)
+        dq_acc_ref[:] += jax.lax.dot_general(   # (Bq, D) = dSᵀ_t·K·scale
+            ds_t, k.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, scale: float, causal: bool, block_q: int,
+                    block_kv: int, q_len: int, kv_len: int,
+                    num_q_blocks: int, num_inner: int):
+    ik = pl.program_id(1)
+    e = pl.program_id(2)                   # enumerates (gqa group, q block)
+    iq = e % num_q_blocks
+
+    @pl.when(e == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    # Causal: the q block must reach at least the first kv row of this block.
+    should_run = ((iq + 1) * block_q > ik * block_kv) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0]                       # (Bq, D)
+        k = k_ref[0]                       # (Bkv, D)
+        v = v_ref[0]
+        do = do_ref[0]                     # (Bq, D)
+        lse = lse_ref[0]                   # (1, Bq)
+        delta = delta_ref[0]
+
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (Bkv, Bq)
+        qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
+        kpos = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
+        mask = jnp.logical_and(qpos < q_len, kpos < kv_len)
+        if causal:
+            mask = jnp.logical_and(mask, qpos >= kpos)
+        p_t = jnp.where(mask, jnp.exp(s_t - lse), 0.0)
+        dv_acc_ref[:] += jax.lax.dot_general(   # (Bkv, D) = P_t·dO
+            p_t, do.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta)
+        dk_acc_ref[:] += jax.lax.dot_general(   # (Bkv, D) = dS_t·Q·scale
+            ds_t, q.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(e == num_inner - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd_pallas(q, k, v, lse, delta, dout, *,
+                               causal: bool, scale: float,
+                               block_q: int = 512, block_kv: int = 512,
+                               interpret: bool = False):
+    """Backward pass. q/dout: (B, Hq, Sq, D); k, v: (B, Hkv, Skv, D);
+    lse, delta: (B, Hq, Sq) f32 with delta = Σ_d dO·O − dlse.
+
+    Returns (dq, dk, dv) in the input dtypes/shapes. GQA kv gradients are
+    accumulated *inside* the dkv kernel (the innermost grid axis enumerates
+    group × q-blocks against a resident kv tile) — no materialized
+    head-repeat or post-hoc group reduction.
+    """
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = hq // hkv
+
+    block_q = max(16, min(block_q, sq))
+    block_kv = max(16, min(block_kv, skv))
+    sq_p = math.ceil(sq / block_q) * block_q
+    skv_p = math.ceil(skv / block_kv) * block_kv
+    if sq_p != sq:
+        pad = ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))
+        q = jnp.pad(q, pad)
+        dout = jnp.pad(dout, pad)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - sq)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq)))
+    if skv_p != skv:
+        pad = ((0, 0), (0, 0), (0, skv_p - skv), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    nq = sq_p // block_q
+    nk = skv_p // block_kv
+
+    qf = q.reshape(b * hq, sq_p, d)
+    doutf = dout.reshape(b * hq, sq_p, d)
+    kf = k.reshape(b * hkv, skv_p, d)
+    vf = v.reshape(b * hkv, skv_p, d)
+    lsef = lse.reshape(b * hq, 1, sq_p).astype(jnp.float32)
+    deltaf = delta.reshape(b * hq, 1, sq_p).astype(jnp.float32)
+
+    def q_ix(bh, iq, ik):
+        return (bh, iq, 0)
+
+    def kv_ix(bh, iq, ik):
+        return (bh // hq * hkv + (bh % hq) // group, ik, 0)
+
+    def vec_ix(bh, iq, ik):
+        return (bh, 0, iq)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, q_len=sq, kv_len=skv, num_kv_blocks=nk)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_ix),
+            pl.BlockSpec((1, block_kv, d), kv_ix),
+            pl.BlockSpec((1, block_kv, d), kv_ix),
+            pl.BlockSpec((1, block_q, d), q_ix),
+            pl.BlockSpec((1, 1, block_q), vec_ix),
+            pl.BlockSpec((1, 1, block_q), vec_ix),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_ix),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, doutf, lsef, deltaf)
+
+    # dkv: grid minor axis sweeps (group, q block) pairs while one kv tile
+    # and its dk/dv accumulators stay resident in VMEM.
+    num_inner = group * nq
+
+    def q_ix2(bh, ik, e):
+        return (bh // hkv * hq + (bh % hkv) * group + e // nq, e % nq, 0)
+
+    def kv_ix2(bh, ik, e):
+        return (bh, ik, 0)
+
+    def vec_ix2(bh, ik, e):
+        return (bh // hkv * hq + (bh % hkv) * group + e // nq, 0, e % nq)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, q_len=sq, kv_len=skv, num_q_blocks=nq,
+        num_inner=num_inner)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * hkv, nk, num_inner),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_ix2),
+            pl.BlockSpec((1, block_kv, d), kv_ix2),
+            pl.BlockSpec((1, block_kv, d), kv_ix2),
+            pl.BlockSpec((1, block_q, d), q_ix2),
+            pl.BlockSpec((1, 1, block_q), vec_ix2),
+            pl.BlockSpec((1, 1, block_q), vec_ix2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), kv_ix2),
+            pl.BlockSpec((1, block_kv, d), kv_ix2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hkv, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hkv, skv_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, doutf, lsef, deltaf)
+
+    dq = dq.reshape(b, hq, sq_p, d)[:, :, :sq]
+    dk = dk.reshape(b, hkv, skv_p, d)[:, :, :skv]
+    dv = dv.reshape(b, hkv, skv_p, d)[:, :, :skv]
+    return dq, dk, dv

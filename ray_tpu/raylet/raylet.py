@@ -263,6 +263,17 @@ class Raylet:
     async def _start_worker(self) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        # Defer the TPU runtime preload: the sitecustomize jax/PJRT boot costs
+        # ~1.9 s per process and only TPU-holding workers need it. The stashed
+        # vars are restored (and the PJRT plugin registered) by
+        # h_set_visible_devices when a TPU lease lands on the worker.
+        if env.get("PALLAS_AXON_POOL_IPS"):
+            env["RT_DEFERRED_PALLAS_AXON_POOL_IPS"] = env.pop(
+                "PALLAS_AXON_POOL_IPS")
+            if "axon" in env.get("JAX_PLATFORMS", ""):
+                # axon is unregistered until the deferred boot runs; leaving
+                # the platform pinned would make a plain jax import raise.
+                env["RT_DEFERRED_JAX_PLATFORMS"] = env.pop("JAX_PLATFORMS")
         env.update(self._fake_worker_env)
         env["RT_WORKER_ID"] = worker_id.hex()
         env["RT_RAYLET_ADDR"] = f"{self.server.address[0]}:{self.server.address[1]}"

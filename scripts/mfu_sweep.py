@@ -1,0 +1,100 @@
+"""MFU sweep on the real chip: step-time for config variants.
+
+Usage: python scripts/mfu_sweep.py [variant ...]
+Prints one JSON line per variant. Not part of the test suite.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.models.training import (
+    OptimizerConfig, init_train_state, make_train_step)
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+from ray_tpu.parallel.sharding import ShardingRules
+
+from bench import peak_flops
+
+
+BASE = dataclasses.replace(
+    llama.CONFIGS["1b"], vocab_size=32000, tie_embeddings=True, max_seq=2048)
+
+VARIANTS = {
+    "base_b4": dict(cfg=BASE, batch=4),
+    "b8": dict(cfg=BASE, batch=8),
+    "b16": dict(cfg=BASE, batch=16),
+    "dots_b4": dict(cfg=dataclasses.replace(BASE, remat_policy="dots"),
+                    batch=4),
+    "dots_b8": dict(cfg=dataclasses.replace(BASE, remat_policy="dots"),
+                    batch=8),
+    "dots_b16": dict(cfg=dataclasses.replace(BASE, remat_policy="dots"),
+                     batch=16),
+    "noremat_b8": dict(cfg=dataclasses.replace(BASE, remat=False), batch=8),
+    "blk256_b8": dict(cfg=dataclasses.replace(BASE, attn_block=256), batch=8),
+    "blk1024_b8": dict(cfg=dataclasses.replace(BASE, attn_block=1024),
+                       batch=8),
+    "blk1024_b4": dict(cfg=dataclasses.replace(BASE, attn_block=1024),
+                       batch=4),
+    "blk2048_b8": dict(cfg=dataclasses.replace(BASE, attn_block=2048),
+                       batch=8),
+    "dots_blk1024_b8": dict(
+        cfg=dataclasses.replace(BASE, attn_block=1024, remat_policy="dots"),
+        batch=8),
+    "noremat_blk1024_b8": dict(
+        cfg=dataclasses.replace(BASE, attn_block=1024, remat=False),
+        batch=8),
+}
+
+
+def run_variant(name, cfg, batch, seq=2048, steps=10):
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=-1), devices=jax.devices()[:1])
+    rules = ShardingRules()
+    opt = OptimizerConfig(warmup_steps=1, decay_steps=1000).make()
+    with jax.sharding.set_mesh(mesh):
+        state, _ = init_train_state(
+            lambda key: llama.init_params(cfg, key),
+            llama.param_logical_axes(cfg), opt, mesh, rules,
+            jax.random.key(0))
+        step_fn = make_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg, rules), opt, mesh, rules)
+        tokens = jax.random.randint(
+            jax.random.key(1), (batch, seq), 0, cfg.vocab_size,
+            dtype=jnp.int32)
+        b = {"tokens": tokens}
+        t_c0 = time.perf_counter()
+        state, m = step_fn(state, b)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t_c0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step_fn(state, b)
+        loss = float(m["loss"])
+        dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    mfu = cfg.flops_per_token(seq) * tps / peak_flops(jax.devices()[0])
+    return {"variant": name, "mfu_pct": round(mfu * 100, 2),
+            "tokens_per_sec": round(tps, 1), "step_s": round(dt / steps, 4),
+            "compile_s": round(compile_s, 1), "batch": batch,
+            "loss": round(loss, 4)}
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    for name in names:
+        try:
+            res = run_variant(name, **VARIANTS[name])
+        except Exception as e:  # noqa: BLE001 — sweep keeps going on OOM
+            res = {"variant": name, "error": str(e)[:200]}
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,6 +211,69 @@ class TestActors:
             rt.get(v.ping.remote(), timeout=10)
 
 
+class TestAsyncActors:
+    def test_async_methods_interleave(self, rt):
+        @rt.remote(num_cpus=0)
+        class AsyncActor:
+            async def slow(self, i):
+                import asyncio
+                await asyncio.sleep(0.3)
+                return i
+
+        a = AsyncActor.remote()
+        t0 = time.perf_counter()
+        out = rt.get([a.slow.remote(i) for i in range(8)], timeout=30)
+        assert out == list(range(8))
+        # 8 × 0.3 s sleeps must overlap on the actor's event loop
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_async_waiters_exceeding_thread_pool(self, rt):
+        """Calls that await an event set by a LATER call must not exhaust
+        the executor pool (async calls never park a pool thread)."""
+
+        @rt.remote(num_cpus=0)
+        class Gate:
+            def __init__(self):
+                import asyncio
+                self.event = asyncio.Event()
+
+            async def wait_open(self):
+                await self.event.wait()
+                return "opened"
+
+            async def open(self):
+                self.event.set()
+                return True
+
+        g = Gate.remote()
+        waiters = [g.wait_open.remote() for _ in range(80)]  # > pool size
+        time.sleep(0.3)
+        assert rt.get(g.open.remote(), timeout=20)
+        assert rt.get(waiters, timeout=30) == ["opened"] * 80
+
+    def test_sync_methods_of_async_actor_serialize(self, rt):
+        """High async concurrency must not let plain (sync) methods race:
+        they serialize, as asyncio-actor sync methods do in the reference."""
+
+        @rt.remote(num_cpus=0)
+        class Mixed:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                before = self.n
+                time.sleep(0.001)  # widen the race window
+                self.n = before + 1
+                return self.n
+
+            async def anoop(self):
+                return True
+
+        m = Mixed.remote()
+        rt.get([m.incr.remote() for _ in range(50)], timeout=60)
+        assert rt.get(m.incr.remote(), timeout=30) == 51
+
+
 class TestCluster:
     def test_cluster_resources(self, rt):
         total = rt.cluster_resources()
