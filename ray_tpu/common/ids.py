@@ -194,8 +194,8 @@ class ObjectID(BaseID):
 class _Counter:
     """Thread-safe monotonically increasing counter."""
 
-    def __init__(self):
-        self._value = 0
+    def __init__(self, start: int = 0):
+        self._value = start
         self._lock = threading.Lock()
 
     def next(self) -> int:

@@ -218,6 +218,25 @@ class MemoryStore:
         except OSError:
             return None
 
+    def value_meta_blocking(self, object_id: ObjectID,
+                            timeout: Optional[float]):
+        """Wait for readiness, then report {size|error|location} WITHOUT
+        restoring a spilled value (the chunk path reads it from disk)."""
+        ready, _ = self.wait_ready([object_id], 1, timeout)
+        if not ready:
+            return None
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            if e.error is not None:
+                return {"error": e.error}
+            if e.value is not None or e.spilled_path is not None:
+                return {"size": e.size}
+            if e.location is not None:
+                return {"location": e.location}
+            return {}
+
     def peek_location(self, object_id: ObjectID):
         """Location of a ready entry WITHOUT restoring a spilled value
         (used on free paths, where restoring would be wasted I/O)."""

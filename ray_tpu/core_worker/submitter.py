@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import pickle
+import threading
 import traceback
 from typing import Dict, List, Optional, Tuple
 
@@ -178,10 +179,17 @@ class ActorTaskSubmitter:
         self._death_error: Optional[Exception] = None
         self._pump_scheduled = False
         self._resolving = False
+        self._seq_lock = threading.Lock()
 
     def next_seq(self) -> int:
-        self._seq += 1
-        return self._seq
+        # Called from arbitrary caller threads (e.g. a server fanning out
+        # concurrent calls): an unsynchronized += here mints DUPLICATE
+        # sequence numbers, and the executee's dedup cache then replays the
+        # first call's reply for the second — whose return refs are never
+        # stored, hanging the caller forever.
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
 
     def submit(self, spec: TaskSpec):
         self._io.loop.call_soon_threadsafe(self._enqueue, spec)
@@ -262,11 +270,16 @@ class ActorTaskSubmitter:
 
     async def _push(self, spec: TaskSpec):
         client = self._client
+        logger.debug("PUSH seq=%d task=%s", spec.sequence_number,
+                     spec.task_id.hex()[:8])
         try:
             reply = await client.call_async("push_task", spec=pickle.dumps(spec), timeout=None)
         except Exception as e:  # noqa: BLE001 - actor worker died / restarting
+            logger.debug("PUSH FAIL seq=%d: %r", spec.sequence_number, e)
             await self._on_connection_failure(e)
             return
+        logger.debug("REPLY seq=%d results=%d", spec.sequence_number,
+                     len(reply.get("results", {})))
         self._inflight.pop(spec.sequence_number, None)
         self._cw.store_task_reply(spec, reply, self._address)
 

@@ -66,14 +66,13 @@ MODE_WORKER = "worker"
 
 
 class _TaskContext:
-    """Per-execution context backed by contextvars: isolated per pool thread
+    """Current-task binding backed by a contextvar: isolated per pool thread
     (sync tasks) AND per asyncio task (async actor calls interleaving on one
-    loop thread) — a threading.local would alias every interleaved coroutine
-    on the actor loop to one mutable record, minting colliding object IDs."""
+    loop thread). Child-task/put INDEX counters deliberately do NOT live
+    here — they are shared per parent task on the CoreWorker so concurrent
+    contexts never mint colliding IDs."""
 
     _task_id = contextvars.ContextVar("rt_task_id", default=None)
-    _task_index = contextvars.ContextVar("rt_task_index", default=0)
-    _put_index = contextvars.ContextVar("rt_put_index", default=0)
 
     @property
     def task_id(self) -> Optional[TaskID]:
@@ -82,22 +81,6 @@ class _TaskContext:
     @task_id.setter
     def task_id(self, v) -> None:
         self._task_id.set(v)
-
-    @property
-    def task_index(self) -> int:
-        return self._task_index.get()
-
-    @task_index.setter
-    def task_index(self, v) -> None:
-        self._task_index.set(v)
-
-    @property
-    def put_index(self) -> int:
-        return self._put_index.get()
-
-    @put_index.setter
-    def put_index(self, v) -> None:
-        self._put_index.set(v)
 
 
 class CoreWorker:
@@ -155,6 +138,8 @@ class CoreWorker:
         self._ctx = _TaskContext()
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._actor_counter = _Counter()
+        self._index_counters: Dict[Any, _Counter] = {}
+        self._index_lock = threading.Lock()
 
         # ownership state (owner side)
         self.lineage: Dict[ObjectID, TaskSpec] = {}
@@ -254,13 +239,40 @@ class CoreWorker:
     def current_task_id(self) -> TaskID:
         return self._ctx.task_id or self._driver_task_id
 
+    # Child-task and put indexes are shared PER PARENT TASK across every
+    # thread and asyncio task in the process. Per-thread/per-context
+    # counters would restart at 0 in each caller thread, minting IDENTICAL
+    # TaskIDs/ObjectIDs for concurrent submissions under the same parent
+    # (e.g. a server fanning out actor calls from a thread pool) — the
+    # first-write-wins memory store then silently cross-wires replies.
+    _INDEX_COUNTER_CAP = 8192
+
+    def _index_counter(self, kind: str) -> _Counter:
+        key = (self.current_task_id(), kind)
+        with self._index_lock:
+            c = self._index_counters.get(key)
+            if c is None:
+                if len(self._index_counters) >= self._INDEX_COUNTER_CAP:
+                    # insertion-ordered dict: evict the oldest half. A
+                    # still-running task whose counter is evicted gets a
+                    # fresh one below — the random starting offset keeps its
+                    # new indexes disjoint from the old ones.
+                    for k in list(self._index_counters)[
+                            : self._INDEX_COUNTER_CAP // 2]:
+                        del self._index_counters[k]
+                import random as _random
+
+                # 28 bits: fits the 4-byte object-index space (put indexes
+                # offset by PUT_INDEX_BASE = 2^31) with headroom
+                c = _Counter(start=_random.getrandbits(28))
+                self._index_counters[key] = c
+            return c
+
     def next_task_index(self) -> int:
-        self._ctx.task_index += 1
-        return self._ctx.task_index
+        return self._index_counter("task").next()
 
     def next_put_index(self) -> int:
-        self._ctx.put_index += 1
-        return self._ctx.put_index
+        return self._index_counter("put").next()
 
     # ---------------------------------------------------------- serialization
     @staticmethod
@@ -894,24 +906,29 @@ class CoreWorker:
         h_object_info (holder-facing; reports size for the chunked pull)."""
         oid = ObjectID(object_id)
         loop = asyncio.get_running_loop()
-        entry = await loop.run_in_executor(
-            self._executor, lambda: self._blocking_entry(oid, timeout))
-        if entry is None:
+        meta = await loop.run_in_executor(
+            self._executor,
+            lambda: self.memory_store.value_meta_blocking(oid, timeout))
+        if meta is None:
             return {"error": pickle.dumps(ObjectLostError(oid, "unknown object"))}
-        if entry.error is not None:
-            return {"error": entry.error}
-        if entry.value is not None:
+        if meta.get("error") is not None:
+            return {"error": meta["error"]}
+        size = meta.get("size")
+        if size is not None:
             # Large values are never shipped as one frame (reference
-            # object_manager splits at 5 MiB chunks, object_manager.h:119).
-            if len(entry.value) > GLOBAL_CONFIG.get(
-                    "object_store_chunk_size_bytes"):
+            # object_manager splits at 5 MiB chunks, object_manager.h:119);
+            # spilled values report their size WITHOUT a restore — chunks
+            # are served straight from the spill file by read_range.
+            if size > GLOBAL_CONFIG.get("object_store_chunk_size_bytes"):
                 if advertise_self:
-                    return {"location": self.server.address,
-                            "size": len(entry.value)}
-                return {"size": len(entry.value)}
-            return {"value": entry.value}
-        if entry.location is not None:
-            return {"location": entry.location}
+                    return {"location": self.server.address, "size": size}
+                return {"size": size}
+            value = self.memory_store.read_range(oid, 0, size)
+            if value is not None:
+                return {"value": value}
+            return {"error": pickle.dumps(ObjectLostError(oid, "value lost"))}
+        if meta.get("location") is not None:
+            return {"location": meta["location"]}
         return {"error": pickle.dumps(ObjectLostError(oid, "empty entry"))}
 
     async def h_get_object(self, object_id: bytes, timeout: float = 60.0):
@@ -1082,7 +1099,8 @@ class CoreWorker:
         caller = (task.caller_worker_id.binary()
                   if task.caller_worker_id is not None else b"?")
         seq = task.sequence_number
-        cached = self._seq_begin(caller, seq, ordered=False)
+        cached = self._seq_begin(caller, seq, ordered=False,
+                                 method=task.actor_method_name)
         if cached is not None:
             return cached
         sem = self._async_call_sem
@@ -1100,10 +1118,8 @@ class CoreWorker:
 
                 async def run_with_ctx():
                     # Runs as its own asyncio task on the actor loop: the
-                    # contextvar sets are isolated to this call.
+                    # contextvar set is isolated to this call.
                     self._ctx.task_id = task.task_id
-                    self._ctx.task_index = 0
-                    self._ctx.put_index = 0
                     return await method(*args, **kwargs)
 
                 result = await asyncio.wrap_future(
@@ -1196,8 +1212,6 @@ class CoreWorker:
 
     def _execute_fn_task(self, task: TaskSpec) -> dict:
         self._ctx.task_id = task.task_id
-        self._ctx.task_index = 0
-        self._ctx.put_index = 0
         try:
             fn = cloudpickle.loads(task.serialized_func)
             args, kwargs = self._resolve_args(task.args)
@@ -1222,12 +1236,16 @@ class CoreWorker:
                 self._async_loop = loop
             return loop
 
-    def _seq_begin(self, caller: bytes, seq: int, ordered: bool):
+    def _seq_begin(self, caller: bytes, seq: int, ordered: bool,
+                   method: str = "?"):
         """Dedup/replay gate shared by the sync and async actor paths.
         Returns a cached reply for duplicates, else None (proceed)."""
         with self._actor_seq_cv:
             st = self._actor_seq_state.setdefault(
                 caller, {"next": 1, "replies": {}})
+            logger.debug("SEQB caller=%s seq=%d m=%s cached=%s",
+                         caller[:4].hex(), seq, method,
+                         seq in st["replies"])
             if seq in st["replies"]:
                 return st["replies"][seq]  # duplicate: replay
             if seq < st["next"]:
@@ -1267,7 +1285,8 @@ class CoreWorker:
         caller = (task.caller_worker_id.binary()
                   if task.caller_worker_id is not None else b"?")
         seq = task.sequence_number
-        cached = self._seq_begin(caller, seq, ordered)
+        cached = self._seq_begin(caller, seq, ordered,
+                                 method=task.actor_method_name)
         if cached is not None:
             return cached
         concurrency.acquire()
@@ -1289,8 +1308,6 @@ class CoreWorker:
                         # with them on a pool thread.
                         async def run_with_ctx():
                             self._ctx.task_id = task.task_id
-                            self._ctx.task_index = 0
-                            self._ctx.put_index = 0
                             r = method(*args, **kwargs)
                             if inspect.iscoroutine(r):
                                 r = await r

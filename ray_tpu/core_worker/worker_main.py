@@ -19,6 +19,12 @@ def main():
         level=os.environ.get("RT_LOG_LEVEL", "INFO"),
         format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
     )
+    # SIGUSR1 → all-thread stack dump to the worker log (stderr), the
+    # equivalent of the reference's `ray stack` debugging entry point.
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
     raylet_host, _, raylet_port = os.environ["RT_RAYLET_ADDR"].partition(":")
     gcs_host, _, gcs_port = os.environ["RT_GCS_ADDR"].partition(":")

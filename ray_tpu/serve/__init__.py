@@ -13,9 +13,12 @@ from ray_tpu.serve.api import (  # noqa: F401
     delete,
     deployment,
     get_deployment_handle,
+    proxy_address,
     run,
     shutdown,
+    start,
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.proxy import Request, Response  # noqa: F401

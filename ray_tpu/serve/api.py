@@ -8,11 +8,14 @@ from typing import Any, Dict, Optional
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import Application, make_deployment
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import PROXY_NAME, ProxyActor, Request, Response
 
 deployment = make_deployment
 
 _lock = threading.Lock()
 _controller = None
+_proxy = None
+_proxy_addr = None
 
 
 def _get_or_create_controller():
@@ -66,6 +69,46 @@ def run(app: Application, *, name: Optional[str] = None,
     return handle
 
 
+def start(http_host: str = "127.0.0.1", http_port: int = 0,
+          grpc_port: Optional[int] = 0) -> Dict[str, Any]:
+    """Start the ingress proxy (HTTP + optional gRPC); idempotent.
+    Returns the bound addresses (reference: serve.start / ProxyActor)."""
+    global _proxy, _proxy_addr
+    import ray_tpu
+
+    import time as _time
+
+    with _lock:
+        if _proxy_addr is not None:
+            return dict(_proxy_addr)
+    _get_or_create_controller()
+    with _lock:
+        if _proxy is None:
+            try:
+                _proxy = ray_tpu.get_actor(PROXY_NAME)
+            except Exception:  # noqa: BLE001 — not started yet
+                remote_cls = ray_tpu.remote(ProxyActor)
+                _proxy = remote_cls.options(
+                    name=PROXY_NAME, max_concurrency=64).remote(
+                        http_host, http_port, grpc_port)
+        proxy = _proxy
+    # start() is idempotent on the actor; poll until the listener is bound
+    # so a port of 0 (pre-bind) is never cached or returned.
+    addr = ray_tpu.get([proxy.start.remote()], timeout=60.0)[0]
+    deadline = _time.monotonic() + 60.0
+    while not addr.get("http_port") and _time.monotonic() < deadline:
+        _time.sleep(0.1)
+        addr = ray_tpu.get([proxy.address.remote()], timeout=30.0)[0]
+    with _lock:
+        if _proxy_addr is None and addr.get("http_port"):
+            _proxy_addr = addr
+    return dict(addr)
+
+
+def proxy_address() -> Optional[Dict[str, Any]]:
+    return dict(_proxy_addr) if _proxy_addr else None
+
+
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name, _get_or_create_controller())
 
@@ -83,9 +126,17 @@ def delete(name: str):
 
 
 def shutdown():
-    global _controller
+    global _controller, _proxy, _proxy_addr
     import ray_tpu
 
+    with _lock:
+        proxy, _proxy, _proxy_addr = _proxy, None, None
+    if proxy is not None:
+        try:
+            ray_tpu.get([proxy.stop.remote()], timeout=10.0)
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001
+            pass
     with _lock:
         if _controller is None:
             return

@@ -9,6 +9,7 @@ autoscales between min/max replicas on observed ongoing-request load.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
 import time
@@ -60,6 +61,7 @@ class ServeController:
     """Target-state reconciler (runs as a detached-ish named actor)."""
 
     RECONCILE_INTERVAL_S = 0.25
+    PING_FAILURE_THRESHOLD = 3
 
     def __init__(self):
         # name -> {"deployment": Deployment, "blob": bytes, "args", "kwargs",
@@ -67,6 +69,9 @@ class ServeController:
         self._apps: Dict[str, dict] = {}
         self._lock = threading.RLock()
         self._version = 0
+        self._route_version = 0
+        self._draining: List[dict] = []  # {"replica", "since"}
+        self._ping_failures: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
@@ -79,6 +84,7 @@ class ServeController:
 
         import ray_tpu
 
+        del ray_tpu  # draining handles teardown; no direct kills here
         dep = cloudpickle.loads(deployment_blob)
         with self._lock:
             prev = self._apps.get(name)
@@ -87,18 +93,19 @@ class ServeController:
                 "cls_blob": cls_blob,
                 "args": init_args,
                 "kwargs": init_kwargs,
-                # Redeploy REPLACES replicas: old ones run old code.
+                # Redeploy REPLACES replicas: old ones run old code until
+                # their in-flight requests finish (graceful drain,
+                # reference: deployment_state.py graceful_shutdown).
                 "replicas": [],
                 "target": (dep.autoscaling_config.min_replicas
                            if dep.autoscaling_config else dep.num_replicas),
             }
+            if prev:
+                for r in prev["replicas"]:
+                    self._draining.append(
+                        {"replica": r, "since": time.monotonic()})
             self._version += 1
-        if prev:
-            for r in prev["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+            self._route_version += 1
         return True
 
     def delete_app(self, name: str) -> bool:
@@ -107,6 +114,7 @@ class ServeController:
         with self._lock:
             app = self._apps.pop(name, None)
             self._version += 1
+            self._route_version += 1
         if app:
             for r in app["replicas"]:
                 try:
@@ -131,6 +139,28 @@ class ServeController:
             return (self._version, list(app["replicas"]),
                     app["deployment"].max_ongoing_requests)
 
+    def get_route_table(self):
+        """(version, {route_prefix: app_name}) for the ingress proxies."""
+        with self._lock:
+            table = {}
+            for name, app in self._apps.items():
+                prefix = app["deployment"].route_prefix or f"/{name}"
+                table[prefix] = name
+            return self._route_version, table
+
+    async def listen_for_route_table(self, known_version: int,
+                                     timeout_s: float = 15.0):
+        """Long-poll (reference long_poll.py): returns when the route table
+        version moves past ``known_version`` or after ``timeout_s``."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._route_version != known_version:
+                    return self._route_version
+            await asyncio.sleep(0.1)
+        with self._lock:
+            return self._route_version
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -152,21 +182,66 @@ class ServeController:
                 logger.error("reconcile error:\n%s", traceback.format_exc())
             self._stop.wait(self.RECONCILE_INTERVAL_S)
 
+    DRAIN_TIMEOUT_S = 10.0
+
+    def _drain_old_replicas(self):
+        import ray_tpu
+
+        with self._lock:
+            draining = list(self._draining)
+        still = []
+        for d in draining:
+            r, since = d["replica"], d["since"]
+            done = False
+            try:
+                m = ray_tpu.get([r.get_metrics.remote()], timeout=3.0)[0]
+                done = m["ongoing"] <= 0
+            except Exception:  # noqa: BLE001 — dead already
+                done = True
+            if done or time.monotonic() - since > self.DRAIN_TIMEOUT_S:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            else:
+                still.append(d)
+        with self._lock:
+            self._draining = still
+
     def _reconcile_once(self):
         import ray_tpu
 
+        self._drain_old_replicas()
         with self._lock:
             apps = list(self._apps.items())
         for name, app in apps:
             dep = app["deployment"]
-            # health check + prune dead replicas
+            # Health check with a consecutive-failure threshold (reference
+            # gcs_health_check_manager failure_threshold): one slow ping
+            # under load must not get a busy replica killed.
             alive = []
             for r in app["replicas"]:
+                key = r._actor_id.hex()
                 try:
-                    ray_tpu.get([r.ping.remote()], timeout=5.0)
+                    ray_tpu.get([r.ping.remote()], timeout=10.0)
+                    self._ping_failures.pop(key, None)
                     alive.append(r)
-                except Exception:  # noqa: BLE001 — replica died
-                    logger.warning("replica of %s died; will replace", name)
+                except Exception:  # noqa: BLE001 — slow or dead
+                    fails = self._ping_failures.get(key, 0) + 1
+                    self._ping_failures[key] = fails
+                    if fails < self.PING_FAILURE_THRESHOLD:
+                        alive.append(r)
+                    else:
+                        logger.warning(
+                            "replica of %s failed %d health checks; "
+                            "replacing", name, fails)
+                        self._ping_failures.pop(key, None)
+                        # drain rather than drop: if it is merely wedged
+                        # on a long request it finishes then dies; the
+                        # drain timeout bounds a truly-hung one
+                        with self._lock:
+                            self._draining.append(
+                                {"replica": r, "since": time.monotonic()})
             changed = len(alive) != len(app["replicas"])
 
             if dep.autoscaling_config is not None and alive:
@@ -177,11 +252,12 @@ class ServeController:
                 alive.append(self._start_replica(name, app))
                 changed = True
             while len(alive) > app["target"]:
+                # Graceful downscale: drain, don't kill mid-request
+                # (reference deployment_state graceful_shutdown).
                 victim = alive.pop()
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:  # noqa: BLE001
-                    pass
+                with self._lock:
+                    self._draining.append(
+                        {"replica": victim, "since": time.monotonic()})
                 changed = True
             with self._lock:
                 if name in self._apps:

@@ -30,6 +30,9 @@ class Deployment:
     ray_actor_options: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # HTTP ingress mount point (reference: Deployment.route_prefix);
+    # None → "/<name>" at serve.run time.
+    route_prefix: Optional[str] = None
 
     def options(self, **kwargs) -> "Deployment":
         return dataclasses.replace(self, **kwargs)
@@ -58,7 +61,8 @@ class _FunctionReplica:
 def make_deployment(func_or_class=None, *, name: Optional[str] = None,
                     num_replicas: int = 1, max_ongoing_requests: int = 8,
                     ray_actor_options: Optional[dict] = None,
-                    autoscaling_config: Optional[dict] = None) -> Any:
+                    autoscaling_config: Optional[dict] = None,
+                    route_prefix: Optional[str] = None) -> Any:
     def wrap(target):
         import functools
 
@@ -76,6 +80,7 @@ def make_deployment(func_or_class=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=asc,
+            route_prefix=route_prefix,
         )
 
     if func_or_class is not None:

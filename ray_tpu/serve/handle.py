@@ -41,7 +41,7 @@ class _RouterState:
         if not force and fresh:
             return
         version, replicas, max_ongoing = ray_tpu.get(
-            [self.controller.get_replicas.remote(self.name)])[0]
+            [self.controller.get_replicas.remote(self.name)], timeout=30.0)[0]
         with self.lock:
             if version != self.version:
                 self.version = version

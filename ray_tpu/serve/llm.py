@@ -57,6 +57,8 @@ class LLMEngine:
         self._key = jax.random.key(seed)
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: Dict[str, dict] = {}      # streaming submit/poll
+        self._pending_lock = threading.Lock()
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._last_token = np.zeros(num_slots, np.int32)
         self._stop = threading.Event()
@@ -84,6 +86,43 @@ class LLMEngine:
         if req.error:
             raise RuntimeError(req.error)
         return req.output
+
+    def submit(self, prompt: List[int], max_tokens: int = 64,
+               temperature: float = 0.0,
+               eos_token: Optional[int] = None) -> str:
+        """Enqueue without blocking; poll with :meth:`poll` (drives the
+        proxy's SSE token streaming)."""
+        import uuid
+
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_tokens > self.max_seq:
+            raise ValueError("prompt + max_tokens exceeds max_seq")
+        req = _Request(list(prompt), max_tokens, temperature, eos_token)
+        rid = uuid.uuid4().hex
+        with self._pending_lock:
+            self._pending[rid] = {"req": req, "sent": 0}
+        self._queue.put(req)
+        return rid
+
+    def poll(self, request_id: str) -> Dict[str, Any]:
+        """New tokens since the last poll + done flag. The entry is dropped
+        once fully drained after completion."""
+        with self._pending_lock:
+            ent = self._pending.get(request_id)
+            if ent is None:
+                return {"chunks": [], "done": True}
+            ent["last_poll"] = time.monotonic()
+            req = ent["req"]
+            out = list(req.output)   # snapshot (engine thread appends)
+            chunks = out[ent["sent"]:]
+            ent["sent"] = len(out)
+            finished = req.done.is_set() and ent["sent"] >= len(req.output)
+            if finished:
+                del self._pending[request_id]
+            if req.error:
+                raise RuntimeError(req.error)
+            return {"chunks": chunks, "done": finished}
 
     def stats(self) -> Dict[str, Any]:
         return {"steps": self._steps,
@@ -161,9 +200,27 @@ class LLMEngine:
                         req.done.set()
                         self._slots[slot] = None
 
+    _PENDING_TTL_S = 180.0
+
+    def _sweep_pending(self):
+        """Drop submit/poll entries whose client stopped polling (stream
+        abandoned mid-generation) so replicas don't leak per-request state."""
+        now = time.monotonic()
+        with self._pending_lock:
+            stale = [rid for rid, ent in self._pending.items()
+                     if now - ent.get("last_poll",
+                                      ent["req"].enqueued_at)
+                     > self._PENDING_TTL_S]
+            for rid in stale:
+                del self._pending[rid]
+
     def _loop_once(self):
         import jax.numpy as jnp
 
+        self._steps_since_sweep = getattr(self, "_steps_since_sweep", 0) + 1
+        if self._steps_since_sweep >= 500:
+            self._steps_since_sweep = 0
+            self._sweep_pending()
         self._admit()
         active = np.array([s is not None for s in self._slots])
         if not active.any():
@@ -198,11 +255,34 @@ class LLMServer:
         self.engine = LLMEngine(model=model, num_slots=num_slots,
                                 max_seq=max_seq, **engine_kwargs)
 
-    def __call__(self, prompt: List[int], max_tokens: int = 64,
-                 temperature: float = 0.0,
-                 eos_token: Optional[int] = None) -> List[int]:
-        return self.engine.generate(prompt, max_tokens, temperature,
-                                    eos_token)
+    @staticmethod
+    def _parse(prompt_or_request, kwargs: Dict[str, Any]):
+        """Accept either direct args (handle calls) or a proxy Request whose
+        JSON body is {"prompt": [...], "max_tokens": n, ...}."""
+        from ray_tpu.serve.proxy import Request
+
+        if isinstance(prompt_or_request, Request):
+            body = prompt_or_request.json() or {}
+            merged = {"max_tokens": body.get("max_tokens", 64),
+                      "temperature": body.get("temperature", 0.0),
+                      "eos_token": body.get("eos_token")}
+            return body.get("prompt", []), merged
+        return prompt_or_request, kwargs
+
+    def __call__(self, prompt_or_request, **kwargs) -> List[int]:
+        prompt, kw = self._parse(prompt_or_request, kwargs)
+        return self.engine.generate(
+            prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
+            kw.get("eos_token"))
+
+    def submit(self, prompt_or_request, **kwargs) -> str:
+        prompt, kw = self._parse(prompt_or_request, kwargs)
+        return self.engine.submit(
+            prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
+            kw.get("eos_token"))
+
+    def poll(self, request_id: str) -> Dict[str, Any]:
+        return self.engine.poll(request_id)
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -1,0 +1,410 @@
+"""Serve ingress proxies: HTTP and gRPC.
+
+Reference: ``python/ray/serve/_private/proxy.py`` (``HTTPProxy:696`` ASGI,
+``gRPCProxy:520``, ``ProxyActor:1008``) with route-table push via long-poll
+(``long_poll.py``). Here the proxy is an async actor:
+
+- HTTP/1.1 server on asyncio streams (no external web framework): requests
+  are parsed into a picklable :class:`Request`, routed by longest matching
+  route prefix to a :class:`DeploymentHandle`, and the replica's return
+  value is rendered (str/bytes/dict/Response). ``Accept: text/event-stream``
+  switches to the submit/poll streaming protocol (SSE) for deployments that
+  implement it (e.g. the LLM server streams tokens).
+- gRPC server (grpc.aio, generic handler — no compiled protos): unary call
+  to ``/<app>/<method>`` with a pickled ``(args, kwargs)`` payload, reply is
+  the pickled return value.
+- The route table is version-stamped; the proxy long-polls the controller
+  (``listen_for_route_table``) so redeploys propagate promptly without a
+  hot refresh loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import pickle
+import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+@dataclasses.dataclass
+class Request:
+    """Picklable HTTP request passed to deployment callables."""
+
+    method: str
+    path: str
+    query: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    @property
+    def text(self) -> str:
+        return self.body.decode("utf-8", "replace")
+
+
+@dataclasses.dataclass
+class Response:
+    """Explicit response: deployments may return one for full control."""
+
+    body: Any = b""
+    status: int = 200
+    content_type: str = "application/octet-stream"
+    headers: Optional[Dict[str, str]] = None
+
+
+def _render(result: Any) -> Tuple[int, str, bytes, Dict[str, str]]:
+    """Map a deployment return value onto (status, content-type, body)."""
+    if isinstance(result, Response):
+        body = result.body
+        if isinstance(body, str):
+            body = body.encode()
+        elif not isinstance(body, (bytes, bytearray)):
+            body = json.dumps(body).encode()
+        return (result.status, result.content_type, bytes(body),
+                result.headers or {})
+    if isinstance(result, (bytes, bytearray)):
+        return 200, "application/octet-stream", bytes(result), {}
+    if isinstance(result, str):
+        return 200, "text/plain; charset=utf-8", result.encode(), {}
+    return 200, "application/json", json.dumps(result).encode(), {}
+
+
+class ProxyActor:
+    """Ingress actor: one per cluster by default (reference ProxyActor)."""
+
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 0,
+                 grpc_port: Optional[int] = None):
+        self._http_host = http_host
+        self._http_port = http_port
+        self._grpc_port = grpc_port
+        self._routes: Dict[str, Any] = {}       # route_prefix -> handle
+        self._route_version = -1
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._grpc_server = None
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="proxy")
+        self._started = asyncio.Event()
+        self._num_requests = 0
+
+    # -------------------------------------------------------------- control
+    async def start(self) -> Dict[str, Any]:
+        """Bind servers; returns the bound addresses. Idempotent: a second
+        caller racing the first gets the already-bound address."""
+        if self._server is not None:
+            await self._started.wait()
+            return self.address()
+        await self._refresh_routes()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._http_host, self._http_port)
+        self._http_port = self._server.sockets[0].getsockname()[1]
+        if self._grpc_port is not None:
+            await self._start_grpc()
+        asyncio.get_running_loop().create_task(self._route_poll_loop())
+        self._started.set()
+        logger.info("serve proxy: http on %s:%d grpc on %s",
+                    self._http_host, self._http_port, self._grpc_port)
+        return {"http_host": self._http_host, "http_port": self._http_port,
+                "grpc_port": self._grpc_port}
+
+    def address(self) -> Dict[str, Any]:
+        return {"http_host": self._http_host, "http_port": self._http_port,
+                "grpc_port": self._grpc_port}
+
+    def num_requests(self) -> int:
+        return self._num_requests
+
+    async def stop(self) -> bool:
+        if self._server is not None:
+            self._server.close()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=1.0)
+        return True
+
+    # ---------------------------------------------------------- route table
+    def _controller(self):
+        from ray_tpu.serve.api import _get_or_create_controller
+
+        return _get_or_create_controller()
+
+    async def _refresh_routes(self):
+        import ray_tpu
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        loop = asyncio.get_running_loop()
+        controller = self._controller()
+
+        def fetch():
+            return ray_tpu.get(
+                [controller.get_route_table.remote()], timeout=30.0)[0]
+
+        version, table = await loop.run_in_executor(self._pool, fetch)
+        if version != self._route_version:
+            self._routes = {
+                prefix: DeploymentHandle(app_name, controller)
+                for prefix, app_name in table.items()}
+            self._route_version = version
+
+    async def _route_poll_loop(self):
+        """Long-poll the controller: returns promptly on version change,
+        every ~15 s otherwise (reference long_poll.py)."""
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        controller = self._controller()
+        while self._server is not None and self._server.is_serving():
+            try:
+                version = self._route_version
+
+                def wait():
+                    return ray_tpu.get(
+                        [controller.listen_for_route_table.remote(version)],
+                        timeout=60.0)[0]
+
+                await loop.run_in_executor(self._pool, wait)
+                await self._refresh_routes()
+            except Exception:  # noqa: BLE001 — controller restarting
+                await asyncio.sleep(1.0)
+
+    def _match_route(self, path: str):
+        """Longest-prefix route match (reference route longest-prefix)."""
+        best = None
+        for prefix, handle in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, handle)
+        return best
+
+    # ------------------------------------------------------------- http
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _version = line.decode().split(" ", 2)
+                except ValueError:
+                    await self._write_simple(writer, 400, b"bad request line")
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    hline = await reader.readline()
+                    if hline in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = hline.decode().partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", "0") or "0")
+                body = await reader.readexactly(length) if length else b""
+                parsed = urllib.parse.urlsplit(target)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                req = Request(method=method.upper(), path=parsed.path,
+                              query=query, headers=headers, body=body)
+                keep_alive = headers.get("connection", "").lower() != "close"
+                await self._dispatch(req, writer)
+                if not keep_alive:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, req: Request, writer: asyncio.StreamWriter):
+        self._num_requests += 1
+        if req.path == "/-/routes":  # reference exposes the route table
+            table = {p: h._name for p, h in self._routes.items()}
+            await self._write_response(
+                writer, 200, "application/json", json.dumps(table).encode())
+            return
+        if req.path == "/-/healthz":
+            await self._write_response(writer, 200, "text/plain", b"ok")
+            return
+        match = self._match_route(req.path)
+        if match is None:
+            await self._refresh_routes()
+            match = self._match_route(req.path)
+        if match is None:
+            await self._write_simple(writer, 404, b"no matching route")
+            return
+        prefix, handle = match
+        if req.headers.get("accept") == "text/event-stream":
+            await self._dispatch_stream(req, handle, writer)
+            return
+        loop = asyncio.get_running_loop()
+
+        def call():
+            import ray_tpu
+            from ray_tpu.common.status import ActorDiedError
+
+            # A replica can die between routing and execution (downscale
+            # drain timeout, crash): retry on a fresh replica like the
+            # reference router does before surfacing an error.
+            for attempt in range(3):
+                ref = handle.remote(req)
+                try:
+                    return ray_tpu.get(ref, timeout=120.0)
+                except ActorDiedError:
+                    if attempt == 2:
+                        raise
+                    handle._state.refresh(force=True)
+
+        try:
+            result = await loop.run_in_executor(self._pool, call)
+        except Exception as e:  # noqa: BLE001 — replica/user error → 500
+            await self._write_response(
+                writer, 500, "text/plain",
+                f"deployment error: {e}".encode()[:4096])
+            return
+        status, ctype, body, extra = _render(result)
+        await self._write_response(writer, status, ctype, body, extra)
+
+    async def _dispatch_stream(self, req: Request, handle,
+                               writer: asyncio.StreamWriter):
+        """SSE streaming via the submit/poll protocol: the deployment
+        implements ``submit(request) -> req_id`` and ``poll(req_id) ->
+        {"chunks": [...], "done": bool}`` (the LLM server streams tokens
+        this way)."""
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        try:
+            req_id = await loop.run_in_executor(
+                self._pool, lambda: ray_tpu.get(
+                    handle.options("submit").remote(req), timeout=60.0))
+        except Exception as e:  # noqa: BLE001
+            await self._write_response(
+                writer, 500, "text/plain",
+                f"stream submit failed: {e}".encode()[:4096])
+            return
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"content-type: text/event-stream\r\n"
+                     b"cache-control: no-cache\r\n"
+                     b"transfer-encoding: chunked\r\n\r\n")
+        await writer.drain()
+        poll_handle = handle.options("poll")
+        try:
+            while True:
+                out = await loop.run_in_executor(
+                    self._pool, lambda: ray_tpu.get(
+                        poll_handle.remote(req_id), timeout=60.0))
+                for chunk in out.get("chunks", ()):
+                    payload = json.dumps(chunk).encode()
+                    await self._write_chunk(
+                        writer, b"data: " + payload + b"\n\n")
+                if out.get("done"):
+                    await self._write_chunk(writer, b"data: [DONE]\n\n")
+                    break
+                await asyncio.sleep(0.02)
+        except (ConnectionError, OSError):
+            return
+        except Exception as e:  # noqa: BLE001
+            try:
+                await self._write_chunk(
+                    writer, b"event: error\ndata: " + str(e).encode() + b"\n\n")
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except Exception:  # noqa: BLE001
+            pass
+
+    @staticmethod
+    async def _write_chunk(writer: asyncio.StreamWriter, data: bytes):
+        writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        await writer.drain()
+
+    @staticmethod
+    async def _write_response(writer: asyncio.StreamWriter, status: int,
+                              ctype: str, body: bytes,
+                              extra: Optional[Dict[str, str]] = None):
+        reason = {200: "OK", 404: "Not Found", 400: "Bad Request",
+                  500: "Internal Server Error"}.get(status, "OK")
+        head = [f"HTTP/1.1 {status} {reason}",
+                f"content-type: {ctype}",
+                f"content-length: {len(body)}"]
+        for k, v in (extra or {}).items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    @staticmethod
+    async def _write_simple(writer, status: int, msg: bytes):
+        await ProxyActor._write_response(writer, status, "text/plain", msg)
+
+    # ------------------------------------------------------------- grpc
+    async def _start_grpc(self):
+        """Generic unary gRPC ingress: /<app>/<method>, pickled payloads
+        (reference gRPCProxy:520 serves user protos; we stay proto-less)."""
+        import grpc
+
+        proxy = self
+
+        class Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                path = handler_call_details.method  # "/<app>/<method>"
+
+                async def unary(request_bytes, context):
+                    _, app, method = path.split("/", 2)
+                    handle = None
+                    for prefix, h in proxy._routes.items():
+                        if h._name == app or prefix.strip("/") == app:
+                            handle = h
+                            break
+                    if handle is None:
+                        await proxy._refresh_routes()
+                        for prefix, h in proxy._routes.items():
+                            if h._name == app or prefix.strip("/") == app:
+                                handle = h
+                                break
+                    if handle is None:
+                        # outside any try: abort signals by raising and must
+                        # not be re-wrapped as INTERNAL
+                        await context.abort(grpc.StatusCode.NOT_FOUND,
+                                            f"no deployment {app!r}")
+                    try:
+                        args, kwargs = pickle.loads(request_bytes) \
+                            if request_bytes else ((), {})
+                        loop = asyncio.get_running_loop()
+
+                        def call():
+                            import ray_tpu
+
+                            ref = handle.options(method).remote(
+                                *args, **kwargs)
+                            return ray_tpu.get(ref, timeout=120.0)
+
+                        result = await loop.run_in_executor(
+                            proxy._pool, call)
+                        return pickle.dumps(result)
+                    except Exception as e:  # noqa: BLE001
+                        await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b)
+
+        from grpc import aio as grpc_aio
+
+        self._grpc_server = grpc_aio.server()
+        self._grpc_server.add_generic_rpc_handlers((Generic(),))
+        bound = self._grpc_server.add_insecure_port(
+            f"{self._http_host}:{self._grpc_port or 0}")
+        self._grpc_port = bound
+        await self._grpc_server.start()

@@ -1,0 +1,208 @@
+"""Serve ingress tests: HTTP proxy, gRPC proxy, SSE streaming, redeploy.
+
+Reference behaviors covered: proxy.py HTTPProxy/gRPCProxy routing,
+long_poll.py route-table push, deployment draining on redeploy, and the
+LLM token-streaming path, all over real sockets against a live cluster.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def proxy_addr():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    addr = serve.start(http_port=0, grpc_port=0)
+    yield addr
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http(addr, path, data=None, headers=None, timeout=60):
+    url = f"http://{addr['http_host']}:{addr['http_port']}{path}"
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.headers.get_content_type(), resp.read()
+
+
+def test_http_roundtrip_and_routing(proxy_addr):
+    @serve.deployment(name="echo")
+    class Echo:
+        def __call__(self, request):
+            return {"path": request.path, "method": request.method,
+                    "q": request.query, "body": request.text}
+
+    serve.run(Echo.bind())
+    status, ctype, body = _http(proxy_addr, "/echo/sub?x=1",
+                                data=b"hello", headers={})
+    assert status == 200 and ctype == "application/json"
+    out = json.loads(body)
+    assert out == {"path": "/echo/sub", "method": "POST",
+                   "q": {"x": "1"}, "body": "hello"}
+
+    # route table endpoint (reference /-/routes)
+    status, _, body = _http(proxy_addr, "/-/routes")
+    assert status == 200 and json.loads(body).get("/echo") == "echo"
+    serve.delete("echo")
+
+
+def test_http_404_and_text(proxy_addr):
+    @serve.deployment(name="txt", route_prefix="/text")
+    class Txt:
+        def __call__(self, request):
+            return "plain-text-reply"
+
+    serve.run(Txt.bind())
+    status, ctype, body = _http(proxy_addr, "/text")
+    assert status == 200 and body == b"plain-text-reply"
+    assert ctype.startswith("text/plain")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http(proxy_addr, "/nosuchroute")
+    assert e.value.code == 404
+    serve.delete("txt")
+
+
+def test_grpc_proxy(proxy_addr):
+    import pickle
+
+    import grpc
+
+    @serve.deployment(name="adder")
+    class Adder:
+        def add(self, a, b):
+            return a + b
+
+        def __call__(self, a):
+            return a
+
+    serve.run(Adder.bind())
+    chan = grpc.insecure_channel(
+        f"{proxy_addr['http_host']}:{proxy_addr['grpc_port']}")
+    stub = chan.unary_unary("/adder/add",
+                            request_serializer=lambda b: b,
+                            response_deserializer=lambda b: b)
+    reply = stub(pickle.dumps(((3, 4), {})), timeout=60)
+    assert pickle.loads(reply) == 7
+    chan.close()
+    serve.delete("adder")
+
+
+def test_sse_streaming_llm_tokens(proxy_addr):
+    """curl-style SSE: proxy → LLM deployment streams tokens incrementally
+    via the submit/poll protocol."""
+    from ray_tpu.serve.llm import LLMServer
+
+    dep = serve.deployment(LLMServer, name="llm",
+                           max_ongoing_requests=4)
+    serve.run(dep.bind("debug"), name="llm")
+
+    url = (f"http://{proxy_addr['http_host']}:{proxy_addr['http_port']}"
+           f"/llm")
+    body = json.dumps({"prompt": [1, 2, 3], "max_tokens": 6}).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Accept": "text/event-stream"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers.get_content_type() == "text/event-stream"
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+            if line == "data: [DONE]":
+                break
+    assert events[-1] == "[DONE]"
+    tokens = [json.loads(e) for e in events[:-1]]
+    assert len(tokens) == 6 and all(isinstance(t, int) for t in tokens)
+
+    # non-streaming POST on the same deployment still works
+    status, _, body = _http(
+        proxy_addr, "/llm",
+        data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 4}).encode())
+    assert status == 200 and len(json.loads(body)) == 4
+    serve.delete("llm")
+
+
+def test_redeploy_updates_routes_and_drains(proxy_addr):
+    @serve.deployment(name="ver")
+    class V1:
+        def __call__(self, request):
+            return "v1"
+
+    serve.run(V1.bind())
+    assert _http(proxy_addr, "/ver")[2] == b"v1"
+
+    @serve.deployment(name="ver")
+    class V2:
+        def __call__(self, request):
+            return "v2"
+
+    serve.run(V2.bind())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if _http(proxy_addr, "/ver")[2] == b"v2":
+            break
+        time.sleep(0.2)
+    assert _http(proxy_addr, "/ver")[2] == b"v2"
+    serve.delete("ver")
+
+
+def test_autoscale_under_http_load(proxy_addr):
+    """Sustained concurrent HTTP load scales replicas up, then back down
+    when idle (VERDICT item 4 'autoscale under sustained HTTP load')."""
+    import threading
+
+    @serve.deployment(name="slow", autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0})
+    class Slow:
+        def __call__(self, request):
+            time.sleep(0.4)
+            return "done"
+
+    serve.run(Slow.bind())
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                _http(proxy_addr, "/slow", data=b"x", timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 60
+        scaled_up = False
+        while time.monotonic() < deadline:
+            st = serve.status().get("slow", {})
+            if st.get("running_replicas", 0) >= 2:
+                scaled_up = True
+                break
+            time.sleep(0.5)
+        assert scaled_up, f"never scaled up: {serve.status()} {errors[:1]}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors
+
+    # scale back down when idle
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if serve.status()["slow"]["running_replicas"] <= 1:
+            break
+        time.sleep(0.5)
+    assert serve.status()["slow"]["running_replicas"] <= 1
+    serve.delete("slow")
