@@ -58,13 +58,33 @@ def init(
             gcs = GcsServer()
             gcs.start()
             node_resources = dict(resources or {})
+            node_labels = dict(labels or {})
             if num_cpus is not None:
                 node_resources["CPU"] = num_cpus
             if num_tpus is not None:
                 node_resources["TPU"] = num_tpus
             elif "TPU" not in node_resources:
-                node_resources["TPU"] = _autodetect_tpu_chips()
-            raylet = Raylet(gcs.address, resources=node_resources, labels=labels)
+                # metadata autodetection + slice labels (reference
+                # accelerators/tpu.py:16-30,338-374): SLICE_PACK placement
+                # works without hand-set num_tpus
+                from ray_tpu.common.resources import (
+                    LABEL_SLICE_NAME, LABEL_SLICE_TOPOLOGY,
+                    LABEL_SLICE_WORKER_INDEX)
+                from ray_tpu.common.tpu_detect import detect
+
+                found = detect()
+                node_resources["TPU"] = found["chips"]
+                if found["topology"]:
+                    node_labels.setdefault(
+                        LABEL_SLICE_TOPOLOGY, str(found["topology"]))
+                if found["slice_name"]:
+                    node_labels.setdefault(
+                        LABEL_SLICE_NAME, str(found["slice_name"]))
+                if found["worker_id"] is not None:
+                    node_labels.setdefault(
+                        LABEL_SLICE_WORKER_INDEX, str(found["worker_id"]))
+            raylet = Raylet(gcs.address, resources=node_resources,
+                            labels=node_labels)
             raylet.start()
             _head = {"gcs": gcs, "raylet": raylet}
             gcs_address = gcs.address
@@ -92,25 +112,6 @@ def init(
         )
         atexit.register(_shutdown_atexit)
         return {"gcs_address": gcs_address, "node_id": node_id.hex()}
-
-
-def _autodetect_tpu_chips() -> float:
-    """Count local TPU chips without initializing jax (env heuristics)."""
-    import os
-
-    if os.environ.get("TPU_VISIBLE_CHIPS"):
-        return float(len(os.environ["TPU_VISIBLE_CHIPS"].split(",")))
-    # defer to jax only if it's already imported (avoid hijacking the chip)
-    import sys
-
-    if "jax" in sys.modules:
-        try:
-            import jax
-
-            return float(len([d for d in jax.devices() if d.platform != "cpu"]))
-        except Exception:  # noqa: BLE001
-            return 0.0
-    return 0.0
 
 
 def _shutdown_atexit():

@@ -1,0 +1,5 @@
+"""Autoscaler: pending-demand bin-packing over node types
+(reference: python/ray/autoscaler/v2/)."""
+
+from .autoscaler import Autoscaler, NodeType  # noqa: F401
+from .provider import LocalRayletProvider, NodeProvider  # noqa: F401

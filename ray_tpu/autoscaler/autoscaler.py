@@ -1,0 +1,200 @@
+"""Autoscaler: demand-driven node launch + idle termination.
+
+Reference: ``python/ray/autoscaler/v2/autoscaler.py:47`` (reconcile loop) and
+``v2/scheduler.py:638 ResourceDemandScheduler`` (bin-pack pending demands
+onto node types). The loop each tick:
+
+1. reads aggregate load from the GCS (queued lease demands reported by every
+   raylet + pending placement-group bundles — ``get_cluster_load``),
+2. simulates placing each demand onto current nodes' AVAILABLE capacity and,
+   for what doesn't fit, bin-packs onto copies of configured node types
+   (first-fit-decreasing), bounded by per-type ``max_workers``,
+3. launches the computed nodes via the :class:`NodeProvider`,
+4. terminates provider-launched nodes that have been fully idle (all
+   resources free, no pending demand) past the idle timeout.
+
+TPU note: node types carry resource dicts + labels, so a
+``{"TPU": 4, labels: {slice-topology: v5e-16}}`` type scales TPU slices the
+same way CPU types scale — SLICE_PACK placement then targets the new slice's
+labels.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.gcs.client import GcsClient
+
+from .provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeType:
+    name: str
+    resources: Dict[str, float]
+    max_workers: int = 4
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _subtract(capacity: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        capacity[k] = capacity.get(k, 0.0) - v
+
+
+class Autoscaler:
+    def __init__(self, gcs_address: Tuple[str, int],
+                 node_types: List[NodeType], provider: NodeProvider,
+                 interval_s: Optional[float] = None,
+                 idle_timeout_s: Optional[float] = None):
+        self._gcs = GcsClient(gcs_address, client_id="autoscaler")
+        self._types = {t.name: t for t in node_types}
+        self._provider = provider
+        self._interval = (interval_s if interval_s is not None
+                          else GLOBAL_CONFIG.get("autoscaler_interval_s"))
+        self._idle_timeout = (
+            idle_timeout_s if idle_timeout_s is not None
+            else GLOBAL_CONFIG.get("autoscaler_idle_timeout_s"))
+        self._launched: Dict[str, str] = {}       # node handle -> type name
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # raylets consult this flag to queue infeasible-now demands; set it
+        # locally AND cluster-wide (GCS publishes to every raylet process)
+        GLOBAL_CONFIG.set_system_config_value("autoscaling_enabled", True)
+        try:
+            self._gcs.call("update_system_config",
+                           key="autoscaling_enabled", value=True)
+        except Exception:  # noqa: BLE001 — older GCS
+            pass
+
+    # ---------------------------------------------------------------- control
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self, terminate_nodes: bool = False):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if terminate_nodes:
+            for handle in list(self._launched):
+                self._provider.terminate_node(handle)
+                self._launched.pop(handle, None)
+        self._gcs.close()
+
+    def status(self) -> Dict[str, object]:
+        return {"launched": dict(self._launched),
+                "types": {n: t.max_workers for n, t in self._types.items()}}
+
+    # ------------------------------------------------------------------- loop
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — keep scaling loop alive
+                logger.exception("autoscaler reconcile failed")
+
+    def _reconcile_once(self):
+        load = self._gcs.call("get_cluster_load")
+        nodes = self._gcs.get_all_nodes()
+        raw: List[dict] = list(load.get("lease_demands", []))
+        for bundles in load.get("pg_demands", []):
+            raw.extend(bundles)
+        # ResourceRequest.to_dict nests under "resources" (label selectors
+        # are ignored for capacity bin-packing).
+        demands: List[Dict[str, float]] = [
+            dict(d.get("resources", d)) for d in raw]
+
+        alive = [n for n in nodes if n.get("alive")]
+        alive_ids = {n["node_id"].hex() if hasattr(n["node_id"], "hex")
+                     else bytes(n["node_id"]).hex() for n in alive}
+        # Simulate placement on current availability PLUS launched-but-not-
+        # yet-registered nodes (their full type capacity) — otherwise every
+        # tick re-launches for the same demand until max_workers
+        # (launch→registration latency is seconds on a real provider).
+        capacities = [dict((n.get("resources") or {}).get("available") or {})
+                      for n in alive]
+        for handle, type_name in self._launched.items():
+            if handle not in alive_ids:
+                capacities.append(dict(self._types[type_name].resources))
+        unmet: List[Dict[str, float]] = []
+        for demand in sorted(demands, key=lambda d: -sum(d.values())):
+            for cap in capacities:
+                if _fits(demand, cap):
+                    _subtract(cap, demand)
+                    break
+            else:
+                unmet.append(demand)
+
+        if unmet:
+            self._launch_for(unmet)
+        self._terminate_idle(alive, bool(demands))
+
+    def _launch_for(self, unmet: List[Dict[str, float]]):
+        """First-fit-decreasing bin-pack of unmet demands onto new node-type
+        instances (reference scheduler.py ResourceDemandScheduler)."""
+        counts: Dict[str, int] = {}
+        for name in self._types:
+            counts[name] = sum(1 for t in self._launched.values() if t == name)
+        planned: List[Tuple[str, Dict[str, float]]] = []  # (type, remaining)
+        for demand in unmet:
+            placed = False
+            for _type_name, cap in planned:
+                if _fits(demand, cap):
+                    _subtract(cap, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in self._types.values():
+                if counts[t.name] >= t.max_workers:
+                    continue
+                if _fits(demand, dict(t.resources)):
+                    cap = dict(t.resources)
+                    _subtract(cap, demand)
+                    planned.append((t.name, cap))
+                    counts[t.name] += 1
+                    placed = True
+                    break
+            if not placed:
+                logger.warning("demand %s does not fit any node type "
+                               "(or max_workers reached)", demand)
+        for type_name, _cap in planned:
+            t = self._types[type_name]
+            handle = self._provider.launch_node(
+                t.name, dict(t.resources), dict(t.labels))
+            self._launched[handle] = t.name
+
+    def _terminate_idle(self, alive_nodes: List[dict], have_demand: bool):
+        now = time.monotonic()
+        by_id = {n["node_id"].hex() if hasattr(n["node_id"], "hex")
+                 else bytes(n["node_id"]).hex(): n for n in alive_nodes}
+        for handle in list(self._launched):
+            node = by_id.get(handle)
+            if node is None:
+                self._idle_since.pop(handle, None)
+                continue
+            snap = node.get("resources") or {}
+            total = snap.get("total") or {}
+            avail = snap.get("available") or {}
+            fully_idle = all(avail.get(k, 0.0) >= v for k, v in total.items())
+            if fully_idle and not have_demand:
+                first = self._idle_since.setdefault(handle, now)
+                if now - first >= self._idle_timeout:
+                    self._provider.terminate_node(handle)
+                    self._launched.pop(handle, None)
+                    self._idle_since.pop(handle, None)
+            else:
+                self._idle_since.pop(handle, None)

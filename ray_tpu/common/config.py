@@ -68,6 +68,14 @@ class Config:
     def system_config_json(self) -> str:
         return json.dumps(self._system_config)
 
+    def set_system_config_value(self, name: str, value: Any) -> None:
+        """Set one flag at system_config precedence (env still wins)."""
+        with self._lock:
+            if name not in self._flags:
+                raise ValueError(f"unknown system_config key {name!r}")
+            self._system_config[name] = value
+            self._cache.pop(name, None)
+
     def get(self, name: str) -> Any:
         with self._lock:
             if name in self._cache:
@@ -140,6 +148,12 @@ _D("max_task_retries", int, 3, "default retries for normal tasks")
 _D("actor_max_restarts", int, 0, "default actor restarts")
 _D("lineage_pinning_enabled", bool, True, "")
 _D("max_lineage_bytes", int, 64 * 1024 * 1024, "lineage buffer cap per worker")
+
+# --- autoscaler --------------------------------------------------------------
+_D("autoscaling_enabled", bool, False,
+   "queue infeasible-now demands for the autoscaler instead of failing them")
+_D("autoscaler_interval_s", float, 1.0, "reconcile loop period")
+_D("autoscaler_idle_timeout_s", float, 30.0, "idle node termination threshold")
 
 # --- chaos / testing ---------------------------------------------------------
 _D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep")

@@ -7,6 +7,7 @@ are the training-ingest path feeding JaxTrainer workers.
 """
 
 from ray_tpu.data.dataset import Dataset, GroupedDataset  # noqa: F401
+from ray_tpu.data.execution import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
     from_items,
     from_numpy,

@@ -39,11 +39,26 @@ class _FromRefs(_Op):
 
 
 class _MapBlock(_Op):
+    actor_pool = None  # (udf, ActorPoolStrategy, ray_remote_args) or None
+
     """Any block→block transform (map/map_batches/filter/flat_map)."""
 
     def __init__(self, fn: Callable, name: str):
         self.fn = fn
         self.name = name
+
+
+class _Shuffle(_Op):
+    """Distributed map/reduce shuffle barrier (execution.shuffle_blocks)."""
+
+    def __init__(self, mode: str, num_blocks_fn, key=None, seed=None,
+                 descending=False):
+        self.mode = mode
+        self.num_blocks_fn = num_blocks_fn  # (n_input_blocks) -> n_output
+        self.key = key
+        self.seed = seed
+        self.descending = descending
+        self.name = f"shuffle:{mode}"
 
 
 class _AllToAll(_Op):
@@ -90,29 +105,67 @@ class Dataset:
 
         return self._with(_MapBlock(do, "flat_map"))
 
-    def map_batches(self, fn: Callable[[Dict[str, np.ndarray]],
-                                       Dict[str, np.ndarray]],
+    def map_batches(self, fn,
                     batch_size: Optional[int] = None,
+                    compute=None,
+                    fn_constructor_args: tuple = (),
+                    ray_remote_args: Optional[dict] = None,
                     **unknown) -> "Dataset":
+        """``fn``: callable batch→batch, or a CLASS (stateful UDF) when
+        ``compute=ActorPoolStrategy(...)`` — constructed once per pool
+        actor (reference actor_pool_map_operator.py)."""
         if unknown:
             import warnings
 
             warnings.warn(f"map_batches: ignoring unsupported options "
                           f"{sorted(unknown)}", stacklevel=2)
 
-        def do(block):
-            batch = B.block_to_batch(block)
-            if not batch:
-                return block
-            n = len(next(iter(batch.values())))
-            size = batch_size or n
-            outs = []
-            for lo in builtins.range(0, n, size):
-                sub = {k: v[lo:lo + size] for k, v in batch.items()}
-                outs.append(B.block_from_batch(fn(sub)))
-            return B.concat_blocks(outs)
+        is_class = isinstance(fn, type)
 
-        return self._with(_MapBlock(do, "map_batches"))
+        def make_do(callable_fn):
+            def do(block):
+                batch = B.block_to_batch(block)
+                if not batch:
+                    return block
+                n = len(next(iter(batch.values())))
+                size = batch_size or n
+                outs = []
+                for lo in builtins.range(0, n, size):
+                    sub = {k: v[lo:lo + size] for k, v in batch.items()}
+                    outs.append(B.block_from_batch(callable_fn(sub)))
+                return B.concat_blocks(outs)
+            return do
+
+        if compute is not None:
+            from ray_tpu.data.execution import ActorPoolStrategy
+
+            if not isinstance(compute, ActorPoolStrategy):
+                raise TypeError("compute= must be an ActorPoolStrategy")
+            if is_class:
+                ctor_args = tuple(fn_constructor_args)
+
+                class _Wrapped:  # constructed inside each pool actor
+                    def __init__(self, _cls=fn, _args=ctor_args):
+                        self._inner = _cls(*_args)
+                        self._do = make_do(self._inner)
+
+                    def __call__(self, block):
+                        return self._do(block)
+
+                udf = _Wrapped
+            else:
+                do = make_do(fn)
+
+                def udf(block, _do=do):
+                    return _do(block)
+            op = _MapBlock(None, "map_batches(actors)")
+            op.actor_pool = (udf, compute, ray_remote_args)
+            return self._with(op)
+
+        if is_class:
+            raise TypeError("class UDFs require compute=ActorPoolStrategy")
+        op = _MapBlock(make_do(fn), "map_batches")
+        return self._with(op)
 
     def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]],
                                                  np.ndarray]) -> "Dataset":
@@ -124,35 +177,18 @@ class Dataset:
         return self.map_batches(do)
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        def do(blocks: List):
-            merged = B.concat_blocks(blocks)
-            n = merged.num_rows
-            if n == 0:
-                return [merged]
-            per = -(-n // num_blocks)
-            return [B.slice_block(merged, i * per, builtins.min(per, n - i * per))
-                    for i in range(num_blocks) if i * per < n]
-
-        return self._with(_AllToAll(do, "repartition"))
+        return self._with(_Shuffle("repartition",
+                                   lambda _n_in: num_blocks))
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        def do(blocks: List):
-            merged = B.concat_blocks(blocks)
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(merged.num_rows)
-            import pyarrow as pa
-
-            return [merged.take(pa.array(perm))]
-
-        return self._with(_AllToAll(do, "random_shuffle"))
+        # seed=None stays None all the way down: each execution draws fresh
+        # OS entropy (per-epoch reshuffling must differ across epochs).
+        return self._with(_Shuffle("random", lambda n_in: builtins.max(n_in, 1),
+                                   seed=seed))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        def do(blocks: List):
-            merged = B.concat_blocks(blocks)
-            order = "descending" if descending else "ascending"
-            return [merged.sort_by([(key, order)])]
-
-        return self._with(_AllToAll(do, "sort"))
+        return self._with(_Shuffle("sort", lambda n_in: builtins.max(n_in, 1),
+                                   key=key, descending=descending))
 
     def union(self, other: "Dataset") -> "Dataset":
         # executes both sides; downstream transforms chain off the refs
@@ -174,16 +210,22 @@ class Dataset:
 
     # --------------------------------------------------------- execution
     def _execute(self) -> List:
-        """Run the plan; returns block refs (cached — plans are
-        deterministic)."""
+        """Materialize the full plan; returns block refs (cached)."""
+        if self._cached_refs is None:
+            self._cached_refs = list(self._stream_refs())
+        return self._cached_refs
+
+    def _stream_refs(self) -> Iterator:
+        """Streaming execution (reference streaming_executor.py:53): final
+        block refs are yielded as chains complete under a bounded in-flight
+        window; shuffle barriers run as distributed map/reduce stages."""
         import ray_tpu
+        from ray_tpu.data.execution import (
+            ActorPool, StreamingExecutor, shuffle_blocks)
 
         if self._cached_refs is not None:
-            return self._cached_refs
-
-        @ray_tpu.remote
-        def _run_read(task):
-            return task()
+            yield from self._cached_refs
+            return
 
         @ray_tpu.remote
         def _run_map(fn, block):
@@ -193,47 +235,53 @@ class Dataset:
         def _run_all(fn, *blocks):
             return fn(list(blocks))
 
-        refs: List = []
         ops = self._ops
         assert isinstance(ops[0], (_Read, _FromRefs))
         if isinstance(ops[0], _FromRefs):
-            source_refs = list(ops[0].refs)
-            read = False
+            sources, is_read = list(ops[0].refs), False
         else:
-            source_refs = ops[0].read_tasks
-            read = True
-        pending_chains: List = []
-        for src in source_refs:
-            ref = _run_read.remote(src) if read else src
-            # chain per-block map stages immediately (streaming)
-            j = 1
-            while j < len(ops) and isinstance(ops[j], _MapBlock):
-                ref = _run_map.remote(ops[j].fn, ref)
-                j += 1
-            refs.append(ref)
-            pending_chains.append(ref)
-            if len(pending_chains) >= self._max_inflight:
-                ray_tpu.wait(pending_chains, num_returns=1, timeout=None)
-                pending_chains = [r for r in pending_chains
-                                  if not _is_ready(r)]
-        i = 1
-        while i < len(ops) and isinstance(ops[i], _MapBlock):
-            i += 1
-        # remaining ops: alternating barriers + map chains
-        while i < len(ops):
-            op = ops[i]
-            if isinstance(op, _AllToAll):
-                out = ray_tpu.get(
-                    [_run_all.remote(_wrap_list(op.fn), *refs)])[0]
-                # out is a list of blocks — re-put as individual refs
-                refs = [ray_tpu.put(b) for b in out]
-                i += 1
-            else:
+            sources, is_read = list(ops[0].read_tasks), True
+
+        pools: List = []
+
+        def make_stage(op):
+            if op.actor_pool is not None:
+                udf, strategy, remote_args = op.actor_pool
+                pool = ActorPool(udf, strategy, remote_args)
+                pools.append(pool)
+                return pool.submit
+            return lambda ref, fn=op.fn: _run_map.remote(fn, ref)
+
+        try:
+            i = 1
+            while True:
+                segment = []
                 while i < len(ops) and isinstance(ops[i], _MapBlock):
-                    refs = [_run_map.remote(ops[i].fn, ref) for ref in refs]
+                    segment.append(ops[i])
                     i += 1
-        self._cached_refs = refs
-        return refs
+                stages = [make_stage(op) for op in segment]
+                ex = StreamingExecutor(self._max_inflight)
+                gen = ex.iter_block_refs(sources, is_read_tasks=is_read,
+                                         stages=stages)
+                if i >= len(ops):
+                    yield from gen
+                    return
+                upstream = list(gen)  # barrier: shuffle needs all inputs
+                op = ops[i]
+                i += 1
+                if isinstance(op, _Shuffle):
+                    sources = shuffle_blocks(
+                        upstream, op.num_blocks_fn(len(upstream)),
+                        mode=op.mode, key=op.key, seed=op.seed,
+                        descending=op.descending)
+                else:  # legacy whole-plan ops (limit, union glue)
+                    out = ray_tpu.get(
+                        [_run_all.remote(_wrap_list(op.fn), *upstream)])[0]
+                    sources = [ray_tpu.put(b) for b in out]
+                is_read = False
+        finally:
+            for p in pools:
+                p.shutdown()
 
     # -------------------------------------------------------- consumption
     def materialize(self) -> "Dataset":
@@ -244,7 +292,7 @@ class Dataset:
         import ray_tpu
 
         out: List[Dict] = []
-        for ref in self._execute():
+        for ref in self._stream_refs():
             block = ray_tpu.get([ref])[0]
             out.extend(B.block_to_rows(block))
             if len(out) >= n:
@@ -310,23 +358,25 @@ class Dataset:
     def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
         import ray_tpu
 
-        for ref in self._execute():
+        for ref in self._stream_refs():
             yield B.block_to_batch(ray_tpu.get([ref])[0])
 
     def iter_rows(self) -> Iterator[Dict]:
         import ray_tpu
 
-        for ref in self._execute():
+        for ref in self._stream_refs():
             yield from B.block_to_rows(ray_tpu.get([ref])[0])
 
     def iter_batches(self, *, batch_size: int = 256,
                      drop_last: bool = False) -> Iterator[Dict[str, np.ndarray]]:
         """Re-batch across block boundaries into fixed-size numpy dicts —
-        the training-ingest path (feeds JaxTrainer data loaders)."""
+        the training-ingest path (feeds JaxTrainer data loaders). Streams:
+        at most the executor's in-flight window of blocks is live at once,
+        so datasets larger than driver memory iterate fine."""
         import ray_tpu
 
         carry: Optional[Dict[str, np.ndarray]] = None
-        for ref in self._execute():
+        for ref in self._stream_refs():
             batch = B.block_to_batch(ray_tpu.get([ref])[0])
             if not batch:
                 continue

@@ -1,0 +1,262 @@
+"""Streaming execution for Datasets.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:53``
+(pull-based operator topology with per-operator resource budgets and
+backpressure), ``operators/actor_pool_map_operator.py`` (stateful UDFs on an
+actor pool), ``operators/hash_shuffle.py`` (distributed shuffle).
+
+Design:
+
+- :class:`StreamingExecutor` drives a block-granular pipeline: source blocks
+  are read and pushed through the chained map stages as independent task
+  chains; at most ``max_inflight`` block-chains are outstanding, and results
+  are yielded as soon as any chain completes. Consumption is a generator —
+  a dataset larger than driver memory streams through, one bounded window
+  of blocks at a time (blocks live in the object plane, not the driver).
+- :class:`ActorPool` executes map stages marked with
+  :class:`ActorPoolStrategy`: the UDF (often a class with expensive
+  ``__init__``, e.g. a model) is constructed ONCE per pool actor and blocks
+  are routed to the least-loaded actor.
+- Shuffles are distributed map/reduce: every input block is hash/random/
+  range-partitioned into ``n`` sub-blocks (one task per block,
+  ``num_returns=n``), and one reduce task per output partition concatenates
+  its column slices — no single-task materialization of the whole dataset
+  (the round-1 ``_AllToAll`` weakness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """compute= argument for map_batches (reference ActorPoolStrategy)."""
+
+    size: int = 2
+    max_tasks_in_flight_per_actor: int = 2
+
+
+class ActorPool:
+    """Least-loaded routing over UDF actors (reference
+    actor_pool_map_operator.py)."""
+
+    def __init__(self, fn: Callable, strategy: ActorPoolStrategy,
+                 ray_remote_args: Optional[dict] = None):
+        import cloudpickle
+
+        import ray_tpu
+
+        self._strategy = strategy
+        remote_cls = ray_tpu.remote(_UdfActor)
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency",
+                        strategy.max_tasks_in_flight_per_actor)
+        blob = cloudpickle.dumps(fn)
+        self._actors = [remote_cls.options(**opts).remote(blob)
+                        for _ in range(strategy.size)]
+        self._load = [0] * len(self._actors)
+
+    def submit(self, block_ref):
+        import ray_tpu
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        idx = min(range(len(self._actors)), key=lambda i: self._load[i])
+        self._load[idx] += 1
+        ref = self._actors[idx].run.remote(block_ref)
+
+        def done(i=idx):
+            self._load[i] = max(0, self._load[i] - 1)
+
+        try:
+            CoreWorker.current_or_raise().memory_store.add_done_callback(
+                ref.object_id, done)
+        except Exception:  # noqa: BLE001
+            done()
+        return ref
+
+    def shutdown(self):
+        import ray_tpu
+
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class _UdfActor:
+    """Holds one constructed UDF instance per pool actor."""
+
+    def __init__(self, fn_blob: bytes):
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_blob)
+        # class UDFs construct once here (the expensive part); plain
+        # functions pass through
+        self._fn = fn() if isinstance(fn, type) else fn
+
+    def run(self, block):
+        return self._fn(block)
+
+    def ping(self):
+        return True
+
+
+class StreamingExecutor:
+    """Bounded-window streaming over (source, map-stage...) segments."""
+
+    def __init__(self, max_inflight: int = 8):
+        self.max_inflight = max_inflight
+
+    def iter_block_refs(self, source_refs_or_tasks: List[Any], *,
+                        is_read_tasks: bool,
+                        stages: List[Any]) -> Iterator[Any]:
+        """stages: callables `stage(block_ref) -> block_ref` (each submits
+        one task/actor call). Yields final block refs in completion order
+        with at most max_inflight chains outstanding (backpressure)."""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _run_read(task):
+            return task()
+
+        pending: Dict[Any, int] = {}
+        completed: Dict[int, Any] = {}
+        source_iter = iter(source_refs_or_tasks)
+        exhausted = False
+        order = 0
+        next_emit = 0
+        while True:
+            while not exhausted \
+                    and len(pending) + len(completed) < self.max_inflight:
+                try:
+                    src = next(source_iter)
+                except StopIteration:
+                    exhausted = True
+                    break
+                ref = _run_read.remote(src) if is_read_tasks else src
+                for stage in stages:
+                    ref = stage(ref)
+                pending[ref] = order
+                order += 1
+            if not pending and not completed:
+                return
+            if pending:
+                ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                        timeout=None)
+                for ref in ready:
+                    completed[pending.pop(ref)] = ref
+            # Emit in PLAN order (Dataset semantics are ordered); the
+            # out-of-order buffer is bounded by the in-flight window.
+            while next_emit in completed:
+                yield completed.pop(next_emit)
+                next_emit += 1
+
+
+# --------------------------------------------------------------- shuffle
+
+def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
+                   mode: str, key: Optional[str] = None,
+                   seed: Optional[int] = None,
+                   descending: bool = False) -> List[Any]:
+    """Distributed map/reduce shuffle (reference hash_shuffle.py):
+    mode ∈ {"repartition", "random", "hash", "sort"}. Returns reduce-output
+    block refs; every stage is a task, nothing materializes centrally."""
+    import ray_tpu
+
+    n = max(1, num_output_blocks)
+
+    @ray_tpu.remote
+    def _sample_keys(block):
+        batch = B.block_to_batch(block)
+        col = batch.get(key)
+        if col is None or len(col) == 0:
+            return np.empty(0)
+        k = max(1, len(col) // 16)
+        idx = np.random.default_rng(0).choice(len(col), size=k, replace=False)
+        return np.asarray(col)[idx]
+
+    boundaries = None
+    if mode == "sort":
+        samples = [s for s in ray_tpu.get(
+            [_sample_keys.remote(r) for r in block_refs]) if len(s)]
+        allk = np.sort(np.concatenate(samples)) if samples else np.empty(0)
+        if len(allk):
+            qs = np.linspace(0, 1, n + 1)[1:-1]
+            boundaries = np.quantile(allk, qs)
+        else:
+            boundaries = np.empty(0)
+
+    @ray_tpu.remote
+    def _partition(block, part_seed):
+        rows = B.block_num_rows(block)
+        batch = B.block_to_batch(block)
+        if mode == "repartition":
+            assign = np.arange(rows) % n
+        elif mode == "random":
+            rng = np.random.default_rng(part_seed)
+            assign = rng.integers(0, n, size=rows)
+        elif mode == "hash":
+            # Python's hash() is per-process salted for str/bytes: equal
+            # keys in different partition TASKS would land in different
+            # reducers. Use a stable content hash instead.
+            import zlib
+
+            def stable(x):
+                if hasattr(x, "item"):
+                    x = x.item()
+                if isinstance(x, (int, np.integer)):
+                    return int(x)
+                b = x if isinstance(x, bytes) else str(x).encode()
+                return zlib.crc32(b)
+
+            assign = np.array([stable(x) % n for x in batch[key]], np.int64)
+        elif mode == "sort":
+            col = np.asarray(batch[key])
+            assign = np.searchsorted(boundaries, col, side="right") \
+                if len(boundaries) else np.zeros(rows, np.int64)
+            if descending:
+                assign = (n - 1) - assign
+        else:
+            raise ValueError(mode)
+        parts = []
+        for p in range(n):
+            mask = assign == p
+            parts.append(B.block_from_batch(
+                {c: np.asarray(v)[mask] for c, v in batch.items()}))
+        # num_returns=1 delivers the value itself, not a 1-tuple
+        return parts[0] if n == 1 else tuple(parts)
+
+    @ray_tpu.remote
+    def _reduce(reduce_seed, *parts):
+        merged_tbl = B.concat_blocks(parts)
+        batch = B.block_to_batch(merged_tbl)
+        if mode == "sort" and key in batch:
+            order = np.argsort(batch[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            return B.block_from_batch({c: v[order] for c, v in batch.items()})
+        if mode == "random" and merged_tbl.num_rows:
+            rng = np.random.default_rng(reduce_seed)
+            order = rng.permutation(merged_tbl.num_rows)
+            return B.block_from_batch(
+                {c: np.asarray(v)[order] for c, v in batch.items()})
+        return merged_tbl
+
+    part_lists = [
+        _partition.options(num_returns=n).remote(
+            r, seed + i if seed is not None else None)
+        for i, r in enumerate(block_refs)]
+    # normalize: num_returns=1 returns a single ref
+    part_lists = [p if isinstance(p, list) else [p] for p in part_lists]
+    return [
+        _reduce.remote(seed * 1000 + p if seed is not None else None,
+                       *[parts[p] for parts in part_lists])
+        for p in range(n)]

@@ -131,6 +131,7 @@ class GcsServer:
         self._stopped = False
         self._pending_actor_queue: List[ActorID] = []
         self._pending_pg_queue: List[PlacementGroupID] = []
+        self._node_demands: Dict[NodeID, List[dict]] = {}  # autoscaler feed
         self._io = IoContext.current()
         self._register_handlers()
 
@@ -139,6 +140,7 @@ class GcsServer:
         s = self.server
         for name in (
             "register_node", "unregister_node", "report_resources", "get_all_nodes",
+            "get_cluster_load", "update_system_config",
             "get_cluster_resources", "check_alive",
             "register_job", "finish_job", "get_all_jobs", "get_next_job_id",
             "register_actor", "report_actor_state", "get_actor", "get_actor_by_name",
@@ -188,15 +190,41 @@ class GcsServer:
         await self._on_node_dead(nid, "unregistered")
         return True
 
-    async def h_report_resources(self, node_id: bytes, snapshot: dict, seq: int):
+    async def h_report_resources(self, node_id: bytes, snapshot: dict, seq: int,
+                                 pending: Optional[List[dict]] = None):
         nid = NodeID(node_id)
         entry = self.view.get(nid)
         if entry is None:
             return {"ok": False, "unknown": True}  # raylet should re-register
+        self._node_demands[nid] = list(pending or [])
         self.view.update_resources(nid, snapshot, seq)
         self.publisher.publish("resources", nid.hex(), {"snapshot": snapshot, "seq": seq})
         self._kick_pending()
         return {"ok": True}
+
+    async def h_update_system_config(self, key: str, value):
+        """Set one cluster-wide flag and push it to every raylet (the
+        autoscaler flips autoscaling_enabled this way)."""
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG.set_system_config_value(key, value)
+        self.publisher.publish("system_config", key, {"value": value})
+        return True
+
+    async def h_get_cluster_load(self):
+        """Aggregate pending demand for the autoscaler (reference:
+        GcsAutoscalerStateManager cluster resource state)."""
+        lease_demands: List[dict] = []
+        for nid, demands in self._node_demands.items():
+            entry = self.view.get(nid)
+            if entry is not None and entry.alive:
+                lease_demands.extend(demands)
+        pg_demands: List[List[dict]] = []
+        for pg_id in self._pending_pg_queue:
+            rec = self._pgs.get(pg_id)
+            if rec is not None:
+                pg_demands.append([b.to_dict() for b in rec.bundles])
+        return {"lease_demands": lease_demands, "pg_demands": pg_demands}
 
     async def h_get_all_nodes(self):
         return [

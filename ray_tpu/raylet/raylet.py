@@ -137,6 +137,7 @@ class Raylet:
                 self.view.upsert(entry)
         self.gcs.subscriber.subscribe("resources", self._on_resources_update)
         self.gcs.subscriber.subscribe("node", self._on_node_update)
+        self.gcs.subscriber.subscribe("system_config", self._on_system_config)
         self._io.spawn_threadsafe(self._report_loop())
         self._io.spawn_threadsafe(self._reap_loop())
         logger.info("raylet %s serving at %s", self.node_id.hex()[:8], self.server.address)
@@ -176,6 +177,12 @@ class Raylet:
         self.view.update_resources(nid, msg["snapshot"], msg["seq"])
         self._io.loop.call_soon_threadsafe(self._try_grant_pending)
 
+    def _on_system_config(self, key: str, msg: dict):
+        try:
+            GLOBAL_CONFIG.set_system_config_value(key, msg.get("value"))
+        except ValueError:
+            logger.warning("unknown system_config key from GCS: %s", key)
+
     def _on_node_update(self, node_hex: str, msg: dict):
         nid = NodeID.from_hex(node_hex)
         if msg.get("state") == "DEAD":
@@ -199,6 +206,12 @@ class Raylet:
                     node_id=self.node_id.binary(),
                     snapshot=self.resources.snapshot(),
                     seq=self._seq,
+                    # queued lease demands feed the autoscaler's bin-packing
+                    # (reference: SchedulerResourceReporter → autoscaler
+                    # state, gcs_autoscaler_state_manager)
+                    pending=[item["request"].to_dict()
+                             for item in self._pending_leases
+                             if not item["future"].done()],
                 )
             except Exception:  # noqa: BLE001 - GCS may be restarting
                 pass
@@ -382,8 +395,11 @@ class Raylet:
         feasible_somewhere = any(
             e.resources.is_feasible(request) for e in self.view.alive_nodes()
         )
-        if not feasible_somewhere:
+        if not feasible_somewhere and not GLOBAL_CONFIG.get(
+                "autoscaling_enabled"):
             return {"status": "infeasible"}
+        # With autoscaling, an infeasible-now demand stays queued: its
+        # pending entry is what the autoscaler bin-packs a new node for.
         fut = asyncio.get_running_loop().create_future()
         self._pending_leases.append(
             {"lease_id": lease_id, "request": request, "pg": None, "future": fut}

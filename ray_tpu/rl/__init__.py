@@ -17,3 +17,8 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
     PPOConfig,
 )
 from ray_tpu.rl.envs import CartPoleEnv, make_env  # noqa: F401
+from ray_tpu.rl.impala import (  # noqa: F401,E402
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+)

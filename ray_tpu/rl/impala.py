@@ -1,0 +1,294 @@
+"""IMPALA: asynchronous sample/learn with aggregator actors and V-trace.
+
+Reference: ``rllib/algorithms/impala/impala.py:599`` (async training_step)
+and ``:634-650`` (aggregator actors building train batches from episode
+refs ahead of the learner). Architecture here:
+
+- EnvRunner actors sample continuously; the driver keeps one ``sample()``
+  call in flight per runner and NEVER blocks the learner on sampling.
+- Completed fragment REFS are handed to :class:`Aggregator` actors (the
+  fragment bytes flow runner→aggregator through the object plane, not
+  through the driver), which concatenate fragments into train batches.
+- The learner applies **V-trace** off-policy correction (Espeholt et al.,
+  2018): sampling continues under stale weights, and the clipped
+  importance-sampling scan (a ``lax.scan`` over the fragment, reversed)
+  corrects the value targets and policy-gradient advantages.
+
+TPU note: the learner update is one jitted function of fixed-shape batches;
+on a TPU learner the same function pjit-s over a mesh unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, _probe_env
+from ray_tpu.rl.module import init_policy_params, jax_forward
+
+
+class Aggregator:
+    """Batch-building actor (reference impala.py:634 aggregator actors):
+    receives rollout fragments (by ref — the data plane bypasses the
+    driver), concatenates them into fixed train batches."""
+
+    def __init__(self, train_batch_size: int):
+        self._size = train_batch_size
+        self._buffer: List[Dict[str, np.ndarray]] = []
+        self._steps = 0
+
+    def add_fragment(self, fragment: Dict[str, np.ndarray]) -> int:
+        self._buffer.append(fragment)
+        self._steps += len(fragment["obs"])
+        return self._steps
+
+    def get_ready_batch(self) -> Optional[Dict[str, Any]]:
+        """A concatenated batch of >= train_batch_size steps, else None."""
+        if self._steps < self._size:
+            return None
+        frags, self._buffer = self._buffer, []
+        self._steps = 0
+        keys = ("obs", "actions", "logp", "rewards", "values", "dones")
+        batch = {k: np.concatenate([f[k] for f in frags]) for k in keys}
+        # fragment boundaries never propagate values across: mark the last
+        # step of each fragment with its bootstrap value
+        bootstrap = np.zeros(len(batch["obs"]), np.float32)
+        is_last = np.zeros(len(batch["obs"]), bool)
+        off = 0
+        for f in frags:
+            n = len(f["obs"])
+            bootstrap[off + n - 1] = f["last_value"]
+            is_last[off + n - 1] = True
+            off += n
+        batch["bootstrap_value"] = bootstrap
+        batch["fragment_end"] = is_last
+        batch["episode_returns"] = np.asarray(
+            [r for f in frags for r in f["episode_returns"]], np.float32)
+        return batch
+
+
+class IMPALALearner:
+    """Policy gradient with V-trace targets (reference: rllib vtrace)."""
+
+    def __init__(self, params, *, lr: float = 5e-4, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 grad_clip: float = 40.0):
+        import jax
+        import optax
+
+        self.gamma = gamma
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+        self._params = jax.tree.map(jax.numpy.asarray, dict(params))
+        self._opt_state = self._optimizer.init(self._params)
+        self._step = self._build_step(gamma, vf_coeff, entropy_coeff,
+                                      rho_bar, c_bar)
+        self.updates = 0
+
+    def _build_step(self, gamma, vf_c, ent_c, rho_bar, c_bar):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        optimizer = self._optimizer
+
+        def vtrace(values, rewards, nonterm, next_values, rho, frag_end):
+            """Reverse scan computing vs_t - V(x_t) corrections. The carry
+            zeroes at fragment boundaries: concatenated fragments come from
+            unrelated trajectories, so corr_{t+1} of the NEXT fragment must
+            not leak into this fragment's targets."""
+            rho_c = jnp.minimum(rho_bar, rho)
+            c = jnp.minimum(c_bar, rho)
+            delta = rho_c * (rewards + gamma * nonterm * next_values - values)
+
+            def body(acc, xs):
+                d, c_t, nt, fe = xs
+                acc = jnp.where(fe, 0.0, acc)   # cut across fragments
+                acc = d + gamma * nt * c_t * acc
+                return acc, acc
+
+            _, corr = jax.lax.scan(
+                body, jnp.zeros(()), (delta, c, nonterm, frag_end),
+                reverse=True)
+            return values + corr  # vs_t
+
+        def loss_fn(params, batch):
+            logits, values = jax_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            rho = jnp.exp(logp - batch["logp"])
+            rho = jax.lax.stop_gradient(rho)
+            nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+            # next-step values: train-time values shifted left; fragment
+            # tails use the runner's bootstrap value
+            next_values = jnp.where(
+                batch["fragment_end"],
+                batch["bootstrap_value"],
+                jnp.roll(jax.lax.stop_gradient(values), -1))
+            vs = vtrace(jax.lax.stop_gradient(values), batch["rewards"],
+                        nonterm, next_values, rho,
+                        batch["fragment_end"].astype(jnp.float32))
+            vs_next = jnp.where(batch["fragment_end"],
+                                batch["bootstrap_value"],
+                                jnp.roll(vs, -1))
+            pg_adv = jnp.minimum(rho_bar, rho) * (
+                batch["rewards"] + gamma * nonterm * vs_next
+                - jax.lax.stop_gradient(values))
+            pi_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": jnp.mean(rho)}
+
+        def step(params, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = total
+            return params, opt_state, aux
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        self._params, self._opt_state, aux = self._step(
+            self._params, self._opt_state, jb)
+        self.updates += 1
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self._params.items()}
+
+
+@dataclasses.dataclass
+class IMPALAConfig(AlgorithmConfig):
+    train_batch_size: int = 512
+    num_aggregators: int = 1
+    lr: float = 5e-4
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    # max learner updates pulled per training_step() call
+    max_updates_per_step: int = 8
+    broadcast_interval: int = 1  # weight push every N learner updates
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+class IMPALA(Algorithm):
+    """Async IMPALA driver (reference impala.py:599 training_step)."""
+
+    def __init__(self, config: IMPALAConfig):
+        import ray_tpu
+
+        super().__init__(config)
+        params = init_policy_params(
+            self._env_probe["obs_size"], self._env_probe["num_actions"],
+            hidden=tuple(config.hidden), seed=config.seed)
+        self.learner = IMPALALearner(
+            params, lr=config.lr, gamma=config.gamma,
+            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff)
+        agg_cls = ray_tpu.remote(Aggregator)
+        self._aggregators = [
+            agg_cls.options(max_concurrency=4).remote(config.train_batch_size)
+            for _ in range(config.num_aggregators)]
+        self._agg_rr = 0
+        self._inflight: Dict[Any, int] = {}   # sample ref -> runner index
+        self._steps_sampled = 0
+        self._steps_trained = 0
+        self._push_weights()
+        self._kick_all_runners()
+
+    # ------------------------------------------------------------ async loop
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def _push_weights(self):
+        self._weights_version += 1
+        weights = self.learner.get_weights()
+        self.env_runner_group.foreach_actor(
+            lambda a: a.set_weights.remote(weights, self._weights_version))
+
+    def _kick_all_runners(self):
+        actors = self.env_runner_group.actors
+        for idx in self.env_runner_group.healthy_actor_ids():
+            if not any(i == idx for i in self._inflight.values()):
+                self._kick_runner(idx, actors[idx])
+
+    def _kick_runner(self, idx, actor):
+        ref = actor.sample.remote(self.config.rollout_fragment_length)
+        self._inflight[ref] = idx
+
+    def _route_completed_samples(self, timeout: float):
+        """Move finished fragments runner→aggregator and resample; the
+        learner never waits on any individual runner."""
+        import ray_tpu
+
+        if not self._inflight:
+            self._kick_all_runners()
+            if not self._inflight:
+                raise RuntimeError("no healthy env runners")
+        ready, _ = ray_tpu.wait(list(self._inflight),
+                                num_returns=1, timeout=timeout)
+        for ref in ready:
+            idx = self._inflight.pop(ref)
+            agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+            self._agg_rr += 1
+            # fragment bytes travel runner→aggregator via the ref
+            agg.add_fragment.remote(ref)
+            self._steps_sampled += self.config.rollout_fragment_length
+            if idx in self.env_runner_group.healthy_actor_ids():
+                self._kick_runner(idx, self.env_runner_group.actors[idx])
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self._maybe_restore_runners()
+        updates = 0
+        metrics: Dict[str, float] = {}
+        returns: List[float] = []
+        deadline = time.monotonic() + 30.0
+        while updates < self.config.max_updates_per_step \
+                and time.monotonic() < deadline:
+            self._route_completed_samples(timeout=0.05)
+            got_batch = False
+            for agg in self._aggregators:
+                batch = ray_tpu.get(agg.get_ready_batch.remote(), timeout=60)
+                if batch is None:
+                    continue
+                got_batch = True
+                metrics = self.learner.update(batch)
+                self._steps_trained += len(batch["obs"])
+                returns.extend(batch["episode_returns"].tolist())
+                updates += 1
+                if self.learner.updates % self.config.broadcast_interval == 0:
+                    self._push_weights()
+            if not got_batch:
+                continue  # keep routing samples; learner stays decoupled
+        self._return_window = (self._return_window
+                               + [float(r) for r in returns])[-100:]
+        return {
+            "env_runners": {
+                "episode_return_mean": self.episode_return_mean(),
+                "num_episodes": len(returns),
+                "num_env_steps_sampled": self._steps_sampled,
+                "num_healthy_workers":
+                    self.env_runner_group.num_healthy_actors(),
+            },
+            "learners": {"default_policy": dict(
+                metrics, num_updates=self.learner.updates,
+                num_env_steps_trained=self._steps_trained)},
+        }

@@ -95,19 +95,21 @@ class ProxyActor:
         self._pool = ThreadPoolExecutor(max_workers=32,
                                         thread_name_prefix="proxy")
         self._started = asyncio.Event()
+        self._starting = False
         self._num_requests = 0
 
     # -------------------------------------------------------------- control
     async def start(self) -> Dict[str, Any]:
         """Bind servers; returns the bound addresses. Idempotent: a second
         caller racing the first gets the already-bound address."""
-        if self._server is not None:
+        if self._server is not None or self._starting:
             await self._started.wait()
             return self.address()
-        await self._refresh_routes()
+        self._starting = True  # set before ANY await: guards double-bind
         self._server = await asyncio.start_server(
             self._handle_conn, self._http_host, self._http_port)
         self._http_port = self._server.sockets[0].getsockname()[1]
+        await self._refresh_routes()
         if self._grpc_port is not None:
             await self._start_grpc()
         asyncio.get_running_loop().create_task(self._route_poll_loop())
@@ -282,11 +284,22 @@ class ProxyActor:
         import ray_tpu
 
         loop = asyncio.get_running_loop()
+        # Sticky routing: submit and every poll must hit the SAME replica
+        # (the request id lives in that replica's engine state).
+        handle._state.refresh()
+        acquired = handle._state.acquire_replica()
+        if acquired is None:
+            await self._write_response(writer, 500, "text/plain",
+                                       b"no running replicas")
+            return
+        replica, ridx = acquired
         try:
             req_id = await loop.run_in_executor(
                 self._pool, lambda: ray_tpu.get(
-                    handle.options("submit").remote(req), timeout=60.0))
+                    replica.handle_request.remote("submit", (req,), {}),
+                    timeout=60.0))
         except Exception as e:  # noqa: BLE001
+            handle._state.release(ridx)
             await self._write_response(
                 writer, 500, "text/plain",
                 f"stream submit failed: {e}".encode()[:4096])
@@ -296,12 +309,12 @@ class ProxyActor:
                      b"cache-control: no-cache\r\n"
                      b"transfer-encoding: chunked\r\n\r\n")
         await writer.drain()
-        poll_handle = handle.options("poll")
         try:
             while True:
                 out = await loop.run_in_executor(
                     self._pool, lambda: ray_tpu.get(
-                        poll_handle.remote(req_id), timeout=60.0))
+                        replica.handle_request.remote("poll", (req_id,), {}),
+                        timeout=60.0))
                 for chunk in out.get("chunks", ()):
                     payload = json.dumps(chunk).encode()
                     await self._write_chunk(
@@ -318,6 +331,8 @@ class ProxyActor:
                     writer, b"event: error\ndata: " + str(e).encode() + b"\n\n")
             except Exception:  # noqa: BLE001
                 pass
+        finally:
+            handle._state.release(ridx)
         try:
             writer.write(b"0\r\n\r\n")
             await writer.drain()

@@ -71,9 +71,19 @@ class TrainContext:
 
     def init_jax_distributed(self) -> None:
         """Join the global JAX mesh (multi-host SPMD). No-op when
-        single-process (tests, one-host runs)."""
+        single-process (tests, one-host runs).
+
+        On TPU pods this is ``jax.distributed.initialize`` against rank 0's
+        coordinator (the WorkerGroup picks the address and injects it into
+        every rank's context). On CPU (multi-process tests, DCN-only
+        clusters) the gloo collectives backend is enabled so cross-process
+        psum/all-gather work the same way.
+        """
         if self.world_size == 1 or self.coordinator is None:
             return
+        # Must precede the first jax import in this process.
+        if "jax" not in __import__("sys").modules:
+            os.environ.setdefault("JAX_CPU_COLLECTIVES", "gloo")
         import jax
 
         jax.distributed.initialize(

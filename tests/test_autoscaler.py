@@ -1,0 +1,78 @@
+"""Autoscaler tests: demand-driven launch, PG-driven launch, idle
+termination — against a real GCS with real raylets via the local provider
+(reference test pattern: autoscaler/_private/fake_multi_node +
+test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, LocalRayletProvider, NodeType
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def scaled_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    provider = LocalRayletProvider(cluster.gcs.address)
+    autoscaler = Autoscaler(
+        cluster.gcs.address,
+        node_types=[NodeType("cpu2", {"CPU": 2}, max_workers=2)],
+        provider=provider, interval_s=0.25, idle_timeout_s=2.0)
+    autoscaler.start()
+    ray_tpu.init(address=cluster.address)
+    yield cluster, autoscaler
+    ray_tpu.shutdown()
+    autoscaler.stop(terminate_nodes=True)
+    cluster.shutdown()
+
+
+def _wait(predicate, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_pending_pg_triggers_launch_and_idle_termination(scaled_cluster):
+    cluster, autoscaler = scaled_cluster
+    from ray_tpu import placement_group, remove_placement_group
+
+    # head has 1 CPU; a 2-CPU bundle is unplaceable until a node launches
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=60), "autoscaler never satisfied the PG"
+    assert len(autoscaler.status()["launched"]) == 1
+
+    remove_placement_group(pg)
+    # idle node terminates after the timeout
+    _wait(lambda: len(autoscaler.status()["launched"]) == 0,
+          timeout=30, msg="idle node termination")
+
+
+def test_queued_task_demand_triggers_launch(scaled_cluster):
+    cluster, autoscaler = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return "ran"
+
+    # infeasible on the 1-CPU head: queues as demand, autoscaler launches
+    assert ray_tpu.get(heavy.remote(), timeout=90) == "ran"
+    assert len(autoscaler.status()["launched"]) >= 1
+
+
+def test_max_workers_cap(scaled_cluster):
+    cluster, autoscaler = scaled_cluster
+    from ray_tpu import placement_group
+
+    pgs = [placement_group([{"CPU": 2}], strategy="PACK") for _ in range(4)]
+    # only 2 node launches allowed; 2 PGs must be placed, never more nodes
+    placed = 0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and placed < 2:
+        placed = sum(1 for pg in pgs if pg.wait(timeout_seconds=0.5))
+    assert placed >= 2
+    assert len(autoscaler.status()["launched"]) <= 2

@@ -1,0 +1,87 @@
+"""Multi-host SPMD proof (VERDICT item 8): two worker PROCESSES join one
+global JAX mesh via jax.distributed.initialize, wired through
+WorkerGroup/TrainContext; plus TPU metadata autodetection."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_two_process_global_mesh_train_step(rt, tmp_path):
+    """Each of 2 worker processes holds 8 local CPU devices; the global
+    mesh spans 16 devices across both processes, and a pjit-ed step with a
+    cross-process reduction executes (gloo CPU collectives)."""
+
+    def train_fn(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        assert ctx.get_world_size() == 2
+        ctx.init_jax_distributed()
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        assert jax.process_count() == 2
+        global_devices = jax.devices()
+        assert len(global_devices) == 16  # 2 procs x 8 virtual cpu devices
+        mesh = Mesh(np.array(global_devices), ("dp",))
+        # data-parallel "train step": global mean of a sharded batch
+        local = jnp.arange(8.0) + 100.0 * ctx.get_world_rank()
+        batch = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), np.asarray(local), (16,))
+        total = jax.jit(
+            lambda x: jnp.mean(x),
+            out_shardings=NamedSharding(mesh, P()))(batch)
+        if ctx.get_world_rank() == 0:
+            train.report({"mean": float(total),
+                          "n_devices": len(global_devices)})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="spmd2", storage_path=str(tmp_path)))
+    result = trainer.fit(timeout_s=300)
+    # mean of [0..7, 100..107] = (28 + 828)/16
+    assert result.metrics["n_devices"] == 16
+    assert abs(result.metrics["mean"] - (28 + 828) / 16.0) < 1e-5
+
+
+class TestTpuDetect:
+    def test_detect_from_accelerator_type(self, monkeypatch):
+        from ray_tpu.common import tpu_detect
+
+        monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+        monkeypatch.setenv("TPU_WORKER_ID", "2")
+        monkeypatch.setenv("TPU_NAME", "my-slice")
+        found = tpu_detect.detect()
+        assert found["chips"] == 4.0  # 16-chip slice = 4 hosts x 4 chips
+        assert found["topology"] == "v5litepod-16"
+        assert found["slice_name"] == "my-slice"
+        assert found["worker_id"] == 2
+
+    def test_detect_single_host_shapes(self, monkeypatch):
+        from ray_tpu.common import tpu_detect
+
+        monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+        assert tpu_detect.detect()["chips"] == 8.0
+
+    def test_visible_chips_override(self, monkeypatch):
+        from ray_tpu.common import tpu_detect
+
+        monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1")
+        assert tpu_detect.detect()["chips"] == 2.0
