@@ -56,8 +56,10 @@ class ActorPool:
         remote_cls = ray_tpu.remote(_UdfActor)
         opts = dict(ray_remote_args or {})
         opts.setdefault("num_cpus", 0)
-        opts.setdefault("max_concurrency",
-                        strategy.max_tasks_in_flight_per_actor)
+        # serial execution per actor: stateful UDFs are not thread-safe;
+        # max_tasks_in_flight_per_actor bounds QUEUED work (routing),
+        # never concurrent threads inside the UDF
+        opts.setdefault("max_concurrency", 1)
         blob = cloudpickle.dumps(fn)
         self._actors = [remote_cls.options(**opts).remote(blob)
                         for _ in range(strategy.size)]
@@ -184,6 +186,18 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
         return np.asarray(col)[idx]
 
     boundaries = None
+    offsets = None
+    if mode == "repartition":
+        # order-preserving: rows map to output partitions by GLOBAL row
+        # position (contiguous ranges), so repartition keeps Dataset order
+        @ray_tpu.remote
+        def _count(block):
+            return B.block_num_rows(block)
+
+        counts = ray_tpu.get([_count.remote(r) for r in block_refs])
+        total = max(1, sum(counts))
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        offsets = {i: (int(starts[i]), total) for i in range(len(counts))}
     if mode == "sort":
         samples = [s for s in ray_tpu.get(
             [_sample_keys.remote(r) for r in block_refs]) if len(s)]
@@ -195,11 +209,13 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
             boundaries = np.empty(0)
 
     @ray_tpu.remote
-    def _partition(block, part_seed):
+    def _partition(block, part_seed, block_index):
         rows = B.block_num_rows(block)
         batch = B.block_to_batch(block)
         if mode == "repartition":
-            assign = np.arange(rows) % n
+            start, total = offsets[block_index]
+            assign = (start + np.arange(rows)) * n // total
+            assign = np.minimum(assign, n - 1)
         elif mode == "random":
             rng = np.random.default_rng(part_seed)
             assign = rng.integers(0, n, size=rows)
@@ -252,7 +268,7 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
 
     part_lists = [
         _partition.options(num_returns=n).remote(
-            r, seed + i if seed is not None else None)
+            r, seed + i if seed is not None else None, i)
         for i, r in enumerate(block_refs)]
     # normalize: num_returns=1 returns a single ref
     part_lists = [p if isinstance(p, list) else [p] for p in part_lists]

@@ -1,0 +1,185 @@
+"""Channels: preallocated transports for compiled actor graphs.
+
+Reference: ``python/ray/experimental/channel/shared_memory_channel.py``
+(mutable shm buffer channel), ``torch_tensor_accelerator_channel.py``
+(device-tensor channel). Here:
+
+- :class:`ShmChannel` — native mutable shared-memory channel
+  (``shm_channel.cc``): the writer rewrites one buffer after every reader
+  has consumed the previous value (depth-1 backpressure, which is exactly
+  the per-stage buffering a pipeline wants). Payloads are pickled values;
+  channel ends are picklable by NAME and lazily opened per process.
+- :class:`DeviceBufferChannel` — carries ``jax.Array``s between TPU
+  actors: arrays are staged to host (device_get) on write and re-placed
+  (device_put) on read. On real multi-chip meshes tensor movement belongs
+  INSIDE jitted programs as ICI collectives (collective/xla_group.py);
+  this channel is the cross-process hop for pipeline-stage handoffs,
+  matching the reference's host-mediated channel for non-p2p transports.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Any, Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "object_store", "native")
+_SO_PATH = os.path.join(_SRC_DIR, "libshm_channel.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = os.path.join(_SRC_DIR, "shm_channel.cc")
+    with _build_lock:
+        if (not os.path.exists(_SO_PATH)
+                or os.path.getmtime(_SO_PATH) < os.path.getmtime(src)):
+            tmp = _SO_PATH + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                 "-o", tmp, src, "-lpthread", "-lrt"],
+                check=True, capture_output=True)
+            os.replace(tmp, _SO_PATH)
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.rtc_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                               ctypes.c_uint64]
+    lib.rtc_create.restype = ctypes.c_int
+    lib.rtc_open.argtypes = [ctypes.c_char_p]
+    lib.rtc_open.restype = ctypes.c_int
+    lib.rtc_write.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_uint64, ctypes.c_int64]
+    lib.rtc_write.restype = ctypes.c_int
+    lib.rtc_read.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,
+                             ctypes.c_uint64,
+                             ctypes.POINTER(ctypes.c_uint64),
+                             ctypes.c_int64]
+    lib.rtc_read.restype = ctypes.c_int64
+    lib.rtc_close.argtypes = [ctypes.c_int]
+    lib.rtc_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ShmChannel:
+    """One named mutable shm channel end; both ends are the same object,
+    distinguished by which methods you call. Picklable by name."""
+
+    def __init__(self, name: str, capacity: int = 4 * 1024 * 1024,
+                 num_readers: int = 1, _create: bool = True):
+        self.name = name
+        self.capacity = capacity
+        self.num_readers = num_readers
+        self._h: Optional[int] = None
+        self._create = _create
+        self._last_version = 0
+        self._buf = None
+
+    def _handle(self) -> int:
+        if self._h is None:
+            lib = _load()
+            h = lib.rtc_create(self.name.encode(), self.capacity,
+                               self.num_readers) if self._create \
+                else lib.rtc_open(self.name.encode())
+            if h < 0:
+                raise OSError(-h, f"channel {self.name}: {os.strerror(-h)}")
+            self._h = h
+            self._buf = ctypes.create_string_buffer(self.capacity)
+        return self._h
+
+    def write(self, value: Any, timeout_s: float = 60.0) -> None:
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = _load().rtc_write(self._handle(), blob, len(blob),
+                               int(timeout_s * 1000))
+        if rc == -32:  # EPIPE
+            raise ChannelClosed(self.name)
+        if rc == -11:  # EAGAIN
+            raise TimeoutError(f"channel {self.name} write timed out")
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def read(self, timeout_s: float = 60.0) -> Any:
+        out_len = ctypes.c_uint64()
+        v = _load().rtc_read(self._handle(), self._last_version, self._buf,
+                             self.capacity, ctypes.byref(out_len),
+                             int(timeout_s * 1000))
+        if v == -32:
+            raise ChannelClosed(self.name)
+        if v == -11:
+            raise TimeoutError(f"channel {self.name} read timed out")
+        if v < 0:
+            raise OSError(-v, os.strerror(-v))
+        self._last_version = int(v)
+        # zero-copy view into the scratch buffer (raw[:n] would copy again)
+        return pickle.loads(memoryview(self._buf)[:out_len.value])
+
+    def close(self):
+        if self._h is not None:
+            _load().rtc_close(self._h)
+
+    def unlink(self):
+        try:
+            _load().rtc_unlink(self.name.encode())
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __reduce__(self):
+        # the receiving process OPENS (never re-creates) the segment
+        return (_open_channel, (self.name, self.capacity, self.num_readers))
+
+
+def _open_channel(name, capacity, num_readers):
+    return ShmChannel(name, capacity, num_readers, _create=False)
+
+
+class DeviceBufferChannel:
+    """Channel for jax.Arrays between TPU actors: host-staged transfer
+    with re-placement on the reader's devices (reference
+    torch_tensor_accelerator_channel.py's CPU-mediated fallback path)."""
+
+    def __init__(self, name: str, capacity: int = 64 * 1024 * 1024,
+                 num_readers: int = 1, _create: bool = True):
+        self._ch = ShmChannel(name, capacity, num_readers, _create=_create)
+
+    def write(self, array, timeout_s: float = 60.0) -> None:
+        import jax
+        import numpy as np
+
+        host = np.asarray(jax.device_get(array))
+        self._ch.write({"shape": host.shape, "dtype": str(host.dtype),
+                        "data": host.tobytes()}, timeout_s)
+
+    def read(self, timeout_s: float = 60.0, device=None):
+        import jax
+        import numpy as np
+
+        msg = self._ch.read(timeout_s)
+        host = np.frombuffer(
+            msg["data"], dtype=msg["dtype"]).reshape(msg["shape"])
+        return jax.device_put(host, device) if device is not None \
+            else jax.device_put(host)
+
+    def close(self):
+        self._ch.close()
+
+    def unlink(self):
+        self._ch.unlink()
+
+    def __reduce__(self):
+        ch = self._ch
+        return (_open_device_channel,
+                (ch.name, ch.capacity, ch.num_readers))
+
+
+def _open_device_channel(name, capacity, num_readers):
+    return DeviceBufferChannel(name, capacity, num_readers, _create=False)

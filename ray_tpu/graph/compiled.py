@@ -5,19 +5,22 @@ Compilation freezes the graph: DAG-owned actors are instantiated exactly
 once, the schedule is topo-sorted once, and each ``execute()`` replays the
 schedule submitting actor tasks with pre-wired argument routing — the
 driver does no graph traversal, serialization of the graph, or actor
-creation per call. Successive ``execute()`` calls pipeline naturally:
-submission is async, so stage k of invocation i+1 overlaps stage k+1 of
-invocation i (the actor-side sequence queues keep per-actor order).
+creation per call.
 
-The reference gains additional speed from preallocated shm/NCCL channels;
-the TPU equivalent (device-buffer channels between TPU actors) rides the
-object-plane work and is tracked as future work — the API contract
-(`experimental_compile` → ``execute`` → ref) is stable either way.
+``experimental_compile(channels=True)`` additionally lowers LINEAR actor
+pipelines onto preallocated mutable shm channels (reference
+``compiled_dag_node.py:809`` + ``experimental/channel/``): each stage actor
+runs a resident exec loop reading its input channel and writing its output
+channel — per-item cost is one shm memcpy + condvar wake per hop, with no
+per-call RPC, scheduling, or driver involvement. Depth-1 channels give
+per-stage buffering, so K in-flight items pipeline across K stages.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import time
+import uuid
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.graph.dag import (
     ClassMethodNode,
@@ -30,16 +33,166 @@ from ray_tpu.graph.dag import (
 )
 
 
+class _PipelineStage:
+    """Resident stage harness: holds the user instance, runs the channel
+    exec loop (reference ``do_exec_tasks:191`` worker loop)."""
+
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self._inner = cls(*init_args, **init_kwargs)
+
+    def run_loop(self, method: str, in_ch, out_ch) -> bool:
+        from ray_tpu.graph.channels import ChannelClosed
+
+        fn = getattr(self._inner, method)
+        while True:
+            try:
+                value = in_ch.read(timeout_s=3600.0)
+            except (ChannelClosed, TimeoutError):
+                break
+            if isinstance(value, _StageError):
+                try:  # propagate an upstream failure to the driver
+                    out_ch.write(value)
+                except ChannelClosed:
+                    pass
+                continue
+            try:
+                result = fn(value)
+            except Exception as e:  # noqa: BLE001 — user stage error
+                import traceback as _tb
+
+                result = _StageError(repr(e), _tb.format_exc())
+            try:
+                out_ch.write(result)
+            except ChannelClosed:
+                break
+        try:
+            out_ch.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def call(self, method: str, *args, **kwargs):
+        return getattr(self._inner, method)(*args, **kwargs)
+
+
+class _StageError:
+    """Marker shuttled through the channels when a stage raises: the error
+    reaches the driver as the item's result instead of wedging the pipe."""
+
+    def __init__(self, err: str, tb: str):
+        self.err = err
+        self.tb = tb
+
+
+class PipelineStageError(RuntimeError):
+    pass
+
+
+class _ChannelResult:
+    """FIFO result handle for a channel-compiled execute()."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout_s: float = 120.0):
+        value = self._dag._read_result(self._seq, timeout_s)
+        if isinstance(value, _StageError):
+            raise PipelineStageError(
+                f"pipeline stage raised {value.err}\n--- remote ---\n"
+                f"{value.tb}")
+        return value
+
+
 class CompiledDAG:
-    def __init__(self, root: DAGNode, max_inflight: int = 64):
+    def __init__(self, root: DAGNode, max_inflight: int = 64,
+                 channels: bool = False, channel_capacity: int = 4 << 20):
         self._root = root
         self._schedule = root._topo()
         self._max_inflight = max_inflight
         self._inflight: List[Any] = []
         self._owned_actors = []
         self._actors: Dict[int, Any] = {}
-        self._validate()
-        self._instantiate_actors()
+        self._channels = None
+        self._loop_refs: List[Any] = []
+        self._write_seq = 0
+        self._read_seq = 0
+        self._result_buf: Dict[int, Any] = {}
+        if channels:
+            self._compile_channel_pipeline(channel_capacity)
+        else:
+            self._validate()
+            self._instantiate_actors()
+
+    # --------------------------------------------------- channel pipeline
+    def _linear_stages(self):
+        """(class_node, method) per stage if the DAG is a linear actor
+        pipeline rooted at one InputNode, else None."""
+        out = self._root
+        if isinstance(out, MultiOutputNode):
+            if len(out._bound_args) != 1:
+                return None
+            out = out._bound_args[0]
+        stages = []
+        node = out
+        while isinstance(node, ClassMethodNode):
+            if not node._parent_is_node:
+                return None  # live-handle stages keep the RPC path
+            data_args = node._data_args()
+            deps = [a for a in data_args if isinstance(a, DAGNode)]
+            # exactly ONE arg and it is the upstream value: the resident
+            # loop calls fn(value), so bound constants would be silently
+            # dropped — reject at compile time instead
+            if len(deps) != 1 or len(data_args) != 1 or node._bound_kwargs:
+                return None
+            stages.append((node._parent, node._method))
+            node = deps[0]
+        if not isinstance(node, InputNode) or not stages:
+            return None
+        return list(reversed(stages))
+
+    def _compile_channel_pipeline(self, capacity: int):
+        import cloudpickle
+
+        import ray_tpu
+        from ray_tpu.graph.channels import ShmChannel
+
+        stages = self._linear_stages()
+        if stages is None:
+            raise ValueError(
+                "channels=True requires a linear actor pipeline "
+                "(InputNode -> method -> method -> ...)")
+        tag = uuid.uuid4().hex[:12]
+        self._channels = [
+            ShmChannel(f"/rtch_{tag}_{i}", capacity=capacity, num_readers=1)
+            for i in range(len(stages) + 1)]
+        for ch in self._channels:
+            ch._handle()  # create the segments before actors open them
+        remote_stage = ray_tpu.remote(_PipelineStage)
+        for i, (class_node, method) in enumerate(stages):
+            opts = dict(class_node._options or {})
+            opts.setdefault("num_cpus", 0)
+            handle = remote_stage.options(**opts).remote(
+                cloudpickle.dumps(class_node._actor_class._cls),
+                class_node._bound_args, class_node._bound_kwargs)
+            self._owned_actors.append(handle)
+            self._loop_refs.append(handle.run_loop.remote(
+                method, self._channels[i], self._channels[i + 1]))
+
+    def _read_result(self, seq: int, timeout_s: float):
+        if seq in self._result_buf:
+            return self._result_buf.pop(seq)
+        while self._read_seq <= seq:
+            value = self._channels[-1].read(timeout_s=timeout_s)
+            got = self._read_seq
+            self._read_seq += 1
+            if got == seq:
+                return value
+            self._result_buf[got] = value
+        raise RuntimeError(f"result {seq} already consumed")
 
     def _validate(self):
         n_inputs = sum(isinstance(n, InputNode) for n in self._schedule)
@@ -63,7 +216,37 @@ class CompiledDAG:
 
     def execute(self, *args, **kwargs):
         """Submit one invocation; returns ObjectRef (or list for
-        MultiOutputNode). Backpressure: caps driver-side inflight refs."""
+        MultiOutputNode), or a _ChannelResult on a channel pipeline.
+        Backpressure: caps driver-side inflight refs (RPC mode) / the
+        depth-1 stage channels themselves (channel mode)."""
+        if self._channels is not None:
+            if kwargs or len(args) != 1:
+                raise TypeError(
+                    "channel pipelines take exactly one positional input")
+            # Depth-1 stage channels bound the in-flight items to ~#stages.
+            # When full, drain completed outputs into the result buffer so
+            # a burst of execute() calls never deadlocks against its own
+            # unread results (reference: max_buffered_results).
+            deadline = time.monotonic() + 120.0
+            while True:
+                # drain ready outputs first: keeps the cascade moving and
+                # the subsequent write wait on the fast (condvar) path
+                try:
+                    while True:
+                        value = self._channels[-1].read(timeout_s=0.0)
+                        self._result_buf[self._read_seq] = value
+                        self._read_seq += 1
+                except TimeoutError:
+                    pass
+                try:
+                    self._channels[0].write(args[0], timeout_s=0.02)
+                    break
+                except TimeoutError:
+                    if time.monotonic() > deadline:
+                        raise
+            seq = self._write_seq
+            self._write_seq += 1
+            return _ChannelResult(self, seq)
         if len(self._inflight) >= self._max_inflight:
             import ray_tpu
 
@@ -82,10 +265,20 @@ class CompiledDAG:
     def teardown(self):
         import ray_tpu
 
+        if self._channels is not None:
+            for ch in self._channels:
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
         for handle in self._owned_actors:
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001
                 pass
+        if self._channels is not None:
+            for ch in self._channels:
+                ch.unlink()
+            self._channels = None
         self._owned_actors = []
         self._actors = {}

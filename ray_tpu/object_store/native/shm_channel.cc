@@ -1,0 +1,241 @@
+// Mutable shared-memory channel — compiled-graph transport.
+//
+// Reference: src/ray/core_worker/experimental_mutable_object_manager.cc and
+// python/ray/experimental/channel/shared_memory_channel.py: a mutable
+// plasma buffer with writer/reader semaphores; the writer rewrites the SAME
+// buffer once every reader has consumed the previous version.
+//
+// Redesign (daemon-less, like shm_store.cc): one POSIX shm segment per
+// channel holding a robust process-shared mutex + condvar, a version
+// counter, a reader-ack counter, and the payload arena. Protocol:
+//
+//   write(buf):  lock; wait until acks == num_readers (previous value fully
+//                consumed — this is the pipeline backpressure); memcpy in;
+//                version++; acks = 0; broadcast.
+//   read(last):  lock; wait until version > last; memcpy out; acks++;
+//                broadcast; return version.
+//
+// Copies happen under the lock (payloads are pipeline activations, small
+// relative to the RPC+pickle+scheduler path they replace). A crashed peer
+// cannot wedge the channel: EOWNERDEAD recovery marks state consistent,
+// and close() wakes all waiters with an error.
+//
+// Build: g++ -O2 -fPIC -shared -o libshm_channel.so shm_channel.cc -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x525443484e4c3031ULL;  // "RTCHNL01"
+
+struct ChannelHeader {
+  uint64_t magic;
+  uint64_t capacity;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  uint64_t version;      // sequence number of the value in the arena
+  uint64_t acks;         // readers that consumed `version`
+  uint64_t num_readers;
+  uint64_t len;          // payload bytes of current value
+  int32_t closed;
+  // arena follows
+};
+
+struct Handle {
+  ChannelHeader* hdr;
+  uint64_t map_size;
+};
+
+char* arena(ChannelHeader* h) {
+  return reinterpret_cast<char*>(h) + sizeof(ChannelHeader);
+}
+
+int lock_robust(ChannelHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    // previous owner died mid-critical-section; state is still a valid
+    // snapshot (counters are only advanced after memcpy completes)
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+void deadline_after_ms(timespec* ts, int64_t ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += ms / 1000;
+  ts->tv_nsec += (ms % 1000) * 1000000;
+  if (ts->tv_nsec >= 1000000000) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000;
+  }
+}
+
+constexpr int kMaxHandles = 4096;
+Handle g_handles[kMaxHandles];
+int g_next_handle = 0;
+pthread_mutex_t g_handles_mu = PTHREAD_MUTEX_INITIALIZER;
+
+}  // namespace
+
+extern "C" {
+
+// Returns handle >= 0, or -errno.
+int rtc_create(const char* name, uint64_t capacity, uint64_t num_readers) {
+  uint64_t map_size = sizeof(ChannelHeader) + capacity;
+  int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  bool fresh = fstat(fd, &st) == 0 && st.st_size == 0;
+  if (fresh && ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  auto* hdr = static_cast<ChannelHeader*>(mem);
+  if (fresh || hdr->magic != kMagic) {
+    std::memset(hdr, 0, sizeof(ChannelHeader));
+    hdr->capacity = capacity;
+    hdr->num_readers = num_readers;
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hdr->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&hdr->cv, &ca);
+    __sync_synchronize();
+    hdr->magic = kMagic;
+  }
+  pthread_mutex_lock(&g_handles_mu);
+  int h = g_next_handle++;
+  if (h >= kMaxHandles) {
+    pthread_mutex_unlock(&g_handles_mu);
+    munmap(mem, map_size);
+    return -ENOMEM;
+  }
+  g_handles[h] = {hdr, map_size};
+  pthread_mutex_unlock(&g_handles_mu);
+  return h;
+}
+
+int rtc_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  auto* hdr = static_cast<ChannelHeader*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, st.st_size);
+    return -EINVAL;
+  }
+  pthread_mutex_lock(&g_handles_mu);
+  int h = g_next_handle++;
+  if (h >= kMaxHandles) {
+    pthread_mutex_unlock(&g_handles_mu);
+    munmap(mem, st.st_size);
+    return -ENOMEM;
+  }
+  g_handles[h] = {hdr, static_cast<uint64_t>(st.st_size)};
+  pthread_mutex_unlock(&g_handles_mu);
+  return h;
+}
+
+// 0 ok; -EAGAIN timeout; -EPIPE closed; -EMSGSIZE too big.
+int rtc_write(int h, const char* data, uint64_t len, int64_t timeout_ms) {
+  ChannelHeader* hdr = g_handles[h].hdr;
+  if (len > hdr->capacity) return -EMSGSIZE;
+  timespec ts;
+  deadline_after_ms(&ts, timeout_ms);
+  if (lock_robust(hdr) != 0) return -EINVAL;
+  // wait for every reader to have consumed the previous version
+  while (!hdr->closed && hdr->version != 0 && hdr->acks < hdr->num_readers) {
+    if (pthread_cond_timedwait(&hdr->cv, &hdr->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -EAGAIN;
+    }
+  }
+  if (hdr->closed) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -EPIPE;
+  }
+  std::memcpy(arena(hdr), data, len);
+  hdr->len = len;
+  hdr->version += 1;
+  hdr->acks = 0;
+  pthread_cond_broadcast(&hdr->cv);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+// Blocks until version > last_version; copies payload into out (cap
+// out_cap). Returns new version (>0); -EAGAIN timeout; -EPIPE closed;
+// -EMSGSIZE out buffer too small (required size in *out_len).
+int64_t rtc_read(int h, uint64_t last_version, char* out, uint64_t out_cap,
+                 uint64_t* out_len, int64_t timeout_ms) {
+  ChannelHeader* hdr = g_handles[h].hdr;
+  timespec ts;
+  deadline_after_ms(&ts, timeout_ms);
+  if (lock_robust(hdr) != 0) return -EINVAL;
+  while (!hdr->closed && hdr->version <= last_version) {
+    if (pthread_cond_timedwait(&hdr->cv, &hdr->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -EAGAIN;
+    }
+  }
+  if (hdr->closed && hdr->version <= last_version) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -EPIPE;
+  }
+  *out_len = hdr->len;
+  if (hdr->len > out_cap) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -EMSGSIZE;
+  }
+  std::memcpy(out, arena(hdr), hdr->len);
+  uint64_t v = hdr->version;
+  hdr->acks += 1;
+  pthread_cond_broadcast(&hdr->cv);
+  pthread_mutex_unlock(&hdr->mu);
+  return static_cast<int64_t>(v);
+}
+
+uint64_t rtc_capacity(int h) { return g_handles[h].hdr->capacity; }
+
+int rtc_close(int h) {
+  ChannelHeader* hdr = g_handles[h].hdr;
+  if (lock_robust(hdr) != 0) return -EINVAL;
+  hdr->closed = 1;
+  pthread_cond_broadcast(&hdr->cv);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+int rtc_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+}  // extern "C"

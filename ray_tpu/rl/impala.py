@@ -36,21 +36,27 @@ class Aggregator:
     driver), concatenates them into fixed train batches."""
 
     def __init__(self, train_batch_size: int):
+        import threading
+
         self._size = train_batch_size
         self._buffer: List[Dict[str, np.ndarray]] = []
         self._steps = 0
+        # max_concurrency > 1 runs these sync methods on multiple threads
+        self._lock = threading.Lock()
 
     def add_fragment(self, fragment: Dict[str, np.ndarray]) -> int:
-        self._buffer.append(fragment)
-        self._steps += len(fragment["obs"])
-        return self._steps
+        with self._lock:
+            self._buffer.append(fragment)
+            self._steps += len(fragment["obs"])
+            return self._steps
 
     def get_ready_batch(self) -> Optional[Dict[str, Any]]:
         """A concatenated batch of >= train_batch_size steps, else None."""
-        if self._steps < self._size:
-            return None
-        frags, self._buffer = self._buffer, []
-        self._steps = 0
+        with self._lock:
+            if self._steps < self._size:
+                return None
+            frags, self._buffer = self._buffer, []
+            self._steps = 0
         keys = ("obs", "actions", "logp", "rewards", "values", "dones")
         batch = {k: np.concatenate([f[k] for f in frags]) for k in keys}
         # fragment boundaries never propagate values across: mark the last

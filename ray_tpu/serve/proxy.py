@@ -106,12 +106,21 @@ class ProxyActor:
             await self._started.wait()
             return self.address()
         self._starting = True  # set before ANY await: guards double-bind
-        self._server = await asyncio.start_server(
-            self._handle_conn, self._http_host, self._http_port)
-        self._http_port = self._server.sockets[0].getsockname()[1]
-        await self._refresh_routes()
-        if self._grpc_port is not None:
-            await self._start_grpc()
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._http_host, self._http_port)
+            self._http_port = self._server.sockets[0].getsockname()[1]
+            await self._refresh_routes()
+            if self._grpc_port is not None:
+                await self._start_grpc()
+        except BaseException:
+            # a failed bind must not wedge every future start() behind
+            # an event that will never be set
+            self._starting = False
+            if self._server is not None:
+                self._server.close()
+                self._server = None
+            raise
         asyncio.get_running_loop().create_task(self._route_poll_loop())
         self._started.set()
         logger.info("serve proxy: http on %s:%d grpc on %s",
@@ -304,12 +313,12 @@ class ProxyActor:
                 writer, 500, "text/plain",
                 f"stream submit failed: {e}".encode()[:4096])
             return
-        writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"content-type: text/event-stream\r\n"
-                     b"cache-control: no-cache\r\n"
-                     b"transfer-encoding: chunked\r\n\r\n")
-        await writer.drain()
         try:
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"content-type: text/event-stream\r\n"
+                         b"cache-control: no-cache\r\n"
+                         b"transfer-encoding: chunked\r\n\r\n")
+            await writer.drain()
             while True:
                 out = await loop.run_in_executor(
                     self._pool, lambda: ray_tpu.get(

@@ -1,0 +1,191 @@
+"""Compiled-graph channel tests (VERDICT item 6): mutable shm channels,
+channel-compiled pipelines vs per-call RPC, device-buffer channels."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.graph import InputNode
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _make_plus():
+    # defined in-function: cloudpickle then serializes the class BY VALUE,
+    # so workers don't need the pytest test module importable (same
+    # constraint as the reference without a working_dir runtime env)
+    class Plus:
+        def __init__(self, k):
+            self.k = k
+
+        def add(self, x):
+            return x + self.k
+
+    return Plus
+
+
+def _build_pipeline(rt, stages=4):
+    Plus = _make_plus()
+    nodes = [rt.remote(Plus).bind(10 ** i) for i in range(stages)]
+    with InputNode() as inp:
+        x = inp
+        for node in nodes:
+            x = node.add.bind(x)
+    return x
+
+
+def test_channel_pipeline_correctness(rt):
+    dag = _build_pipeline(rt, stages=4).experimental_compile(channels=True)
+    try:
+        futs = [dag.execute(i) for i in range(3)]
+        # 1 + 10 + 100 + 1000 = 1111 added per item
+        assert [f.get() for f in futs] == [1111, 1112, 1113]
+        # out-of-order gets work (FIFO buffer)
+        futs = [dag.execute(10 * i) for i in range(3)]
+        assert futs[2].get() == 1131
+        assert futs[0].get() == 1111
+        assert futs[1].get() == 1121
+    finally:
+        dag.teardown()
+
+
+def test_channel_pipeline_beats_per_call_rpc(rt):
+    """The VERDICT item-6 benchmark: a 4-stage channel pipeline moving
+    1 MB activations (the pipeline-parallel payload shape) must beat the
+    same chain issued as per-call actor RPCs through the driver by >5x —
+    channels cost ONE shm memcpy per hop; the RPC path pays
+    pickle+TCP+scheduling twice per hop plus a driver round trip."""
+    n_items = 30
+    payload = np.ones(128 * 1024, dtype=np.float64)  # 1 MB
+
+    Plus = _make_plus()
+    actors = [rt.remote(Plus).options(num_cpus=0).remote(float(i + 1))
+              for i in range(4)]
+    rt.get([a.add.remote(payload) for a in actors])  # warm up
+    t0 = time.perf_counter()
+    for i in range(n_items):
+        v = payload
+        for a in actors:
+            v = rt.get(a.add.remote(v), timeout=60)
+        assert v[0] == 1 + 1 + 2 + 3 + 4
+    rpc_s = time.perf_counter() - t0
+
+    Plus2 = _make_plus()
+    nodes = [rt.remote(Plus2).bind(float(i + 1)) for i in range(4)]
+    with InputNode() as inp:
+        x = inp
+        for node in nodes:
+            x = node.add.bind(x)
+    dag = x.experimental_compile(channels=True, channel_capacity=16 << 20)
+    try:
+        assert dag.execute(payload).get()[0] == 11.0  # warm the loops
+        t0 = time.perf_counter()
+        futs = [dag.execute(payload) for _ in range(n_items)]
+        out = [f.get() for f in futs]
+        chan_s = time.perf_counter() - t0
+    finally:
+        dag.teardown()
+    assert all(o[0] == 11.0 for o in out)
+    speedup = rpc_s / chan_s
+    assert speedup > 5.0, (rpc_s, chan_s, speedup)
+
+
+def test_channel_closed_on_teardown(rt):
+    from ray_tpu.graph.channels import ChannelClosed, ShmChannel
+
+    dag = _build_pipeline(rt, stages=2).experimental_compile(channels=True)
+    name0 = dag._channels[0].name
+    dag.teardown()
+    reopened = ShmChannel(name0, _create=True)  # re-creates post-unlink
+    reopened.close()
+    reopened.unlink()
+
+
+def test_device_buffer_channel_two_actor_tp_graph(rt):
+    """2-actor tensor-parallel inference handoff on the CPU mesh: stage 1
+    computes a partial matmul, ships the activation through a
+    DeviceBufferChannel as a jax array, stage 2 finishes the product."""
+    import uuid
+
+    from ray_tpu.graph.channels import DeviceBufferChannel
+
+    name = f"/rtdb_{uuid.uuid4().hex[:8]}"
+    ch = DeviceBufferChannel(name, capacity=1 << 20, num_readers=1)
+    ch._ch._handle()
+
+    class Stage1:
+        def __init__(self, w1, chan):
+            self.w1 = np.asarray(w1)
+            self.chan = chan
+
+        def run(self, x):
+            import jax.numpy as jnp
+
+            y = jnp.asarray(x) @ jnp.asarray(self.w1)
+            self.chan.write(y)
+            return True
+
+    class Stage2:
+        def __init__(self, w2, chan):
+            self.w2 = np.asarray(w2)
+            self.chan = chan
+
+        def run(self):
+            import jax.numpy as jnp
+
+            y = self.chan.read(timeout_s=60)
+            return np.asarray(y @ jnp.asarray(self.w2))
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w1 = rng.normal(size=(8, 16)).astype(np.float32)
+    w2 = rng.normal(size=(16, 2)).astype(np.float32)
+
+    s1 = rt.remote(Stage1).options(num_cpus=0).remote(w1, ch)
+    s2 = rt.remote(Stage2).options(num_cpus=0).remote(w2, ch)
+    out_ref = s2.run.remote()
+    assert rt.get(s1.run.remote(x), timeout=60)
+    out = rt.get(out_ref, timeout=60)
+    np.testing.assert_allclose(out, x @ w1 @ w2, rtol=1e-4, atol=1e-4)
+    ch.close()
+    ch.unlink()
+
+
+def test_stage_error_propagates_to_driver(rt):
+    """A raising stage must surface the error on .get(), not wedge the
+    pipeline."""
+    from ray_tpu.graph.compiled import PipelineStageError
+
+    def make_bad():
+        class Bad:
+            def __init__(self, _):
+                pass
+
+            def add(self, x):
+                raise ValueError(f"boom on {x}")
+
+        return Bad
+
+    Plus = _make_plus()
+    nodes = [rt.remote(Plus).bind(1), rt.remote(make_bad()).bind(0)]
+    with InputNode() as inp:
+        x = inp
+        for node in nodes:
+            x = node.add.bind(x)
+    dag = x.experimental_compile(channels=True)
+    try:
+        fut = dag.execute(7)
+        with pytest.raises(PipelineStageError, match="boom"):
+            fut.get(timeout_s=30)
+        # pipeline still alive for the next item
+        with pytest.raises(PipelineStageError):
+            dag.execute(8).get(timeout_s=30)
+    finally:
+        dag.teardown()

@@ -168,9 +168,8 @@ class TestStreamingExecution:
 
     def test_shuffle_runs_distributed_not_single_task(self, rt):
         """The shuffle map stage must emit one partition task per input
-        block (not one whole-dataset task): verify via per-block task
-        structure — num_blocks outputs from repartition of a multi-block
-        dataset, with rows preserved."""
+        block (not one whole-dataset task), and repartition must preserve
+        global row ORDER (contiguous range partitioning)."""
         ds = rd.range(300, num_blocks=6).repartition(3)
         assert ds.num_blocks() == 3
-        assert sorted(r["id"] for r in ds.take_all()) == list(range(300))
+        assert [r["id"] for r in ds.take_all()] == list(range(300))
