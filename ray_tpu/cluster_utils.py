@@ -17,12 +17,30 @@ from ray_tpu.raylet.raylet import Raylet
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[dict] = None):
-        self.gcs = GcsServer()
+                 head_node_args: Optional[dict] = None,
+                 persist_dir: Optional[str] = None):
+        self.persist_dir = persist_dir
+        self.gcs = GcsServer(persist_dir=persist_dir)
         self.gcs.start()
         self.raylets: List[Raylet] = []
         if initialize_head:
             self.add_node(**(head_node_args or {}))
+
+    def kill_gcs(self):
+        """Simulate a GCS crash: stop the server, leave raylets running."""
+        self.gcs.server.stop()
+        self.gcs._stopped = True
+        if self.gcs.storage is not None:
+            self.gcs.storage.close()
+        self.gcs.kv.close()
+
+    def restart_gcs(self):
+        """Bring the GCS back at the SAME address, recovering state from the
+        persist log; surviving raylets re-register via their report loop."""
+        addr = self.gcs.address
+        self.gcs = GcsServer(host=addr[0], port=addr[1],
+                             persist_dir=self.persist_dir)
+        self.gcs.start()
 
     @property
     def address(self) -> str:

@@ -1,47 +1,32 @@
 """Internal key-value store (reference: gcs/gcs_server/gcs_kv_manager.h).
 
 Namespaced binary KV used for: collective group rendezvous, named actors,
-function table, cluster metadata.  In-memory with an optional JSON-lines
-append log for GCS restart recovery (the reference's Redis-backed fault
-tolerance, store_client/redis_store_client.h, is modeled as a flush/replay
-file since Redis isn't part of this image).
+function table, cluster metadata.  In-memory, with optional durability
+delegated to :class:`~ray_tpu.gcs.storage.GcsTableStorage` (one "kv" table
+in the shared GCS table log machinery) — the reference's Redis-backed fault
+tolerance (store_client/redis_store_client.h) modeled as replay-on-restart,
+with torn-tail tolerance and compaction inherited from the table store.
 """
 
 from __future__ import annotations
 
-import os
-import pickle
 import threading
 from typing import Dict, List, Optional
 
 
 class InternalKV:
-    def __init__(self, persist_path: Optional[str] = None):
+    def __init__(self, storage=None, *, owns_storage: bool = False):
+        """``storage`` is a :class:`GcsTableStorage` (usually the GCS
+        server's own, shared) whose "kv" table backs this store; None keeps
+        the KV purely in-memory. The storage is only closed here when this
+        KV created it (``owns_storage``)."""
         self._data: Dict[bytes, bytes] = {}
         self._lock = threading.Lock()
-        self._persist_path = persist_path
-        self._log = None
-        if persist_path:
-            if os.path.exists(persist_path):
-                self._replay(persist_path)
-            self._log = open(persist_path, "ab")
-
-    def _replay(self, path: str):
-        with open(path, "rb") as f:
-            while True:
-                try:
-                    op, key, value = pickle.load(f)
-                except EOFError:
-                    break
-                if op == "put":
-                    self._data[key] = value
-                elif op == "del":
-                    self._data.pop(key, None)
-
-    def _append(self, op: str, key: bytes, value: Optional[bytes]):
-        if self._log is not None:
-            pickle.dump((op, key, value), self._log)
-            self._log.flush()
+        self._storage = storage
+        self._owns_storage = owns_storage
+        if storage is not None:
+            for key, rec in storage.all("kv").items():
+                self._data[key] = rec["v"]
 
     @staticmethod
     def _k(namespace: str, key: bytes | str) -> bytes:
@@ -55,7 +40,8 @@ class InternalKV:
             if not overwrite and k in self._data:
                 return False
             self._data[k] = value
-            self._append("put", k, value)
+            if self._storage is not None:
+                self._storage.put("kv", k, {"v": value})
             return True
 
     def get(self, namespace: str, key) -> Optional[bytes]:
@@ -70,8 +56,8 @@ class InternalKV:
         k = self._k(namespace, key)
         with self._lock:
             existed = self._data.pop(k, None) is not None
-            if existed:
-                self._append("del", k, None)
+            if existed and self._storage is not None:
+                self._storage.delete("kv", k)
             return existed
 
     def keys(self, namespace: str, prefix: bytes | str = b"") -> List[bytes]:
@@ -81,6 +67,6 @@ class InternalKV:
             return [k[ns_len:] for k in self._data if k.startswith(p)]
 
     def close(self):
-        if self._log is not None:
-            self._log.close()
-            self._log = None
+        if self._storage is not None and self._owns_storage:
+            self._storage.close()
+        self._storage = None

@@ -47,6 +47,7 @@ class ActorRecord:
     name: Optional[str]
     creation_spec: bytes  # pickled TaskSpec for the creation task
     max_restarts: int
+    namespace: str = "default"
     state: str = ACTOR_PENDING
     node_id: Optional[NodeID] = None
     worker_id: Optional[WorkerID] = None
@@ -54,6 +55,41 @@ class ActorRecord:
     num_restarts: int = 0
     death_cause: str = ""
     handled_deaths: set = field(default_factory=set)
+
+    def to_store(self) -> dict:
+        return {
+            "actor_id": self.actor_id.binary(),
+            "job_id": self.job_id.binary(),
+            "name": self.name,
+            "namespace": self.namespace,
+            "creation_spec": self.creation_spec,
+            "max_restarts": self.max_restarts,
+            "state": self.state,
+            "node_id": self.node_id and self.node_id.binary(),
+            "worker_id": self.worker_id and self.worker_id.binary(),
+            "address": self.address,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "handled_deaths": [w.binary() for w in self.handled_deaths],
+        }
+
+    @classmethod
+    def from_store(cls, d: dict) -> "ActorRecord":
+        return cls(
+            actor_id=ActorID(d["actor_id"]),
+            job_id=JobID(d["job_id"]),
+            name=d["name"],
+            namespace=d.get("namespace", "default"),
+            creation_spec=d["creation_spec"],
+            max_restarts=d["max_restarts"],
+            state=d["state"],
+            node_id=d["node_id"] and NodeID(d["node_id"]),
+            worker_id=d["worker_id"] and WorkerID(d["worker_id"]),
+            address=d["address"] and tuple(d["address"]),
+            num_restarts=d["num_restarts"],
+            death_cause=d["death_cause"],
+            handled_deaths={WorkerID(w) for w in d["handled_deaths"]},
+        )
 
     def public_view(self) -> dict:
         return {
@@ -89,6 +125,29 @@ class PgRecord:
             "bundle_nodes": [n.hex() if n else None for n in self.bundle_nodes],
         }
 
+    def to_store(self) -> dict:
+        return {
+            "pg_id": self.pg_id.binary(),
+            "name": self.name,
+            "bundles": [b.to_dict() for b in self.bundles],
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundle_nodes": [n and n.binary() for n in self.bundle_nodes],
+            "creator_job": self.creator_job and self.creator_job.binary(),
+        }
+
+    @classmethod
+    def from_store(cls, d: dict) -> "PgRecord":
+        return cls(
+            pg_id=PlacementGroupID(d["pg_id"]),
+            name=d["name"],
+            bundles=[ResourceRequest.from_dict(b) for b in d["bundles"]],
+            strategy=d["strategy"],
+            state=d["state"],
+            bundle_nodes=[n and NodeID(n) for n in d["bundle_nodes"]],
+            creator_job=d["creator_job"] and JobID(d["creator_job"]),
+        )
+
 
 @dataclass
 class JobRecord:
@@ -115,12 +174,15 @@ class GcsServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: Optional[str] = None):
         from .kv import InternalKV
+        from .storage import GcsTableStorage
 
         self.server = RpcServer(host, port)
         self.publisher = Publisher()
         self.publisher.attach(self.server)
         self.view = ClusterView()
-        self.kv = InternalKV(persist_dir and f"{persist_dir}/gcs_kv.log")
+        self.storage: Optional[GcsTableStorage] = (
+            GcsTableStorage(f"{persist_dir}/gcs_tables.log") if persist_dir else None)
+        self.kv = InternalKV(self.storage)
         self._raylets: Dict[NodeID, RayletHandle] = {}
         self._actors: Dict[ActorID, ActorRecord] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace,name)
@@ -132,8 +194,72 @@ class GcsServer:
         self._pending_actor_queue: List[ActorID] = []
         self._pending_pg_queue: List[PlacementGroupID] = []
         self._node_demands: Dict[NodeID, List[dict]] = {}  # autoscaler feed
+        # Actors persisted ALIVE whose hosting raylet hasn't re-registered yet
+        # after a GCS restart (reference: gcs_actor_manager.cc restart path —
+        # wait for raylet reports, then fail over the unclaimed).
+        self._unconfirmed_actors: set = set()
+        self._recovered = False
         self._io = IoContext.current()
+        if self.storage is not None:
+            self._replay_tables()
         self._register_handlers()
+
+    # ------------------------------------------------------------ persistence
+    def _replay_tables(self):
+        """Rebuild control-plane state from the table log (GCS restart).
+
+        Nodes are NOT replayed — raylets outlive the GCS and re-register
+        themselves (their report_resources gets ``unknown`` and triggers a
+        fresh register_node carrying live actors + held bundles), which is
+        how the reference handles GCS failover (NotifyGCSRestart,
+        node_manager.proto:397).
+        """
+        for raw in self.storage.all("jobs").values():
+            rec = JobRecord(JobID(raw["job_id"]),
+                            raw["driver_address"] and tuple(raw["driver_address"]),
+                            raw["start_time"], raw["state"], raw["entrypoint"])
+            self._jobs[rec.job_id] = rec
+        meta = self.storage.get("meta", b"job_counter")
+        if meta:
+            self._job_counter = meta["value"]
+        for raw in self.storage.all("actors").values():
+            rec = ActorRecord.from_store(raw)
+            self._actors[rec.actor_id] = rec
+            if rec.name is not None and rec.state != ACTOR_DEAD:
+                self._named_actors[(rec.namespace, rec.name)] = rec.actor_id
+            if rec.state == ACTOR_ALIVE:
+                self._unconfirmed_actors.add(rec.actor_id)
+            elif rec.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                self._pending_actor_queue.append(rec.actor_id)
+        for raw in self.storage.all("pgs").values():
+            rec = PgRecord.from_store(raw)
+            self._pgs[rec.pg_id] = rec
+            if rec.state == PG_CREATED:
+                # bundle placements must be re-claimed by re-registering
+                # raylets; unclaimed ones are rescheduled by the reconciler
+                rec.bundle_nodes = [None] * len(rec.bundles)
+            elif rec.state in (PG_PENDING, PG_RESCHEDULING):
+                self._pending_pg_queue.append(rec.pg_id)
+        if self._actors or self._pgs or self._jobs:
+            self._recovered = True
+
+    def _persist_actor(self, rec: ActorRecord):
+        if self.storage is not None:
+            self.storage.put("actors", rec.actor_id.binary(), rec.to_store())
+
+    def _persist_pg(self, rec: PgRecord):
+        if self.storage is not None:
+            self.storage.put("pgs", rec.pg_id.binary(), rec.to_store())
+
+    def _persist_job(self, rec: JobRecord):
+        if self.storage is not None:
+            self.storage.put("jobs", rec.job_id.binary(), {
+                "job_id": rec.job_id.binary(),
+                "driver_address": rec.driver_address,
+                "start_time": rec.start_time,
+                "state": rec.state,
+                "entrypoint": rec.entrypoint,
+            })
 
     # ------------------------------------------------------------------ setup
     def _register_handlers(self):
@@ -156,6 +282,8 @@ class GcsServer:
     def start(self):
         self.server.start()
         self._io.spawn_threadsafe(self._health_loop())
+        if self._recovered:
+            self._io.spawn_threadsafe(self._reconcile_after_restart())
 
     def stop(self):
         self._stopped = True
@@ -163,6 +291,8 @@ class GcsServer:
             h.close()
         self.server.stop()
         self.kv.close()
+        if self.storage is not None:
+            self.storage.close()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -170,7 +300,9 @@ class GcsServer:
 
     # ------------------------------------------------------------- node mgmt
     async def h_register_node(self, node_id: bytes, address, resources: dict, labels: dict,
-                              object_store_address: Optional[str] = None):
+                              object_store_address: Optional[str] = None,
+                              live_actors: Optional[List[dict]] = None,
+                              held_bundles: Optional[List[dict]] = None):
         nid = NodeID(node_id)
         entry = NodeEntry(
             node_id=nid,
@@ -182,8 +314,103 @@ class GcsServer:
         self._raylets[nid] = RayletHandle(tuple(address))
         self.publisher.publish("node", nid.hex(), {"state": "ALIVE", "address": tuple(address)})
         logger.info("node %s registered at %s", nid.hex()[:8], address)
+        # Re-registration after a GCS restart: the raylet reports what it
+        # still hosts so replayed records can be re-confirmed instead of
+        # restarted (reference: raylet re-report on NotifyGCSRestart).
+        stale_workers: List[bytes] = []
+        for info in live_actors or []:
+            rec = self._actors.get(ActorID(info["actor_id"]))
+            # Only actors still awaiting reconfirmation may be reclaimed: a
+            # raylet that re-registers AFTER the reconcile window must not
+            # re-point a record the reconciler already failed over (that
+            # incarnation may be restarting elsewhere — reclaiming it would
+            # leave two live copies with clients routed to the stale one).
+            # The stale copy can't just be skipped either: left alone it
+            # would run forever holding its lease, so it is killed here.
+            if rec is None or rec.actor_id not in self._unconfirmed_actors:
+                stale_workers.append(info["worker_id"])
+                continue
+            rec.state = ACTOR_ALIVE
+            rec.node_id = nid
+            rec.worker_id = WorkerID(info["worker_id"])
+            rec.address = info["address"] and tuple(info["address"])
+            self._unconfirmed_actors.discard(rec.actor_id)
+            self._persist_actor(rec)
+            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+        stale_pgs = []
+        for info in held_bundles or []:
+            rec = self._pgs.get(PlacementGroupID(info["pg_id"]))
+            if rec is None or rec.state != PG_CREATED:
+                # the PG was removed, or is being rescheduled from scratch:
+                # the raylet's surviving allocations must be freed, not kept
+                stale_pgs.append(info["pg_id"])
+                continue
+            for idx in info["indices"]:
+                # only fill unclaimed slots — never steal a slot the
+                # reconciler already rescheduled onto another node
+                if 0 <= idx < len(rec.bundle_nodes) and rec.bundle_nodes[idx] is None:
+                    rec.bundle_nodes[idx] = nid
+            self._persist_pg(rec)
+        if stale_pgs or stale_workers:
+            handle = self._raylets.get(nid)
+
+            async def drop_stale(handle=handle, pgs=stale_pgs,
+                                 workers=stale_workers):
+                for pg_raw in pgs:
+                    try:
+                        await handle.client.call_async(
+                            "return_bundles", pg_id=pg_raw, timeout=10.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                for wid_raw in workers:
+                    try:
+                        await handle.client.call_async(
+                            "kill_worker", worker_id=wid_raw, timeout=10.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            if handle is not None:
+                self._io.spawn_threadsafe(drop_stale())
         self._kick_pending()
         return {"ok": True, "system_config": GLOBAL_CONFIG.system_config_json()}
+
+    async def _reconcile_after_restart(self):
+        """After a restart, give surviving raylets one reconnect window, then
+        fail over whatever nobody re-claimed: ALIVE actors on missing nodes
+        restart through the normal budgeted path; CREATED PGs with unclaimed
+        bundles go back through 2PC scheduling."""
+        await asyncio.sleep(GLOBAL_CONFIG.get("gcs_restart_reconcile_delay_s"))
+        if self._stopped:
+            return
+        for aid in list(self._unconfirmed_actors):
+            # Re-check membership at each step: _schedule_actor below awaits
+            # RPCs, and a raylet's h_register_node may reclaim a later entry
+            # of this snapshot meanwhile — failing that one over too would
+            # fork the actor into two live copies.
+            if aid not in self._unconfirmed_actors:
+                continue
+            self._unconfirmed_actors.discard(aid)
+            rec = self._actors.get(aid)
+            if rec is not None and rec.state == ACTOR_ALIVE:
+                await self._on_actor_failure(
+                    rec, "hosting node lost across GCS restart")
+        for rec in list(self._pgs.values()):
+            if rec.state == PG_CREATED and any(n is None for n in rec.bundle_nodes):
+                # tear down surviving partial placements, then reschedule
+                for nid in {n for n in rec.bundle_nodes if n is not None}:
+                    handle = self._raylets.get(nid)
+                    if handle:
+                        try:
+                            await handle.client.call_async(
+                                "return_bundles", pg_id=rec.pg_id.binary(),
+                                timeout=10.0)
+                        except Exception:  # noqa: BLE001
+                            pass
+                rec.state = PG_RESCHEDULING
+                rec.bundle_nodes = [None] * len(rec.bundles)
+                self._persist_pg(rec)
+                self._pending_pg_queue.append(rec.pg_id)
+        self._kick_pending()
 
     async def h_unregister_node(self, node_id: bytes):
         nid = NodeID(node_id)
@@ -290,6 +517,7 @@ class GcsServer:
             if pg.state in (PG_CREATED, PG_PENDING) and any(b == nid for b in pg.bundle_nodes):
                 pg.state = PG_RESCHEDULING
                 pg.bundle_nodes = [None if b == nid else b for b in pg.bundle_nodes]
+                self._persist_pg(pg)
                 self.publisher.publish("pg", pg.pg_id.hex(), pg.public_view())
                 self._pending_pg_queue.append(pg.pg_id)
         self._kick_pending()
@@ -297,11 +525,14 @@ class GcsServer:
     # ------------------------------------------------------------------ jobs
     async def h_get_next_job_id(self):
         self._job_counter += 1
+        if self.storage is not None:
+            self.storage.put("meta", b"job_counter", {"value": self._job_counter})
         return JobID.from_int(self._job_counter).binary()
 
     async def h_register_job(self, job_id: bytes, driver_address=None, entrypoint: str = ""):
         jid = JobID(job_id)
         self._jobs[jid] = JobRecord(jid, driver_address and tuple(driver_address), time.time(), entrypoint=entrypoint)
+        self._persist_job(self._jobs[jid])
         self.publisher.publish("job", jid.hex(), {"state": "RUNNING"})
         return True
 
@@ -310,6 +541,7 @@ class GcsServer:
         rec = self._jobs.get(jid)
         if rec:
             rec.state = "FINISHED"
+            self._persist_job(rec)
             self.publisher.publish("job", jid.hex(), {"state": "FINISHED"})
         # tear down the job's detached=False actors
         for actor in list(self._actors.values()):
@@ -338,9 +570,11 @@ class GcsServer:
             self._named_actors[key] = aid
         rec = ActorRecord(
             actor_id=aid, job_id=JobID(job_id), name=name,
+            namespace=namespace,
             creation_spec=creation_spec, max_restarts=max_restarts,
         )
         self._actors[aid] = rec
+        self._persist_actor(rec)
         await self._schedule_actor(rec)
         return {"ok": True}
 
@@ -359,6 +593,7 @@ class GcsServer:
         if handle is None:
             return
         rec.node_id = node.node_id
+        self._persist_actor(rec)
         try:
             reply = await handle.client.call_async(
                 "start_actor", creation_spec=rec.creation_spec, timeout=60.0
@@ -384,6 +619,8 @@ class GcsServer:
             rec.address = address and tuple(address)
             if node_id:
                 rec.node_id = NodeID(node_id)
+            self._unconfirmed_actors.discard(rec.actor_id)
+            self._persist_actor(rec)
         elif state == ACTOR_DEAD:
             # Idempotency: a death report is only valid once per worker
             # incarnation — RPC retries deliver duplicates, which must not
@@ -411,11 +648,13 @@ class GcsServer:
             rec.state = ACTOR_RESTARTING
             rec.address = None
             rec.worker_id = None
+            self._persist_actor(rec)
             self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
             await self._schedule_actor(rec)
         else:
             rec.state = ACTOR_DEAD
             rec.death_cause = cause
+            self._persist_actor(rec)
             self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
 
     async def h_get_actor(self, actor_id: bytes):
@@ -464,6 +703,7 @@ class GcsServer:
             creator_job=job_id and JobID(job_id),
         )
         self._pgs[pgid] = rec
+        self._persist_pg(rec)
         await self._schedule_pg(rec)
         return {"ok": True, "state": rec.state}
 
@@ -536,11 +776,13 @@ class GcsServer:
                     except Exception:  # noqa: BLE001
                         pass
             rec.state = PG_RESCHEDULING
+            self._persist_pg(rec)
             if rec.pg_id not in self._pending_pg_queue:
                 self._pending_pg_queue.append(rec.pg_id)
             return
         rec.bundle_nodes = list(placement)
         rec.state = PG_CREATED
+        self._persist_pg(rec)
         self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
 
     async def h_remove_placement_group(self, pg_id: bytes):
@@ -557,6 +799,7 @@ class GcsServer:
                 except Exception:  # noqa: BLE001
                     pass
         rec.state = PG_REMOVED
+        self._persist_pg(rec)
         self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
         return True
 

@@ -115,14 +115,35 @@ class Raylet:
         ):
             s.register(name, getattr(self, f"h_{name}"))
 
+    def _registration_payload(self) -> dict:
+        """What this node tells the GCS at (re-)registration: its shape plus
+        everything it still hosts, so a restarted GCS can re-confirm replayed
+        actor/PG records instead of failing them over (reference: raylet
+        re-report on NotifyGCSRestart, node_manager.proto:397)."""
+        live_actors = [
+            {"actor_id": w.actor_id, "worker_id": w.worker_id.binary(),
+             "address": w.address}
+            for w in self._workers.values()
+            if w.state == "ACTOR" and w.actor_id is not None
+            and (w.proc is None or w.proc.poll() is None)
+        ]
+        held_bundles = [
+            {"pg_id": pgid.binary(),
+             "indices": [i for i, b in bundles.items() if b.committed]}
+            for pgid, bundles in self._bundles.items()
+        ]
+        return dict(
+            node_id=self.node_id.binary(),
+            address=self.server.address,
+            resources=self.resources.total.to_dict(),
+            labels=self.resources.labels,
+            live_actors=live_actors,
+            held_bundles=held_bundles,
+        )
+
     def start(self):
         self.server.start()
-        reply = self.gcs.register_node(
-            self.node_id,
-            self.server.address,
-            self.resources.total.to_dict(),
-            self.resources.labels,
-        )
+        reply = self.gcs.call("register_node", **self._registration_payload())
         GLOBAL_CONFIG.initialize(reply.get("system_config") or "{}")
         GLOBAL_CONFIG.reset_cache()
         # seed the local cluster view, then keep it fresh via pubsub
@@ -201,7 +222,7 @@ class Raylet:
         while not self._stopped:
             self._seq += 1
             try:
-                await self.gcs.call_async(
+                reply = await self.gcs.call_async(
                     "report_resources",
                     node_id=self.node_id.binary(),
                     snapshot=self.resources.snapshot(),
@@ -213,6 +234,10 @@ class Raylet:
                              for item in self._pending_leases
                              if not item["future"].done()],
                 )
+                if isinstance(reply, dict) and reply.get("unknown"):
+                    # GCS restarted and lost us: re-register with live state
+                    await self.gcs.call_async(
+                        "register_node", **self._registration_payload())
             except Exception:  # noqa: BLE001 - GCS may be restarting
                 pass
             # keep our own entry in the local view fresh for spillback scoring
@@ -571,6 +596,14 @@ class Raylet:
     # --------------------------------------------------------------- PG (2PC)
     async def h_prepare_bundles(self, pg_id: bytes, bundles: Dict[int, dict]):
         pgid = PlacementGroupID(pg_id)
+        # Idempotent re-prepare (GCS may 2PC the same pg_id again after a
+        # restart/reschedule): free any allocation this node still holds for
+        # an index being re-prepared, or it leaks when overwritten below.
+        existing = self._bundles.get(pgid, {})
+        for idx in list(bundles):
+            old = existing.pop(int(idx), None) or existing.pop(idx, None)
+            if old is not None:
+                self.resources.free(old.request, old.assignment)
         prepared: Dict[int, Bundle] = {}
         for idx, bdict in bundles.items():
             request = ResourceRequest.from_dict(bdict)

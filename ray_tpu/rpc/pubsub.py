@@ -52,6 +52,13 @@ class Publisher:
         return True
 
     async def _handle_poll(self, subscriber_id: str, timeout: float = 30.0):
+        with self._lock:
+            known = subscriber_id in self._subs
+        if not known:
+            # Publisher restarted (GCS failover) and lost the subscription
+            # table; the poller must re-issue its subscribes before messages
+            # can flow again.
+            return "__resubscribe__"
         event = self._wakeups.setdefault(subscriber_id, asyncio.Event())
         deadline = time.monotonic() + timeout
         while True:
@@ -97,6 +104,7 @@ class Subscriber:
         self._prefix = prefix
         self._client = RetryableRpcClient(address)
         self._callbacks: Dict[str, Callable[[str, Any], None]] = {}
+        self._keys: Dict[str, Optional[str]] = {}
         self._stopped = threading.Event()
         self._task = None
         self._io = IoContext.current()
@@ -123,6 +131,7 @@ class Subscriber:
 
     def subscribe(self, channel: str, callback: Callable[[str, Any], None], key: Optional[str] = None):
         self._callbacks[channel] = callback
+        self._keys[channel] = key
         self._client.call(self._prefix + "subscribe", subscriber_id=self.subscriber_id, channel=channel, key=key)
         if self._task is None:
             self._task = True
@@ -138,6 +147,18 @@ class Subscriber:
                 if self._stopped.is_set():
                     return
                 await asyncio.sleep(0.2)
+                continue
+            if batch == "__resubscribe__":
+                # publisher restarted: replay every subscription, then poll
+                for channel in list(self._callbacks):
+                    try:
+                        await self._client.call_async(
+                            self._prefix + "subscribe",
+                            subscriber_id=self.subscriber_id,
+                            channel=channel, key=self._keys.get(channel))
+                    except Exception:  # noqa: BLE001
+                        break
+                await asyncio.sleep(0.05)
                 continue
             for channel, key, message in batch or []:
                 cb = self._callbacks.get(channel)

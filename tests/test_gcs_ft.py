@@ -1,0 +1,254 @@
+"""GCS fault tolerance (VERDICT "What's missing" #9): table persistence,
+restart recovery, raylet re-registration with live state, pubsub resubscribe.
+
+Reference behavior being matched: Redis-backed GCS state
+(src/ray/gcs/store_client/redis_store_client.h) + raylet reconnect/replay on
+GCS restart (NotifyGCSRestart, node_manager.proto:397) — a GCS crash must not
+kill running actors, lose named-actor registrations, or drop placement
+groups.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common.config import GLOBAL_CONFIG
+
+
+@pytest.fixture
+def ft_cluster(tmp_path):
+    GLOBAL_CONFIG.set_system_config_value("gcs_restart_reconcile_delay_s", 1.0)
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4},
+                persist_dir=str(tmp_path))
+    yield c
+    try:
+        ray_tpu.shutdown()
+    finally:
+        c.shutdown()
+        GLOBAL_CONFIG.set_system_config_value(
+            "gcs_restart_reconcile_delay_s", 2.0)
+
+
+def _make_counter():
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    return Counter
+
+
+def test_storage_roundtrip(tmp_path):
+    from ray_tpu.gcs.storage import GcsTableStorage
+
+    path = str(tmp_path / "tables.log")
+    s = GcsTableStorage(path)
+    s.put("actors", b"a1", {"state": "ALIVE"})
+    s.put("actors", b"a1", {"state": "DEAD"})
+    s.put("pgs", b"p1", {"state": "CREATED"})
+    s.delete("pgs", b"p1")
+    s.close()
+
+    s2 = GcsTableStorage(path)
+    assert s2.all("actors") == {b"a1": {"state": "DEAD"}}
+    assert s2.all("pgs") == {}
+    s2.close()
+
+
+def test_storage_survives_torn_tail(tmp_path):
+    from ray_tpu.gcs.storage import GcsTableStorage
+
+    path = str(tmp_path / "tables.log")
+    s = GcsTableStorage(path)
+    s.put("kv", b"k", {"v": 1})
+    s.close()
+    with open(path, "ab") as f:
+        f.write(b"\x80\x05garbage-torn-frame")  # crash mid-append
+    s2 = GcsTableStorage(path)
+    assert s2.get("kv", b"k") == {"v": 1}
+    s2.put("kv", b"k2", {"v": 2})  # log still writable post-compaction
+    s2.close()
+    s3 = GcsTableStorage(path)
+    assert s3.get("kv", b"k2") == {"v": 2}
+    s3.close()
+
+
+def test_actor_survives_gcs_restart(ft_cluster):
+    """An ALIVE actor keeps serving through a GCS crash+restart, and the
+    restarted GCS re-learns it from the raylet's re-registration (NOT via
+    restart — num_restarts must stay 0)."""
+    ray_tpu.init(address=ft_cluster.address)
+    Counter = _make_counter()
+    a = ray_tpu.remote(Counter).options(
+        name="survivor", max_restarts=2).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+
+    ft_cluster.kill_gcs()
+    time.sleep(0.3)
+    ft_cluster.restart_gcs()
+
+    # wait for the raylet to re-register and re-claim the actor
+    from ray_tpu.gcs.client import GcsClient
+
+    c = GcsClient(ft_cluster.gcs.address)
+    deadline = time.monotonic() + 15
+    view = None
+    try:
+        while time.monotonic() < deadline:
+            view = c.get_actor_by_name("survivor")
+            if view is not None and view["state"] == "ALIVE":
+                break
+            time.sleep(0.1)
+    finally:
+        c.close()
+    assert view is not None and view["state"] == "ALIVE"
+    assert view["num_restarts"] == 0
+    # the actor's in-memory state survived (same process, not a restart)
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 2
+
+
+def test_named_actor_lookup_after_restart(ft_cluster):
+    ray_tpu.init(address=ft_cluster.address)
+    Counter = _make_counter()
+    ray_tpu.remote(Counter).options(name="registry").remote()
+    time.sleep(0.5)
+    ft_cluster.kill_gcs()
+    ft_cluster.restart_gcs()
+    deadline = time.monotonic() + 15
+    h = None
+    while time.monotonic() < deadline:
+        try:
+            h = ray_tpu.get_actor("registry")
+            break
+        except ValueError:
+            time.sleep(0.2)
+    assert h is not None
+    assert ray_tpu.get(h.incr.remote(), timeout=30) == 1
+
+
+def test_namespaced_name_survives_restart(ft_cluster):
+    """The namespace must be persisted with the record — on replay the name
+    index is rebuilt as (namespace, name), not ('default', name)."""
+    ray_tpu.init(address=ft_cluster.address)
+    from ray_tpu.gcs.client import GcsClient
+
+    Counter = _make_counter()
+    cw = ray_tpu.api._core_worker()
+    # create through the core worker to pass a non-default namespace
+    cw.create_actor(Counter, (), {}, resources={"CPU": 0},
+                    name="nsvc", namespace="ns1")
+    time.sleep(0.5)
+    ft_cluster.kill_gcs()
+    ft_cluster.restart_gcs()
+    c = GcsClient(ft_cluster.gcs.address)
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            view = c.get_actor_by_name("nsvc", namespace="ns1")
+            if view is not None and view["state"] == "ALIVE":
+                break
+            time.sleep(0.1)
+        assert view is not None and view["state"] == "ALIVE"
+        assert c.get_actor_by_name("nsvc", namespace="default") is None
+    finally:
+        c.close()
+
+
+def test_kv_and_jobs_survive_restart(ft_cluster):
+    ray_tpu.init(address=ft_cluster.address)
+    from ray_tpu.gcs.client import GcsClient
+
+    c = GcsClient(ft_cluster.gcs.address)
+    c.kv_put("test", b"key", b"value")
+    jobs_before = c.call("get_all_jobs")
+    assert len(jobs_before) >= 1
+    c.close()
+
+    ft_cluster.kill_gcs()
+    ft_cluster.restart_gcs()
+
+    c = GcsClient(ft_cluster.gcs.address)
+    try:
+        assert c.kv_get("test", b"key") == b"value"
+        jobs_after = c.call("get_all_jobs")
+        assert {j["job_id"] for j in jobs_before} <= {
+            j["job_id"] for j in jobs_after}
+        # job-id counter must not rewind (new jobs must not collide)
+        nxt = c.get_next_job_id()
+        assert nxt.binary() not in {bytes.fromhex(j["job_id"])
+                                    for j in jobs_before}
+    finally:
+        c.close()
+
+
+def test_placement_group_survives_restart(ft_cluster):
+    """A CREATED PG keeps its bundles across a GCS restart: the raylet
+    re-claims them at re-registration, and leases against the PG still
+    work."""
+    ray_tpu.init(address=ft_cluster.address)
+    pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    ft_cluster.kill_gcs()
+    ft_cluster.restart_gcs()
+    time.sleep(1.5)  # > reconcile delay: must NOT be torn down
+
+    from ray_tpu.gcs.client import GcsClient
+
+    c = GcsClient(ft_cluster.gcs.address)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            view = c.get_placement_group(pg.id)
+            if view and view["state"] == "CREATED" and all(
+                    n is not None for n in view["bundle_nodes"]):
+                break
+            time.sleep(0.1)
+        assert view["state"] == "CREATED"
+        assert all(n is not None for n in view["bundle_nodes"])
+    finally:
+        c.close()
+    # a lease inside the surviving PG still schedules
+    from ray_tpu.core_worker.placement_group import (
+        PlacementGroupSchedulingStrategy)
+
+    Counter = _make_counter()
+    a = ray_tpu.remote(Counter).options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+
+
+def test_dead_actor_stays_dead_after_restart(ft_cluster):
+    """DEAD is a terminal state the restart must not resurrect."""
+    ray_tpu.init(address=ft_cluster.address)
+    Counter = _make_counter()
+    a = ray_tpu.remote(Counter).options(name="goner").remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 10
+    from ray_tpu.gcs.client import GcsClient
+
+    c = GcsClient(ft_cluster.gcs.address)
+    try:
+        while time.monotonic() < deadline:
+            if c.get_actor(a._actor_id)["state"] == "DEAD":
+                break
+            time.sleep(0.1)
+        ft_cluster.kill_gcs()
+        ft_cluster.restart_gcs()
+    finally:
+        c.close()
+    time.sleep(2.0)  # past reconcile: no resurrection allowed
+    c = GcsClient(ft_cluster.gcs.address)
+    try:
+        assert c.get_actor(a._actor_id)["state"] == "DEAD"
+        # and its name is free for reuse after death
+        assert c.get_actor_by_name("goner") is None
+    finally:
+        c.close()
