@@ -40,11 +40,33 @@ def init(
     labels: Optional[Dict[str, str]] = None,
     system_config: Optional[dict] = None,
     job_name: str = "",
+    runtime_env: Optional[dict] = None,
+    dashboard: bool = False,
+    dashboard_port: int = 0,
 ) -> dict:
-    """Start or connect. Returns {"gcs_address": (host, port), "node_id": hex}."""
+    """Start or connect. Returns {"gcs_address": (host, port), "node_id": hex}.
+
+    With no ``address``, ``RT_ADDRESS`` (exported by the job supervisor for
+    submitted drivers — reference: RAY_ADDRESS) connects to the running
+    cluster; a submitted job's runtime env becomes the driver's job-level
+    default via ``RT_JOB_RUNTIME_ENV``.
+    """
     global _head
+    import json as _json
+    import os as _os
+
     from ray_tpu.core_worker.worker import MODE_DRIVER, CoreWorker
 
+    if address is None:
+        address = _os.environ.get("RT_ADDRESS")
+    if runtime_env is None and _os.environ.get("RT_JOB_RUNTIME_ENV"):
+        runtime_env = _json.loads(_os.environ["RT_JOB_RUNTIME_ENV"])
+    if runtime_env:
+        # validate BEFORE any daemon starts: failing after GcsServer/Raylet
+        # are up would leak a running head the caller can't shut down
+        from ray_tpu.runtime_env.runtime_env import validate as _validate_env
+
+        _validate_env(runtime_env)
     with _global_lock:
         if CoreWorker._current is not None:
             raise RuntimeError("ray_tpu.init() already called; call shutdown() first")
@@ -110,8 +132,18 @@ def init(
             raylet_address=raylet_address,
             node_id=node_id,
         )
+        cw.job_runtime_env = dict(runtime_env) if runtime_env else None
         atexit.register(_shutdown_atexit)
-        return {"gcs_address": gcs_address, "node_id": node_id.hex()}
+        out = {"gcs_address": gcs_address, "node_id": node_id.hex()}
+        if dashboard and _head is not None:
+            from ray_tpu.dashboard import Dashboard
+
+            dash = Dashboard(gcs_address, _head["raylet"].session_dir,
+                             port=dashboard_port)
+            dash.start()
+            _head["dashboard"] = dash
+            out["dashboard_url"] = dash.url
+        return out
 
 
 def _shutdown_atexit():
@@ -135,6 +167,8 @@ def shutdown() -> None:
             cw.shutdown()
         if _head is not None:
             node_id = _head["raylet"].node_id
+            if _head.get("dashboard") is not None:
+                _head["dashboard"].stop()
             _head["raylet"].stop()
             _head["gcs"].stop()
             _head = None
@@ -195,6 +229,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries"),
             name=opts.get("name", self._fn.__name__),
             serialized_func=self._serialized,
+            runtime_env=opts.get("runtime_env"),
         )
         if num_returns == 1:
             return refs[0]

@@ -117,6 +117,10 @@ class TaskSpec:
     # runtime env / misc
     runtime_env: Optional[dict] = None
     name: str = ""
+    # content hash of runtime_env, computed ONCE at submit time (hashing
+    # walks working_dir trees — far too hot for shape_key, which runs on
+    # the IO loop for every task)
+    runtime_env_hash: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.from_index(self.task_id, i + 1) for i in range(self.num_returns)]
@@ -131,5 +135,13 @@ class TaskSpec:
         return [a.object_id for a in self.args if not a.is_inline and a.object_id is not None]
 
     def shape_key(self) -> tuple:
-        """Lease-pooling key: tasks with the same shape can share leases."""
-        return (self.required_resources.shape_key(), type(self.scheduling_strategy).__name__)
+        """Lease-pooling key: tasks with the same shape can share leases.
+        Runtime env joins the key — a lease's worker is a process forked
+        into ONE materialized environment."""
+        if self.runtime_env is not None and self.runtime_env_hash is None:
+            from ray_tpu.runtime_env.runtime_env import env_hash
+
+            self.runtime_env_hash = env_hash(self.runtime_env)
+        return (self.required_resources.shape_key(),
+                type(self.scheduling_strategy).__name__,
+                self.runtime_env_hash)

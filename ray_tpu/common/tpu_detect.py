@@ -79,3 +79,20 @@ def detect() -> Dict[str, object]:
                   or None)
     return {"chips": float(chips or 0.0), "topology": topology,
             "slice_name": slice_name, "worker_id": worker_id}
+
+
+def defer_tpu_preload(env: dict) -> dict:
+    """Stash the axon/PJRT boot env vars so a freshly forked process does
+    NOT connect to the TPU at interpreter startup (the sitecustomize boot
+    costs seconds and blocks entirely when the tunnel is busy). The stashed
+    vars are restored by the worker when a TPU lease actually lands on it
+    (core_worker h_set_visible_devices), or by user code calling
+    restore_tpu_preload()."""
+    if env.get("PALLAS_AXON_POOL_IPS"):
+        env["RT_DEFERRED_PALLAS_AXON_POOL_IPS"] = env.pop(
+            "PALLAS_AXON_POOL_IPS")
+        if "axon" in env.get("JAX_PLATFORMS", ""):
+            # axon is unregistered until the deferred boot runs; leaving the
+            # platform pinned would make a plain jax import raise.
+            env["RT_DEFERRED_JAX_PLATFORMS"] = env.pop("JAX_PLATFORMS")
+    return env

@@ -97,6 +97,7 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", default_concurrency),
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
+            runtime_env=opts.get("runtime_env"),
         )
         methods = [m for m in dir(self._cls)
                    if not m.startswith("_") and callable(getattr(self._cls, m))]

@@ -54,9 +54,19 @@ class NormalTaskSubmitter:
 
     async def _lease_and_run(self, key: tuple, sample: TaskSpec):
         """Obtain one lease, drain queue tasks through it, return the lease."""
+        from ray_tpu.runtime_env.runtime_env import RuntimeEnvError
+
         try:
             while self._queues.get(key):
-                grant = await self._request_lease(sample)
+                try:
+                    grant = await self._request_lease(sample)
+                except RuntimeEnvError as env_err:
+                    # env setup can never succeed on retry — fail the queue.
+                    # transient RPC errors deliberately propagate instead:
+                    # they leave tasks queued for a later lease attempt.
+                    for spec in self._queues.pop(key, []):
+                        self._store_error(spec, env_err)
+                    return
                 if grant is None:
                     # infeasible right now — fail queued tasks of this shape
                     for spec in self._queues.pop(key, []):
@@ -102,6 +112,7 @@ class NormalTaskSubmitter:
                     resources=spec.required_resources.to_dict(),
                     strategy=strategy,
                     pg=pg,
+                    runtime_env=spec.runtime_env,
                     timeout=None,
                 )
             except Exception as e:  # noqa: BLE001
@@ -116,6 +127,10 @@ class NormalTaskSubmitter:
             if status == "spill":
                 raylet_addr = tuple(reply["address"])
                 continue
+            if status == "env_error":
+                from ray_tpu.runtime_env.runtime_env import RuntimeEnvError
+
+                raise RuntimeEnvError(reply.get("error", "runtime env failed"))
             if status == "infeasible":
                 return None
         return None

@@ -443,8 +443,10 @@ class CoreWorker:
         max_retries: Optional[int] = None,
         name: str = "",
         serialized_func: Optional[bytes] = None,
+        runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         from ray_tpu.common.resources import ResourceRequest
+        from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
         task_id = TaskID.for_normal_task(
             self.job_id, self.current_task_id(), self.next_task_index())
@@ -465,7 +467,13 @@ class CoreWorker:
             caller_worker_id=self.worker_id,
             caller_address=self.server.address,
             name=name,
+            runtime_env=_merge_env(
+                getattr(self, "job_runtime_env", None), runtime_env),
         )
+        if spec.runtime_env is not None:
+            from ray_tpu.runtime_env.runtime_env import env_hash
+
+            spec.runtime_env_hash = env_hash(spec.runtime_env)
         return self._register_and_submit(spec)
 
     def _register_and_submit(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -507,8 +515,10 @@ class CoreWorker:
     # --------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, resources=None, label_selector=None,
                      scheduling_strategy=None, max_restarts=0, max_concurrency=1,
-                     name=None, namespace="default") -> "ActorID":
+                     name=None, namespace="default",
+                     runtime_env=None) -> "ActorID":
         from ray_tpu.common.resources import ResourceRequest
+        from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
         actor_id = ActorID.of(self.job_id, self.current_task_id(), self._actor_counter.next())
         creation_task_id = TaskID.for_actor_creation_task(actor_id)
@@ -529,6 +539,8 @@ class CoreWorker:
             caller_worker_id=self.worker_id,
             caller_address=self.server.address,
             name=name or "",
+            runtime_env=_merge_env(
+                getattr(self, "job_runtime_env", None), runtime_env),
         )
         reply = self.gcs.register_actor(
             pickle.dumps(spec), actor_id, self.job_id, name=name,
@@ -1071,6 +1083,12 @@ class CoreWorker:
     # ------------------------------------------------------------- execution
     async def h_push_task(self, spec: bytes):
         task: TaskSpec = pickle.loads(spec)
+        # Inherit the task's runtime env as this worker's job-level default:
+        # children submitted from inside the task stay in the parent's env
+        # (reference: runtime_env parent-to-child inheritance). The worker
+        # process IS the materialized env, so this is just spec plumbing.
+        if task.runtime_env is not None:
+            self.job_runtime_env = task.runtime_env
         loop = asyncio.get_running_loop()
         if task.is_actor_task() and self._is_async_actor_call(task):
             # Async actor fast path: never parks a pool thread across the
@@ -1134,6 +1152,8 @@ class CoreWorker:
 
     async def h_create_actor(self, creation_spec: bytes, node_id: bytes):
         task: TaskSpec = pickle.loads(creation_spec)
+        if task.runtime_env is not None:
+            self.job_runtime_env = task.runtime_env  # children inherit
         loop = asyncio.get_running_loop()
 
         def create():

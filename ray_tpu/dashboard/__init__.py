@@ -1,0 +1,9 @@
+"""Dashboard: cluster-state REST API + job submission endpoint + web page.
+
+Reference: ``python/ray/dashboard/`` (dashboard head, state API routes,
+job_head.py REST handlers).
+"""
+
+from .dashboard import Dashboard
+
+__all__ = ["Dashboard"]

@@ -1,0 +1,247 @@
+"""Dashboard head — REST API over GCS state + job submission + HTML page.
+
+Reference: ``python/ray/dashboard/head.py`` (DashboardHead hosting module
+routes), ``modules/job/job_head.py`` (the job REST surface mirrored here),
+``modules/node/`` + ``modules/actor/`` (state routes), ``modules/
+reporter/`` (Prometheus metrics). One asyncio HTTP server in the head
+process; no separate agent daemons — the GCS already aggregates node state
+and task events, so every route is a thin read of the control plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional, Tuple
+
+from ray_tpu._version import __version__
+from ray_tpu.gcs.client import GcsClient
+from ray_tpu.job.job_manager import JobManager
+from ray_tpu.rpc.rpc import IoContext
+from ray_tpu.util.http import (HttpRequest, HttpResponse, HttpServer,
+                               StreamResponse)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DASHBOARD_PORT = 8265
+
+
+class Dashboard:
+    def __init__(self, gcs_address: Tuple[str, int], session_dir: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._gcs_address = tuple(gcs_address)
+        self._gcs = GcsClient(self._gcs_address, client_id="dashboard")
+        self.job_manager = JobManager(self._gcs_address, session_dir)
+        self._http = HttpServer(host, port)
+        self._io = IoContext.current()
+        self._started = time.time()
+        self._register_routes()
+
+    @property
+    def address(self) -> Optional[Tuple[str, int]]:
+        return self._http.address
+
+    @property
+    def url(self) -> str:
+        host, port = self._http.address
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self._io.run(self._http.start(), timeout=10)
+        logger.info("dashboard serving at %s", self.url)
+
+    def stop(self):
+        try:
+            self._io.run(self._http.stop(), timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        self.job_manager.close()
+        self._gcs.close()
+
+    # ---------------------------------------------------------------- routes
+    def _register_routes(self):
+        r = self._http.route
+        r("GET", "/", self._index)
+        r("GET", "/api/version", self._version)
+        r("GET", "/api/overview", self._overview)
+        r("GET", "/api/nodes", self._nodes)
+        r("GET", "/api/actors", self._actors)
+        r("GET", "/api/placement_groups", self._pgs)
+        r("GET", "/api/cluster_resources", self._resources)
+        r("GET", "/api/task_events", self._task_events)
+        r("GET", "/api/metrics", self._metrics)
+        # job REST surface (reference job_head.py)
+        r("POST", "/api/jobs/", self._submit_job)
+        r("GET", "/api/jobs/", self._list_jobs)
+        r("GET", "/api/jobs/{sid}", self._get_job)
+        r("POST", "/api/jobs/{sid}/stop", self._stop_job)
+        r("DELETE", "/api/jobs/{sid}", self._delete_job)
+        r("GET", "/api/jobs/{sid}/logs", self._job_logs)
+        r("GET", "/api/jobs/{sid}/logs/tail", self._job_logs_tail)
+
+    # ------------------------------------------------------------- handlers
+    async def _version(self, _req: HttpRequest):
+        return {"version": __version__, "uptime_s": time.time() - self._started}
+
+    async def _nodes(self, _req: HttpRequest):
+        nodes = await self._gcs.call_async("get_all_nodes")
+        for n in nodes:
+            n["node_id"] = n["node_id"].hex()
+        return nodes
+
+    async def _actors(self, _req: HttpRequest):
+        return await self._gcs.call_async("list_actors")
+
+    async def _pgs(self, _req: HttpRequest):
+        return await self._gcs.call_async("list_placement_groups")
+
+    async def _resources(self, _req: HttpRequest):
+        return await self._gcs.call_async("get_cluster_resources")
+
+    async def _task_events(self, req: HttpRequest):
+        limit = int(req.query.get("limit", "1000"))
+        return await self._gcs.call_async("get_task_events", limit=limit)
+
+    async def _overview(self, _req: HttpRequest):
+        nodes = await self._gcs.call_async("get_all_nodes")
+        actors = await self._gcs.call_async("list_actors")
+        res = await self._gcs.call_async("get_cluster_resources")
+        jobs = await asyncio.to_thread(self.job_manager.list_jobs)
+        return {
+            "nodes_alive": sum(1 for n in nodes if n["alive"]),
+            "nodes_total": len(nodes),
+            "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+            "actors_total": len(actors),
+            "resources": res,
+            "jobs": [j.public_view() for j in jobs],
+        }
+
+    async def _metrics(self, _req: HttpRequest):
+        from ray_tpu.util.metrics import prometheus_text
+
+        return HttpResponse(prometheus_text(),
+                            content_type="text/plain; version=0.0.4")
+
+    # job handlers ---------------------------------------------------------
+    async def _submit_job(self, req: HttpRequest):
+        body = req.json()
+        if not body or not body.get("entrypoint"):
+            return HttpResponse({"error": "entrypoint is required"}, 400)
+        try:
+            sid = await asyncio.to_thread(
+                self.job_manager.submit_job,
+                entrypoint=body["entrypoint"],
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+            )
+        except ValueError as e:
+            return HttpResponse({"error": str(e)}, 409)
+        return HttpResponse({"submission_id": sid}, 201)
+
+    async def _list_jobs(self, _req: HttpRequest):
+        jobs = await asyncio.to_thread(self.job_manager.list_jobs)
+        return [j.public_view() for j in jobs]
+
+    async def _get_job(self, req: HttpRequest):
+        info = await asyncio.to_thread(
+            self.job_manager.get_job_info, req.path_params["sid"])
+        if info is None:
+            return HttpResponse({"error": "no such job"}, 404)
+        return info.public_view()
+
+    async def _stop_job(self, req: HttpRequest):
+        ok = await asyncio.to_thread(
+            self.job_manager.stop_job, req.path_params["sid"])
+        return {"stopped": ok}
+
+    async def _delete_job(self, req: HttpRequest):
+        ok = await asyncio.to_thread(
+            self.job_manager.delete_job, req.path_params["sid"])
+        return {"deleted": ok}
+
+    async def _job_logs(self, req: HttpRequest):
+        text = await asyncio.to_thread(
+            self.job_manager.get_job_logs, req.path_params["sid"])
+        return HttpResponse(text)
+
+    async def _job_logs_tail(self, req: HttpRequest):
+        return StreamResponse(
+            self.job_manager.tail_logs(req.path_params["sid"]))
+
+    async def _index(self, _req: HttpRequest):
+        return HttpResponse(_INDEX_HTML, content_type="text/html")
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ td, th { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
+ th { background: #f2f2f2; text-align: left; }
+ code { background: #f6f6f6; padding: 0 .3rem; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="summary">loading…</div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<script>
+async function refresh() {
+  const o = await (await fetch('/api/overview')).json();
+  document.getElementById('summary').textContent =
+    `${o.nodes_alive}/${o.nodes_total} nodes alive - ` +
+    `${o.actors_alive}/${o.actors_total} actors alive - ` +
+    `resources: ${JSON.stringify(o.resources.available)} available of ` +
+    `${JSON.stringify(o.resources.total)}`;
+  const nodes = await (await fetch('/api/nodes')).json();
+  fill('nodes', ['node_id','alive','address'], nodes.map(n => ({
+    node_id: n.node_id.slice(0,12), alive: n.alive,
+    address: n.address.join(':')})));
+  const actors = await (await fetch('/api/actors')).json();
+  fill('actors', ['actor_id','name','state','num_restarts'], actors.map(a => ({
+    actor_id: a.actor_id.slice(0,12), name: a.name || '',
+    state: a.state, num_restarts: a.num_restarts})));
+  fill('jobs', ['submission_id','status','entrypoint','message'], o.jobs);
+}
+function fill(id, cols, rows) {
+  const t = document.getElementById(id);
+  t.innerHTML = '<tr>' + cols.map(c => `<th>${c}</th>`).join('') + '</tr>' +
+    rows.map(r => '<tr>' + cols.map(c => `<td>${r[c]}</td>`).join('') +
+    '</tr>').join('');
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs", required=True, help="host:port of the GCS")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_DASHBOARD_PORT)
+    p.add_argument("--session-dir", default="/tmp/rt/dashboard")
+    args = p.parse_args()
+    import os
+
+    os.makedirs(args.session_dir, exist_ok=True)
+    host, _, port = args.gcs.partition(":")
+    dash = Dashboard((host, int(port)), args.session_dir, args.host, args.port)
+    dash.start()
+    print(f"DASHBOARD_READY {dash.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -603,6 +603,12 @@ class GcsServer:
             self._pending_actor_queue.append(rec.actor_id)
             return
         if not reply.get("ok"):
+            if reply.get("fatal"):
+                # e.g. runtime-env setup failure: retrying placement can
+                # never succeed — fail the actor with the cause
+                rec.max_restarts = rec.num_restarts
+                await self._on_actor_failure(rec, reply.get("reason", "fatal"))
+                return
             if rec.actor_id not in self._pending_actor_queue:
                 self._pending_actor_queue.append(rec.actor_id)
 

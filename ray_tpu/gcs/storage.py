@@ -15,10 +15,14 @@ replay needs no class imports.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import shutil
 import threading
 from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class GcsTableStorage:
@@ -39,16 +43,21 @@ class GcsTableStorage:
         self._log = open(path, "ab")
 
     def _replay(self):
+        size = os.path.getsize(self._path)
+        stopped_at = size
         with open(self._path, "rb") as f:
             while True:
                 try:
                     op, table, key, value = pickle.load(f)
+                except EOFError:
+                    break  # clean end of log
                 except Exception:  # noqa: BLE001
                     # Torn tail write: everything before it is valid. A
                     # truncated frame's surviving opcodes can raise far more
                     # than UnpicklingError (ValueError, IndexError,
                     # AttributeError, ...), and any of them crashing startup
                     # would break recovery exactly when it is needed.
+                    stopped_at = f.tell()
                     break
                 t = self._tables.setdefault(table, {})
                 if op == "put":
@@ -56,6 +65,24 @@ class GcsTableStorage:
                 else:
                     t.pop(key, None)
                 self._ops += 1
+        if stopped_at < size:
+            # Distinguish the expected torn TAIL (crash mid-append: only the
+            # final frame is lost) from mid-log corruption, where everything
+            # after the bad frame is dropped. Either way compaction will
+            # rewrite the log from the replayed state, so preserve the
+            # original for forensics before that happens.
+            backup = self._path + ".corrupt"
+            try:
+                shutil.copyfile(self._path, backup)
+            except OSError:
+                backup = "<copy failed>"
+            lost = size - stopped_at
+            level = logger.error if lost > 256 else logger.warning
+            level(
+                "gcs table log %s: replay stopped at byte %d of %d "
+                "(%d bytes unread, %d ops replayed); original preserved "
+                "at %s", self._path, stopped_at, size, lost, self._ops,
+                backup)
 
     def _compact_locked(self):
         tmp = self._path + ".compact"

@@ -1,0 +1,12 @@
+"""Job submission: run driver entrypoints on the cluster via REST/CLI.
+
+Reference: ``python/ray/dashboard/modules/job/`` (job_manager.py:60
+JobManager, job_supervisor.py supervisor actor, job_head.py REST routes,
+sdk.py JobSubmissionClient).
+"""
+
+from .common import JobInfo, JobStatus
+from .job_manager import JobManager
+from .client import JobSubmissionClient
+
+__all__ = ["JobInfo", "JobStatus", "JobManager", "JobSubmissionClient"]

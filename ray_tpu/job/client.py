@@ -1,0 +1,97 @@
+"""JobSubmissionClient — HTTP client for the job REST API.
+
+Reference: ``python/ray/dashboard/modules/job/sdk.py`` (JobSubmissionClient)
+with the same method surface: submit_job / stop_job / delete_job /
+get_job_info / list_jobs / get_job_status / get_job_logs / tail_job_logs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Dict, Iterator, List, Optional
+
+from ray_tpu.util.http import http_call
+
+from .common import JobInfo, JobStatus
+
+
+class JobSubmissionError(RuntimeError):
+    pass
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` is the dashboard URL, e.g. ``http://127.0.0.1:8265``."""
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 timeout: float = 30.0) -> dict:
+        status, raw = http_call(method, self._base + path, body, timeout)
+        try:
+            payload = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            payload = {"raw": raw.decode(errors="replace")}
+        if status >= 400:
+            raise JobSubmissionError(
+                payload.get("error", f"HTTP {status} for {path}"))
+        return payload
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        body = {"entrypoint": entrypoint}
+        if submission_id:
+            body["submission_id"] = submission_id
+        if runtime_env:
+            body["runtime_env"] = runtime_env
+        if metadata:
+            body["metadata"] = metadata
+        return self._request("POST", "/api/jobs/", body)["submission_id"]
+
+    def list_jobs(self) -> List[JobInfo]:
+        return [JobInfo(**d) for d in self._request("GET", "/api/jobs/")]
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        return JobInfo(**self._request("GET", f"/api/jobs/{submission_id}"))
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id).status
+
+    def get_job_logs(self, submission_id: str) -> str:
+        status, raw = http_call(
+            "GET", f"{self._base}/api/jobs/{submission_id}/logs")
+        if status >= 400:
+            raise JobSubmissionError(f"HTTP {status}")
+        return raw.decode(errors="replace")
+
+    def stop_job(self, submission_id: str) -> bool:
+        return bool(self._request(
+            "POST", f"/api/jobs/{submission_id}/stop")["stopped"])
+
+    def delete_job(self, submission_id: str) -> bool:
+        return bool(self._request(
+            "DELETE", f"/api/jobs/{submission_id}")["deleted"])
+
+    def tail_job_logs(self, submission_id: str) -> Iterator[str]:
+        """Stream log chunks (chunked transfer) until the job terminates."""
+        req = urllib.request.Request(
+            f"{self._base}/api/jobs/{submission_id}/logs/tail")
+        with urllib.request.urlopen(req) as r:
+            while True:
+                chunk = r.read(4096)
+                if not chunk:
+                    return
+                yield chunk.decode(errors="replace")
+
+    def wait_until_finish(self, submission_id: str,
+                          timeout: float = 300.0) -> JobInfo:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.get_job_info(submission_id)
+            if JobStatus.is_terminal(info.status):
+                return info
+            time.sleep(0.3)
+        raise TimeoutError(f"job {submission_id} still running")

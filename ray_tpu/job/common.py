@@ -1,0 +1,51 @@
+"""Job submission data model (reference: dashboard/modules/job/common.py —
+JobStatus enum + JobInfo persisted through the GCS KV)."""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+# KV namespace holding one record per submission id
+JOB_KV_NAMESPACE = "job_submission"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    TERMINAL = (STOPPED, SUCCEEDED, FAILED)
+
+    @staticmethod
+    def is_terminal(status: str) -> bool:
+        return status in JobStatus.TERMINAL
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    runtime_env: Optional[dict] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    driver_exit_code: Optional[int] = None
+    driver_pid: Optional[int] = None
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "JobInfo":
+        d = json.loads(raw)
+        return cls(**d)
+
+    def public_view(self) -> Dict[str, Any]:
+        return asdict(self)

@@ -49,6 +49,7 @@ class WorkerHandle:
     proc: Optional[subprocess.Popen]
     address: Optional[Tuple[str, int]] = None  # worker's RPC server
     state: str = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
+    env_key: Optional[str] = None  # runtime-env pool key (None = default env)
     lease_id: Optional[bytes] = None
     assignment: Optional[dict] = None  # unit-resource chip indices
     request: Optional[ResourceRequest] = None
@@ -103,6 +104,9 @@ class Raylet:
         self._stopped = False
         self._bg_tasks: List = []
         self._fake_worker_env = fake_worker_env or {}
+        from ray_tpu.runtime_env.agent import RuntimeEnvAgent
+
+        self.runtime_env_agent = RuntimeEnvAgent(self.session_dir)
         self._register_handlers()
 
     # ------------------------------------------------------------------ wiring
@@ -289,30 +293,39 @@ class Raylet:
                 except Exception:  # noqa: BLE001
                     pass
         self._workers.pop(w.worker_id, None)
+        self.runtime_env_agent.release(w.env_key)
         self._try_grant_pending()
 
     def _kill_worker_proc(self, w: WorkerHandle):
+        if w.state != "DEAD":
+            self.runtime_env_agent.release(w.env_key)
         w.state = "DEAD"
         self._workers.pop(w.worker_id, None)
         if w.proc is not None and w.proc.poll() is None:
             w.proc.terminate()
 
     # ------------------------------------------------------------ worker pool
-    async def _start_worker(self) -> WorkerHandle:
+    async def _start_worker(self, ctx=None) -> WorkerHandle:
+        from ray_tpu.runtime_env.agent import WorkerEnvContext
+
+        ctx = ctx or WorkerEnvContext()
         worker_id = WorkerID.from_random()
-        env = dict(os.environ)
-        # Defer the TPU runtime preload: the sitecustomize jax/PJRT boot costs
-        # ~1.9 s per process and only TPU-holding workers need it. The stashed
-        # vars are restored (and the PJRT plugin registered) by
+        from ray_tpu.common.tpu_detect import defer_tpu_preload
+
+        # Defer the TPU runtime preload: the sitecustomize jax/PJRT boot
+        # costs ~1.9 s per process and only TPU-holding workers need it. The
+        # stashed vars are restored (and the PJRT plugin registered) by
         # h_set_visible_devices when a TPU lease lands on the worker.
-        if env.get("PALLAS_AXON_POOL_IPS"):
-            env["RT_DEFERRED_PALLAS_AXON_POOL_IPS"] = env.pop(
-                "PALLAS_AXON_POOL_IPS")
-            if "axon" in env.get("JAX_PLATFORMS", ""):
-                # axon is unregistered until the deferred boot runs; leaving
-                # the platform pinned would make a plain jax import raise.
-                env["RT_DEFERRED_JAX_PLATFORMS"] = env.pop("JAX_PLATFORMS")
+        env = defer_tpu_preload(dict(os.environ))
         env.update(self._fake_worker_env)
+        env = ctx.apply(env)
+        # the framework itself must stay importable when a runtime env
+        # changes cwd (it may only be reachable via the driver's cwd today)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if pkg_root not in env.get("PYTHONPATH", "").split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                                 if env.get("PYTHONPATH") else pkg_root)
         env["RT_WORKER_ID"] = worker_id.hex()
         env["RT_RAYLET_ADDR"] = f"{self.server.address[0]}:{self.server.address[1]}"
         env["RT_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
@@ -323,9 +336,10 @@ class Raylet:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core_worker.worker_main"],
             env=env, stdout=logfile, stderr=subprocess.STDOUT,
-            cwd=os.getcwd(),
+            cwd=ctx.cwd or os.getcwd(),
         )
-        w = WorkerHandle(worker_id=worker_id, proc=proc)
+        w = WorkerHandle(worker_id=worker_id, proc=proc, env_key=ctx.env_key)
+        self.runtime_env_agent.acquire(ctx.env_key)
         self._workers[worker_id] = w
         logger.debug("forked worker %s (pid %s)", worker_id.hex()[:8], proc.pid)
         return w
@@ -344,30 +358,47 @@ class Raylet:
         self._try_grant_pending()
         return {"ok": True}
 
-    async def _pop_worker(self, timeout: float = None) -> Optional[WorkerHandle]:
-        """Get an idle registered worker, forking if needed."""
+    async def _pop_worker(self, timeout: float = None, ctx=None) -> Optional[WorkerHandle]:
+        """Get an idle registered worker IN THE SAME runtime env (pools are
+        keyed by env hash, reference: worker_pool.h), forking if needed.
+        ``maximum_startup_concurrency`` caps forks NODE-WIDE, across envs."""
         timeout = timeout or GLOBAL_CONFIG.get("worker_register_timeout_s")
-        for w in self._workers.values():
-            if w.state == "IDLE" and (w.proc is None or w.proc.poll() is None):
+        env_key = ctx.env_key if ctx is not None else None
+        deadline = time.monotonic() + timeout
+        while True:
+            for w in self._workers.values():
+                if (w.state == "IDLE" and w.env_key == env_key
+                        and (w.proc is None or w.proc.poll() is None)):
+                    w.state = "LEASED"
+                    return w
+            starting_all = [w for w in self._workers.values()
+                            if w.state == "STARTING"]
+            if len(starting_all) < GLOBAL_CONFIG.get("maximum_startup_concurrency"):
+                w = await self._start_worker(ctx)
+            else:
+                starting_same = [w for w in starting_all if w.env_key == env_key]
+                # at the fork cap: wait for ANY starting worker to register
+                # (freeing a fork slot), then re-check
+                w = (starting_same or starting_all)[0]
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logger.warning("pop_worker: registration timeout")
+                return None
+            logger.debug("pop_worker: waiting registration of %s",
+                         w.worker_id.hex()[:8])
+            try:
+                await asyncio.wait_for(w.registered.wait(),
+                                       min(remaining, 5.0))
+            except asyncio.TimeoutError:
+                if time.monotonic() >= deadline:
+                    logger.warning("pop_worker: registration timeout for %s",
+                                   w.worker_id.hex()[:8])
+                    return None
+                continue
+            if w.env_key == env_key and w.state == "IDLE":
                 w.state = "LEASED"
                 return w
-        starting = [w for w in self._workers.values() if w.state == "STARTING"]
-        if len(starting) < GLOBAL_CONFIG.get("maximum_startup_concurrency"):
-            w = await self._start_worker()
-        else:
-            w = starting[0]
-        logger.debug("pop_worker: waiting registration of %s", w.worker_id.hex()[:8])
-        try:
-            await asyncio.wait_for(w.registered.wait(), timeout)
-        except asyncio.TimeoutError:
-            logger.warning("pop_worker: registration timeout for %s", w.worker_id.hex()[:8])
-            return None
-        if w.state != "IDLE":
-            logger.warning("pop_worker: %s not idle after registration (%s)",
-                           w.worker_id.hex()[:8], w.state)
-            return None
-        w.state = "LEASED"
-        return w
+            # someone else took it, it's a different env, or it died — retry
 
     # ------------------------------------------------------------- scheduling
     def _local_available(self, request: ResourceRequest,
@@ -393,7 +424,8 @@ class Raylet:
 
     async def h_request_worker_lease(self, lease_id: bytes, resources: dict,
                                      strategy=None, pg: Optional[tuple] = None,
-                                     grant_only_local: bool = False):
+                                     grant_only_local: bool = False,
+                                     runtime_env: Optional[dict] = None):
         """Two-level scheduling (reference: node_manager.proto:413 +
         cluster_task_manager.h): grant locally, spill, or queue."""
         request = ResourceRequest.from_dict(resources) if isinstance(resources, dict) and "resources" in resources else ResourceRequest(resources)
@@ -401,14 +433,16 @@ class Raylet:
         logger.debug("lease request %s res=%s", lease_id[:4].hex(), request.resources.to_dict())
 
         if self._local_available(request, pg_key):
-            granted = await self._grant_lease(lease_id, request, pg_key)
+            granted = await self._grant_lease(lease_id, request, pg_key,
+                                              runtime_env)
             if granted is not None:
                 return granted
         if pg_key is not None or grant_only_local:
             # PG leases are node-pinned; queue locally until bundle frees up
             fut = asyncio.get_running_loop().create_future()
             self._pending_leases.append(
-                {"lease_id": lease_id, "request": request, "pg": pg_key, "future": fut}
+                {"lease_id": lease_id, "request": request, "pg": pg_key,
+                 "runtime_env": runtime_env, "future": fut}
             )
             return await fut
         # consider spilling to another node
@@ -427,16 +461,32 @@ class Raylet:
         # pending entry is what the autoscaler bin-packs a new node for.
         fut = asyncio.get_running_loop().create_future()
         self._pending_leases.append(
-            {"lease_id": lease_id, "request": request, "pg": None, "future": fut}
+            {"lease_id": lease_id, "request": request, "pg": None,
+             "runtime_env": runtime_env, "future": fut}
         )
         return await fut
 
+    async def _materialize_env(self, runtime_env: Optional[dict]):
+        """Stage the env off-loop (file copies must not stall the raylet)."""
+        if not runtime_env:
+            from ray_tpu.runtime_env.agent import WorkerEnvContext
+
+            return WorkerEnvContext()
+        return await asyncio.to_thread(
+            self.runtime_env_agent.get_or_create, runtime_env)
+
     async def _grant_lease(self, lease_id: bytes, request: ResourceRequest,
-                           pg_key) -> Optional[dict]:
+                           pg_key, runtime_env=None) -> Optional[dict]:
+        # Materialize the env only on the node that will actually grant —
+        # a request that spills elsewhere must not stage files here.
+        try:
+            ctx = await self._materialize_env(runtime_env)
+        except Exception as e:  # noqa: BLE001 - RuntimeEnvError + staging IO
+            return {"status": "env_error", "error": str(e)}
         assignment = self._allocate_local(request, pg_key)
         if assignment is None:
             return None
-        w = await self._pop_worker()
+        w = await self._pop_worker(ctx=ctx)
         if w is None:
             # couldn't start a worker: roll back
             if pg_key is None:
@@ -521,7 +571,9 @@ class Raylet:
                 if item["future"].done():
                     continue
                 if self._local_available(item["request"], item["pg"]):
-                    granted = await self._grant_lease(item["lease_id"], item["request"], item["pg"])
+                    granted = await self._grant_lease(
+                        item["lease_id"], item["request"], item["pg"],
+                        item.get("runtime_env"))
                     if granted is not None:
                         item["future"].set_result(granted)
                         continue
@@ -552,8 +604,14 @@ class Raylet:
                       spec.scheduling_strategy.bundle_index)
         if not self._local_available(request, pg_key):
             return {"ok": False, "reason": "resources unavailable"}
+        try:
+            ctx = await self._materialize_env(spec.runtime_env)
+        except Exception as e:  # noqa: BLE001
+            # env failures are fatal for the actor, not retryable placement
+            return {"ok": False, "fatal": True,
+                    "reason": f"runtime env setup failed: {e}"}
         assignment = self._allocate_local(request, pg_key)
-        w = await self._pop_worker()
+        w = await self._pop_worker(ctx=ctx)
         if w is None:
             if pg_key is None:
                 self.resources.free(request, assignment)

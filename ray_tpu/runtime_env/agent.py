@@ -1,0 +1,153 @@
+"""RuntimeEnvAgent — materializes runtime envs on a node.
+
+Reference: ``python/ray/_private/runtime_env/agent/runtime_env_agent.py:165``
+(GetOrCreateRuntimeEnv / DeleteRuntimeEnvIfPossible with per-env refcounts
+and a URI cache). Here the agent lives inside the raylet process (no
+separate daemon needed — setup is file staging, not package downloads) and
+returns a :class:`WorkerEnvContext` the worker pool applies at fork time.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import shutil
+import threading
+import zipfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .runtime_env import RuntimeEnvError, env_hash, validate
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerEnvContext:
+    """Everything the worker fork needs to run inside the env."""
+
+    env_key: Optional[str] = None
+    env_vars: Dict[str, str] = field(default_factory=dict)
+    cwd: Optional[str] = None
+    pythonpath_prepend: List[str] = field(default_factory=list)
+
+    def apply(self, base_env: Dict[str, str]) -> Dict[str, str]:
+        out = dict(base_env)
+        out.update(self.env_vars)
+        if self.pythonpath_prepend:
+            existing = out.get("PYTHONPATH", "")
+            parts = list(self.pythonpath_prepend)
+            if existing:
+                parts.append(existing)
+            out["PYTHONPATH"] = os.pathsep.join(parts)
+        return out
+
+
+class RuntimeEnvAgent:
+    def __init__(self, session_dir: str):
+        self._root = os.path.join(session_dir, "runtime_envs")
+        os.makedirs(self._root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._cache: Dict[str, WorkerEnvContext] = {}
+        self._refs: Dict[str, int] = {}
+
+    def get_or_create(self, env: Optional[dict]) -> WorkerEnvContext:
+        """Materialize (or fetch cached) the env. Raises RuntimeEnvError on
+        anything that cannot be satisfied — the caller fails the lease, not
+        the node. References are NOT taken here: a holder (worker process,
+        job driver) calls :meth:`acquire` when it starts using the env and
+        :meth:`release` when it exits."""
+        if not env:
+            return WorkerEnvContext()
+        validate(env)
+        key = env_hash(env)
+        with self._lock:
+            ctx = self._cache.get(key)
+            if ctx is not None:
+                return ctx
+        ctx = self._materialize(key, env)
+        with self._lock:
+            self._cache[key] = ctx
+            self._refs.setdefault(key, 0)
+        return ctx
+
+    def acquire(self, key: Optional[str]) -> None:
+        """One live holder (a forked worker / running job driver)."""
+        if key is None:
+            return
+        with self._lock:
+            self._refs[key] = self._refs.get(key, 0) + 1
+
+    def release(self, key: Optional[str]) -> None:
+        """Drop one holder; unreferenced envs stay cached (cheap disk)
+        until evict_unused() — matching the reference's soft URI cache."""
+        if key is None:
+            return
+        with self._lock:
+            if key in self._refs:
+                self._refs[key] = max(0, self._refs[key] - 1)
+
+    def evict_unused(self) -> int:
+        """Delete staged files of envs with zero references. Returns count."""
+        n = 0
+        with self._lock:
+            for key in [k for k, r in self._refs.items() if r == 0]:
+                self._cache.pop(key, None)
+                self._refs.pop(key, None)
+                shutil.rmtree(os.path.join(self._root, key),
+                              ignore_errors=True)
+                n += 1
+        return n
+
+    # ------------------------------------------------------------- internals
+    def _materialize(self, key: str, env: dict) -> WorkerEnvContext:
+        ctx = WorkerEnvContext(env_key=key, env_vars=dict(env.get("env_vars") or {}))
+        stage = os.path.join(self._root, key)
+        os.makedirs(stage, exist_ok=True)
+        wd = env.get("working_dir")
+        if wd is not None:
+            target = os.path.join(stage, "working_dir")
+            self._stage_path(wd, target)
+            ctx.cwd = target
+            ctx.pythonpath_prepend.append(target)
+        for i, mod in enumerate(env.get("py_modules") or []):
+            target = os.path.join(stage, f"py_module_{i}")
+            self._stage_path(mod, target)
+            # a module DIRECTORY is importable from its parent; a staged
+            # tree of plain files is importable from the target itself
+            ctx.pythonpath_prepend.append(target)
+        self._check_pip(env.get("pip") or [])
+        logger.info("runtime env %s materialized at %s", key, stage)
+        return ctx
+
+    @staticmethod
+    def _stage_path(src: str, target: str):
+        if os.path.exists(target):
+            shutil.rmtree(target, ignore_errors=True)
+        if not os.path.exists(src):
+            raise RuntimeEnvError(f"runtime_env path does not exist: {src}")
+        if src.endswith(".zip") and os.path.isfile(src):
+            os.makedirs(target, exist_ok=True)
+            with zipfile.ZipFile(src) as zf:
+                zf.extractall(target)
+        elif os.path.isdir(src):
+            shutil.copytree(src, target)
+        else:
+            raise RuntimeEnvError(
+                f"runtime_env path must be a directory or .zip: {src}")
+
+    @staticmethod
+    def _check_pip(reqs: List[str]):
+        """No network egress on this image: a requirement is satisfiable only
+        if the distribution is already importable. Anything else must fail
+        the env (reference: RuntimeEnvSetupError), never silently run without
+        the dependency."""
+        for req in reqs:
+            name = (req.split(";")[0].split("==")[0].split(">=")[0]
+                    .split("<=")[0].split("[")[0].strip())
+            mod = name.replace("-", "_")
+            if importlib.util.find_spec(mod) is None:
+                raise RuntimeEnvError(
+                    f"pip requirement {req!r} is not installed and this "
+                    "environment has no package index access")

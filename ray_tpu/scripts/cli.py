@@ -1,0 +1,243 @@
+"""Cluster CLI (reference: python/ray/scripts/scripts.py — ``ray start /
+stop / status`` and ``ray job submit / status / logs / stop / list``).
+
+Usage:
+    python -m ray_tpu start --head [--port P] [--dashboard] [--num-cpus N]
+    python -m ray_tpu start --address HOST:PORT [--num-cpus N]
+    python -m ray_tpu status --address HOST:PORT
+    python -m ray_tpu stop
+    python -m ray_tpu job submit --address http://HOST:PORT -- CMD...
+    python -m ray_tpu job status|logs|stop --address URL SUBMISSION_ID
+    python -m ray_tpu job list --address URL
+
+``start`` runs the daemons in THIS process and blocks (use a process
+manager / ``&`` to background it; reference ``ray start --block`` model).
+A pidfile under the session dir lets ``stop`` terminate nodes started on
+this machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+PID_DIR = "/tmp/rt/pids"
+
+
+def _write_pidfile(kind: str):
+    os.makedirs(PID_DIR, exist_ok=True)
+    with open(os.path.join(PID_DIR, f"{kind}-{os.getpid()}.pid"), "w") as f:
+        f.write(str(os.getpid()))
+
+
+def cmd_start(args) -> int:
+    from ray_tpu.common.config import GLOBAL_CONFIG
+
+    if args.system_config:
+        GLOBAL_CONFIG.initialize(json.loads(args.system_config))
+        GLOBAL_CONFIG.reset_cache()
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    if args.num_tpus is not None:
+        resources["TPU"] = args.num_tpus
+    labels = json.loads(args.labels) if args.labels else {}
+
+    if args.head:
+        from ray_tpu.gcs.server import GcsServer
+        from ray_tpu.raylet.raylet import Raylet
+
+        gcs = GcsServer(args.host, args.port, persist_dir=args.persist_dir)
+        gcs.start()
+        raylet = Raylet(gcs.address, resources=resources or None,
+                        labels=labels or None)
+        raylet.start()
+        dash = None
+        if args.dashboard:
+            from ray_tpu.dashboard import Dashboard
+
+            dash = Dashboard(gcs.address, raylet.session_dir,
+                             port=args.dashboard_port)
+            dash.start()
+        _write_pidfile("head")
+        print(f"RAY_TPU_HEAD {gcs.address[0]}:{gcs.address[1]}", flush=True)
+        if dash is not None:
+            print(f"RAY_TPU_DASHBOARD {dash.url}", flush=True)
+        print("To connect: ray_tpu.init(address="
+              f"'{gcs.address[0]}:{gcs.address[1]}')", flush=True)
+        _block([lambda: raylet.stop(), lambda: gcs.stop()]
+               + ([lambda: dash.stop()] if dash else []))
+        return 0
+    if not args.address:
+        print("either --head or --address is required", file=sys.stderr)
+        return 2
+    host, _, port = args.address.partition(":")
+    from ray_tpu.raylet.raylet import Raylet
+
+    raylet = Raylet((host, int(port)), resources=resources or None,
+                    labels=labels or None)
+    raylet.start()
+    _write_pidfile("node")
+    print(f"RAY_TPU_NODE {raylet.server.address[0]}:"
+          f"{raylet.server.address[1]}", flush=True)
+    _block([lambda: raylet.stop()])
+    return 0
+
+
+def _block(stops):
+    stop_now = {"flag": False}
+
+    def handler(_sig, _frm):
+        stop_now["flag"] = True
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    try:
+        while not stop_now["flag"]:
+            time.sleep(0.2)
+    finally:
+        for s in stops:
+            try:
+                s()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def cmd_stop(_args) -> int:
+    n = 0
+    if os.path.isdir(PID_DIR):
+        for fn in os.listdir(PID_DIR):
+            path = os.path.join(PID_DIR, fn)
+            try:
+                with open(path) as f:
+                    pid = int(f.read().strip())
+                os.kill(pid, signal.SIGTERM)
+                n += 1
+            except (OSError, ValueError):
+                pass
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+    print(f"stopped {n} node process(es)")
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ray_tpu.gcs.client import GcsClient
+
+    host, _, port = args.address.partition(":")
+    c = GcsClient((host, int(port)))
+    try:
+        nodes = c.get_all_nodes()
+        res = c.cluster_resources()
+    finally:
+        c.close()
+    alive = [n for n in nodes if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    print(f"resources total:     {res['total']}")
+    print(f"resources available: {res['available']}")
+    return 0
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.job_cmd == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        runtime_env = json.loads(args.runtime_env) if args.runtime_env else None
+        sid = client.submit_job(entrypoint=entrypoint,
+                                submission_id=args.submission_id,
+                                runtime_env=runtime_env)
+        print(sid)
+        if args.follow:
+            for chunk in client.tail_job_logs(sid):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            info = client.get_job_info(sid)
+            print(f"--- job {sid}: {info.status}", file=sys.stderr)
+            return 0 if info.status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+        return 0
+    if args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.submission_id))
+        return 0
+    if args.job_cmd == "stop":
+        print(json.dumps({"stopped": client.stop_job(args.submission_id)}))
+        return 0
+    if args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}\t{info.status}\t{info.entrypoint}")
+        return 0
+    return 2
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("start", help="start a head or worker node")
+    ps.add_argument("--head", action="store_true")
+    ps.add_argument("--address", help="GCS host:port to join (worker node)")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=6379)
+    ps.add_argument("--dashboard", action="store_true")
+    ps.add_argument("--dashboard-port", type=int, default=8265)
+    ps.add_argument("--num-cpus", type=int)
+    ps.add_argument("--num-tpus", type=int)
+    ps.add_argument("--resources", help="JSON dict")
+    ps.add_argument("--labels", help="JSON dict")
+    ps.add_argument("--persist-dir", help="GCS fault-tolerance log dir")
+    ps.add_argument("--system-config", help="JSON dict")
+    ps.set_defaults(fn=cmd_start)
+
+    pstop = sub.add_parser("stop", help="stop nodes started on this machine")
+    pstop.set_defaults(fn=cmd_stop)
+
+    pstat = sub.add_parser("status", help="cluster resource summary")
+    pstat.add_argument("--address", required=True)
+    pstat.set_defaults(fn=cmd_status)
+
+    pj = sub.add_parser("job", help="job submission commands")
+    pj.add_argument("job_cmd",
+                    choices=["submit", "status", "logs", "stop", "list"])
+    pj.add_argument("--address", required=True, help="dashboard URL")
+    pj.add_argument("--submission-id")
+    pj.add_argument("--runtime-env", help="JSON dict")
+    pj.add_argument("--follow", action="store_true",
+                    help="submit: stream logs until the job finishes")
+    pj.add_argument("rest", nargs="*",
+                    help="submit: entrypoint (after --); "
+                         "status/logs/stop: the submission id")
+    pj.set_defaults(fn=cmd_job)
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # everything after a literal "--" is the verbatim entrypoint — split it
+    # off before argparse so flags inside the entrypoint aren't interpreted
+    entrypoint: list = []
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, entrypoint = argv[:cut], argv[cut + 1:]
+    args = p.parse_args(argv)
+    if getattr(args, "job_cmd", None) is not None:
+        rest = list(getattr(args, "rest", []) or [])
+        if args.job_cmd == "submit":
+            args.entrypoint = entrypoint or rest
+            if not args.entrypoint:
+                p.error("job submit requires an entrypoint after --")
+        elif args.job_cmd in ("status", "logs", "stop"):
+            args.submission_id = args.submission_id or (rest[0] if rest else None)
+            if not args.submission_id:
+                p.error(f"job {args.job_cmd} requires a submission id")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

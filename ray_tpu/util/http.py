@@ -1,0 +1,219 @@
+"""Minimal asyncio HTTP/1.1 server + client helpers.
+
+No external web framework in this image, so platform services (dashboard,
+job REST API — reference: python/ray/dashboard/) share this tiny server:
+route table with path parameters ("/api/jobs/{id}"), JSON in/out, streaming
+(chunked) responses for log tails.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import urllib.parse
+import urllib.request
+from typing import Any, AsyncIterator, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_MAX_BODY = 128 * 1024 * 1024
+
+
+class HttpRequest:
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes,
+                 path_params: Optional[Dict[str, str]] = None):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.path_params = path_params or {}
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+
+class HttpResponse:
+    def __init__(self, body: Any = b"", status: int = 200,
+                 content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        if isinstance(body, (dict, list)):
+            self.body = json.dumps(body).encode()
+            content_type = content_type or "application/json"
+        elif isinstance(body, str):
+            self.body = body.encode()
+            content_type = content_type or "text/plain; charset=utf-8"
+        else:
+            self.body = bytes(body)
+            content_type = content_type or "application/octet-stream"
+        self.status = status
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+class StreamResponse:
+    """Chunked-transfer response driven by an async iterator of bytes."""
+
+    def __init__(self, chunks: AsyncIterator[bytes],
+                 content_type: str = "text/plain; charset=utf-8"):
+        self.chunks = chunks
+        self.content_type = content_type
+
+
+_STATUS_TEXT = {200: "OK", 201: "Created", 204: "No Content",
+                400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+                409: "Conflict", 500: "Internal Server Error"}
+
+
+class HttpServer:
+    """Route patterns may contain ``{name}`` path parameters."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        # (method, regex, handler)
+        self._routes: list = []
+        self.address: Optional[Tuple[str, int]] = None
+
+    def route(self, method: str, pattern: str,
+              handler: Callable[[HttpRequest], Any]):
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+        self._routes.append((method.upper(), regex, handler))
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port)
+        self.address = self._server.sockets[0].getsockname()[:2]
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+            self._server = None
+
+    # ------------------------------------------------------------- internals
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _version = line.decode().split(None, 2)
+                except ValueError:
+                    break
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", "0") or "0")
+                if length > _MAX_BODY:
+                    await self._write(writer, HttpResponse(
+                        {"error": "body too large"}, 400), close=True)
+                    return
+                body = await reader.readexactly(length) if length else b""
+                parsed = urllib.parse.urlsplit(target)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                req = HttpRequest(method.upper(), parsed.path, query,
+                                  headers, body)
+                resp = await self._dispatch(req)
+                keep = headers.get("connection", "").lower() != "close"
+                if isinstance(resp, StreamResponse):
+                    await self._write_stream(writer, resp)
+                    keep = False
+                else:
+                    await self._write(writer, resp, close=not keep)
+                if not keep:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("http connection handler failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, req: HttpRequest):
+        path_matched = False
+        for method, regex, handler in self._routes:
+            m = regex.match(req.path)
+            if m is None:
+                continue
+            path_matched = True
+            if method != req.method:
+                continue
+            req.path_params = m.groupdict()
+            try:
+                result = handler(req)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            except Exception as e:  # noqa: BLE001
+                logger.exception("handler for %s %s failed", req.method, req.path)
+                return HttpResponse({"error": str(e)}, 500)
+            if isinstance(result, (HttpResponse, StreamResponse)):
+                return result
+            return HttpResponse(result if result is not None else b"")
+        if path_matched:
+            return HttpResponse({"error": "method not allowed"}, 405)
+        return HttpResponse({"error": f"no route for {req.path}"}, 404)
+
+    @staticmethod
+    async def _write(writer: asyncio.StreamWriter, resp: HttpResponse,
+                     close: bool):
+        head = (f"HTTP/1.1 {resp.status} "
+                f"{_STATUS_TEXT.get(resp.status, 'OK')}\r\n"
+                f"Content-Type: {resp.content_type}\r\n"
+                f"Content-Length: {len(resp.body)}\r\n"
+                f"Connection: {'close' if close else 'keep-alive'}\r\n")
+        for k, v in resp.headers.items():
+            head += f"{k}: {v}\r\n"
+        writer.write(head.encode() + b"\r\n" + resp.body)
+        await writer.drain()
+
+    @staticmethod
+    async def _write_stream(writer: asyncio.StreamWriter, resp: StreamResponse):
+        writer.write(
+            (f"HTTP/1.1 200 OK\r\nContent-Type: {resp.content_type}\r\n"
+             "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n").encode())
+        await writer.drain()
+        try:
+            async for chunk in resp.chunks:
+                if not chunk:
+                    continue
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                await writer.drain()
+        finally:
+            try:
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# --------------------------------------------------------------- client side
+def http_call(method: str, url: str, body: Optional[dict] = None,
+              timeout: float = 30.0) -> Tuple[int, bytes]:
+    """Blocking JSON HTTP call (stdlib only — used by JobSubmissionClient)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method.upper())
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()

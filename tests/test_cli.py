@@ -1,0 +1,102 @@
+"""CLI lifecycle (reference: python/ray/scripts/scripts.py `ray start/stop/
+status` + `ray job`): real subprocess head, join, status, jobs, stop."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ENV = dict(os.environ,
+           PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_line(proc, prefix, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().decode()
+        if line.startswith(prefix):
+            return line.strip()
+        if proc.poll() is not None:
+            raise RuntimeError(f"process exited: {proc.returncode}")
+    raise TimeoutError(f"no {prefix!r} line")
+
+
+@pytest.fixture(scope="module")
+def head():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head", "--port", "0",
+         "--dashboard", "--dashboard-port", "0", "--num-cpus", "2",
+         "--num-tpus", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=ENV)
+    try:
+        addr = _wait_line(proc, "RAY_TPU_HEAD").split()[1]
+        dash = _wait_line(proc, "RAY_TPU_DASHBOARD").split()[1]
+        yield {"addr": addr, "dash": dash, "proc": proc}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_status_and_join(head):
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "status", "--address", head["addr"]],
+        capture_output=True, text=True, timeout=60, env=ENV)
+    assert out.returncode == 0
+    assert "1 alive" in out.stdout
+
+    node = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--address", head["addr"],
+         "--num-cpus", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=ENV)
+    try:
+        _wait_line(node, "RAY_TPU_NODE")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            out = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "status",
+                 "--address", head["addr"]],
+                capture_output=True, text=True, timeout=60, env=ENV)
+            if "2 alive" in out.stdout:
+                break
+            time.sleep(0.3)
+        assert "2 alive" in out.stdout
+    finally:
+        node.send_signal(signal.SIGTERM)
+        node.wait(timeout=15)
+
+
+def test_driver_connects_to_cli_head(head):
+    code = (f"import ray_tpu; ray_tpu.init(address='{head['addr']}'); "
+            "print('got', ray_tpu.get(ray_tpu.remote(lambda: 7).remote()))")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120, env=ENV)
+    assert "got 7" in out.stdout, out.stdout + out.stderr
+
+
+def test_job_cli_roundtrip(head):
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "job", "submit",
+         "--address", head["dash"], "--follow", "--",
+         "echo", "job-went-through"],
+        capture_output=True, text=True, timeout=120, env=ENV)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "job-went-through" in out.stdout
+    sid = out.stdout.splitlines()[0].strip()
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "job", "status",
+         "--address", head["dash"], sid],
+        capture_output=True, text=True, timeout=60, env=ENV)
+    assert out.stdout.strip() == "SUCCEEDED"
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "job", "list",
+         "--address", head["dash"]],
+        capture_output=True, text=True, timeout=60, env=ENV)
+    assert sid in out.stdout

@@ -1,0 +1,154 @@
+"""Job submission + dashboard REST (reference:
+dashboard/modules/job/job_manager.py:60, job_head.py routes,
+dashboard state API). End-to-end over real HTTP against a live cluster."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def dash():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, dashboard=True)
+    yield info["dashboard_url"]
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(dash):
+    return JobSubmissionClient(dash)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestDashboardApi:
+    def test_index_and_version(self, dash):
+        status, body = _get(dash + "/")
+        assert status == 200 and b"ray_tpu dashboard" in body
+        status, body = _get(dash + "/api/version")
+        assert status == 200 and json.loads(body)["version"]
+
+    def test_nodes_and_resources(self, dash):
+        status, body = _get(dash + "/api/nodes")
+        nodes = json.loads(body)
+        assert status == 200 and len(nodes) == 1 and nodes[0]["alive"]
+        status, body = _get(dash + "/api/cluster_resources")
+        res = json.loads(body)
+        assert res["total"]["CPU"] == 4
+
+    def test_actors_listed(self, dash):
+        class Pinger:
+            def ping(self):
+                return "pong"
+
+        a = ray_tpu.remote(Pinger).options(name="dash-actor").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        status, body = _get(dash + "/api/actors")
+        actors = json.loads(body)
+        assert any(x["name"] == "dash-actor" and x["state"] == "ALIVE"
+                   for x in actors)
+
+    def test_overview_and_metrics(self, dash):
+        status, body = _get(dash + "/api/overview")
+        o = json.loads(body)
+        assert o["nodes_alive"] == 1
+        status, body = _get(dash + "/api/metrics")
+        assert status == 200
+
+    def test_404_and_405(self, dash):
+        from ray_tpu.util.http import http_call
+
+        status, _ = http_call("GET", dash + "/api/nonexistent")
+        assert status == 404
+        status, _ = http_call("DELETE", dash + "/api/nodes")
+        assert status == 405
+
+
+class TestJobSubmission:
+    def test_submit_and_succeed(self, client):
+        code = ("import ray_tpu, os; ray_tpu.init(); "
+                "assert os.environ['RT_JOB_SUBMISSION_ID']; "
+                "r = ray_tpu.get(ray_tpu.remote(lambda: 40 + 2).remote()); "
+                "print('answer', r); assert r == 42")
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"{code}\"")
+        info = client.wait_until_finish(sid, timeout=180)
+        logs = client.get_job_logs(sid)
+        assert info.status == JobStatus.SUCCEEDED, logs
+        assert "answer 42" in logs
+        assert info.driver_exit_code == 0
+
+    def test_failing_job(self, client):
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        info = client.wait_until_finish(sid, timeout=120)
+        assert info.status == JobStatus.FAILED
+        assert info.driver_exit_code == 3
+
+    def test_stop_job(self, client):
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+        deadline = time.monotonic() + 60
+        while (client.get_job_status(sid) == JobStatus.PENDING
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert client.stop_job(sid)
+        info = client.wait_until_finish(sid, timeout=60)
+        assert info.status == JobStatus.STOPPED
+
+    def test_job_with_runtime_env(self, client, tmp_path):
+        app = tmp_path / "jobapp"
+        app.mkdir()
+        (app / "main.py").write_text(
+            "import os, ray_tpu\n"
+            "ray_tpu.init()\n"
+            "@ray_tpu.remote\n"
+            "def probe():\n"
+            "    return os.environ.get('JOB_WIDE')\n"
+            "print('probe:', ray_tpu.get(probe.remote()))\n")
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} main.py",
+            runtime_env={"working_dir": str(app),
+                         "env_vars": {"JOB_WIDE": "set-for-job"}})
+        info = client.wait_until_finish(sid, timeout=180)
+        logs = client.get_job_logs(sid)
+        assert info.status == JobStatus.SUCCEEDED, logs
+        # the job-level env reached the DRIVER (cwd+env) AND its TASKS
+        assert "probe: set-for-job" in logs
+
+    def test_list_get_delete(self, client):
+        sid = client.submit_job(entrypoint="echo listed-job")
+        client.wait_until_finish(sid, timeout=60)
+        assert any(j.submission_id == sid for j in client.list_jobs())
+        assert "listed-job" in client.get_job_logs(sid)
+        assert client.delete_job(sid)
+        assert all(j.submission_id != sid for j in client.list_jobs())
+
+    def test_duplicate_submission_id_conflict(self, client):
+        sid = client.submit_job(entrypoint="echo one",
+                                submission_id="fixed-id-1")
+        client.wait_until_finish(sid, timeout=60)
+        from ray_tpu.job.client import JobSubmissionError
+
+        with pytest.raises(JobSubmissionError, match="already exists"):
+            client.submit_job(entrypoint="echo two",
+                              submission_id="fixed-id-1")
+
+    def test_tail_logs_streams(self, client):
+        code = ("import time\n"
+                "for i in range(5): print('line', i, flush=True); "
+                "time.sleep(0.1)\n")
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"{code}\"")
+        chunks = "".join(client.tail_job_logs(sid))
+        assert "line 0" in chunks and "line 4" in chunks
+        assert client.get_job_status(sid) == JobStatus.SUCCEEDED

@@ -1,0 +1,209 @@
+"""Runtime environments (reference: python/ray/_private/runtime_env/):
+env_vars / working_dir / py_modules materialization, pool keying, job-level
+defaults, and setup-failure propagation."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv, RuntimeEnvError
+from ray_tpu.runtime_env.runtime_env import env_hash, merge, validate
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_validate_and_hash():
+    validate({"env_vars": {"A": "1"}})
+    with pytest.raises(RuntimeEnvError):
+        validate({"bogus_field": 1})
+    with pytest.raises(RuntimeEnvError):
+        validate({"env_vars": {"A": 1}})  # non-str value
+    assert env_hash(None) is None
+    assert env_hash({}) is None
+    h1 = env_hash({"env_vars": {"A": "1"}})
+    assert h1 == env_hash({"env_vars": {"A": "1"}})
+    assert h1 != env_hash({"env_vars": {"A": "2"}})
+
+
+def test_merge_semantics():
+    base = {"env_vars": {"A": "1", "B": "1"}, "working_dir": "/x"}
+    over = {"env_vars": {"B": "2"}, "pip": ["numpy"]}
+    m = merge(base, over)
+    assert m["env_vars"] == {"A": "1", "B": "2"}  # env_vars merge
+    assert m["working_dir"] == "/x"               # untouched fields inherit
+    assert m["pip"] == ["numpy"]                  # new fields apply
+    assert merge(None, over) == over
+    assert merge(base, None) == base
+
+
+def test_env_vars_in_task(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("RT_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "hello"
+
+
+def test_pool_isolation_by_env(rt):
+    """Tasks in different envs must not share worker processes."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHO": "alpha"}})
+    def who_a():
+        return os.environ["WHO"], os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHO": "beta"}})
+    def who_b():
+        return os.environ["WHO"], os.getpid()
+
+    (va, pa), (vb, pb) = ray_tpu.get(
+        [who_a.remote(), who_b.remote()], timeout=120)
+    assert va == "alpha" and vb == "beta"
+    assert pa != pb
+
+
+def test_working_dir_staged_and_cwd(rt, tmp_path):
+    app = tmp_path / "app"
+    app.mkdir()
+    (app / "data.txt").write_text("staged-payload")
+    (app / "helper_mod_rt.py").write_text("VALUE = 41\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(app)})
+    def use_working_dir():
+        import helper_mod_rt  # importable: working_dir is on PYTHONPATH
+
+        with open("data.txt") as f:  # cwd IS the staged dir
+            data = f.read()
+        return data, helper_mod_rt.VALUE + 1, os.getcwd()
+
+    data, val, cwd = ray_tpu.get(use_working_dir.remote(), timeout=120)
+    assert data == "staged-payload"
+    assert val == 42
+    assert "runtime_envs" in cwd and cwd.endswith("working_dir")
+
+
+def test_working_dir_edit_gets_fresh_env(rt, tmp_path):
+    """Editing the working_dir must produce a NEW env (hash covers content),
+    not reuse a stale staged copy."""
+    app = tmp_path / "app2"
+    app.mkdir()
+    (app / "v.txt").write_text("one")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(app)})
+    def read_v():
+        with open("v.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_v.remote(), timeout=120) == "one"
+    time.sleep(0.01)  # ensure mtime_ns moves
+    (app / "v.txt").write_text("two")
+    assert ray_tpu.get(read_v.remote(), timeout=120) == "two"
+
+
+def test_py_modules(rt, tmp_path):
+    pkg = tmp_path / "mods" / "rt_test_pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("ANSWER = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path / "mods")]})
+    def use_mod():
+        import rt_test_pkg
+
+        return rt_test_pkg.ANSWER
+
+    assert ray_tpu.get(use_mod.remote(), timeout=120) == 7
+
+
+def test_pip_satisfied_and_unsatisfied(rt):
+    @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+    def ok():
+        import numpy
+
+        return numpy.__name__
+
+    assert ray_tpu.get(ok.remote(), timeout=120) == "numpy"
+
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-real-pkg-xyz"]})
+    def bad():
+        return 1
+
+    with pytest.raises(Exception, match="not installed|no package index"):
+        ray_tpu.get(bad.remote(), timeout=60)
+
+
+def test_actor_runtime_env(rt):
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV_VAL")
+
+    a = ray_tpu.remote(EnvActor).options(
+        runtime_env={"env_vars": {"ACTOR_ENV_VAL": "actor-env"}}).remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "actor-env"
+
+
+def test_actor_env_setup_failure_is_fatal(rt):
+    class Doomed:
+        def ping(self):
+            return 1
+
+    a = ray_tpu.remote(Doomed).options(
+        name="doomed-env",
+        runtime_env={"pip": ["definitely-not-a-real-pkg-xyz"]}).remote()
+    # the creation must fail terminally (DEAD with the env cause), not
+    # retry forever
+    from ray_tpu.gcs.client import GcsClient
+
+    cw = ray_tpu.api._core_worker()
+    c = GcsClient(cw.gcs.address)
+    try:
+        deadline = time.monotonic() + 30
+        view = None
+        while time.monotonic() < deadline:
+            view = c.get_actor(a._actor_id)
+            if view and view["state"] == "DEAD":
+                break
+            time.sleep(0.2)
+        assert view and view["state"] == "DEAD"
+        assert "not installed" in view["death_cause"] or \
+            "runtime env" in view["death_cause"]
+    finally:
+        c.close()
+
+
+def test_job_level_default_env_merges(rt):
+    """submit-path merge: job default env_vars + per-task override."""
+    cw = ray_tpu.api._core_worker()
+    old = getattr(cw, "job_runtime_env", None)
+    cw.job_runtime_env = {"env_vars": {"JOB_LEVEL": "yes", "BOTH": "job"}}
+    try:
+        @ray_tpu.remote(runtime_env={"env_vars": {"BOTH": "task"}})
+        def read():
+            return os.environ.get("JOB_LEVEL"), os.environ.get("BOTH")
+
+        jl, both = ray_tpu.get(read.remote(), timeout=120)
+        assert jl == "yes"      # inherited from the job default
+        assert both == "task"   # per-task override wins
+    finally:
+        cw.job_runtime_env = old
+
+
+def test_child_task_inherits_parent_env(rt):
+    """A task submitted FROM INSIDE another task inherits the parent's
+    runtime env (reference parent-to-child inheritance) — without it, child
+    tasks of an env'd task land on default-env workers."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"LINEAGE": "inherited"}})
+    def parent():
+        import ray_tpu as rt2
+
+        @rt2.remote
+        def child():
+            return os.environ.get("LINEAGE")
+
+        return rt2.get(child.remote(), timeout=60)
+
+    assert ray_tpu.get(parent.remote(), timeout=120) == "inherited"
