@@ -61,9 +61,13 @@ class NormalTaskSubmitter:
                 try:
                     grant = await self._request_lease(sample)
                 except RuntimeEnvError as env_err:
-                    # env setup can never succeed on retry — fail the queue.
-                    # transient RPC errors deliberately propagate instead:
-                    # they leave tasks queued for a later lease attempt.
+                    # Env setup failure fails the queued tasks terminally,
+                    # matching the reference's RuntimeEnvSetupError semantics
+                    # (setup runs on the scheduled node; its failure is the
+                    # task's failure — even when another node might have the
+                    # local path). Transient RPC errors deliberately
+                    # propagate instead: they leave tasks queued for a later
+                    # lease attempt.
                     for spec in self._queues.pop(key, []):
                         self._store_error(spec, env_err)
                     return

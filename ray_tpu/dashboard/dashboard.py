@@ -208,11 +208,15 @@ async function refresh() {
     state: a.state, num_restarts: a.num_restarts})));
   fill('jobs', ['submission_id','status','entrypoint','message'], o.jobs);
 }
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, ch => ({'&':'&amp;','<':'&lt;',
+    '>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
+}
 function fill(id, cols, rows) {
   const t = document.getElementById(id);
-  t.innerHTML = '<tr>' + cols.map(c => `<th>${c}</th>`).join('') + '</tr>' +
-    rows.map(r => '<tr>' + cols.map(c => `<td>${r[c]}</td>`).join('') +
-    '</tr>').join('');
+  t.innerHTML = '<tr>' + cols.map(c => `<th>${esc(c)}</th>`).join('') +
+    '</tr>' + rows.map(r => '<tr>' +
+    cols.map(c => `<td>${esc(r[c])}</td>`).join('') + '</tr>').join('');
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>

@@ -142,6 +142,19 @@ class JobManager:
             self._env_agent.release(ctx.env_key)
             return
         self._procs[info.submission_id] = proc
+        # stop_job may have raced us while the env staged / process spawned
+        # (status PENDING, nothing in _procs to kill): honor the STOPPED
+        # marker instead of clobbering it with RUNNING.
+        latest = await self._get_info_async(info.submission_id)
+        if latest is not None and latest.status == JobStatus.STOPPED:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            logfile.close()
+            self._procs.pop(info.submission_id, None)
+            self._env_agent.release(ctx.env_key)
+            return
         info.status = JobStatus.RUNNING
         info.driver_pid = proc.pid
         await self._save_async(info)

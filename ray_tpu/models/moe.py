@@ -1,0 +1,250 @@
+"""Mixture-of-Experts decoder (Mixtral-style), TPU-first expert parallelism.
+
+The reference framework has no in-tree MoE — its LLM stack delegates to
+vLLM (reference ``python/ray/llm/_internal/serve/deployments/llm/vllm/``),
+and its EP story is torch process groups. Here expert parallelism is
+GSPMD-native (the design the public MoE-on-TPU literature converged on —
+GShard/Switch):
+
+- Experts are one stacked weight tensor with a leading ``expert`` logical
+  axis, sharded over the mesh's ep axes by the rule table
+  (``parallel/sharding.py: expert``). No per-expert modules, no manual
+  all-to-all: the dispatch einsum ``tec,th->ech`` contracts a
+  token-sharded activation against a token-routed one-hot into an
+  EXPERT-sharded tensor, and XLA lowers the resharding to ICI all-to-all.
+- Routing is top-k softmax gating with static expert capacity
+  (``capacity_factor``) so every shape is static under jit: dropped
+  tokens (over capacity) pass through the residual stream untouched.
+- The Switch load-balancing auxiliary loss and a router z-loss keep the
+  gate from collapsing; both are collected through the layer scan.
+- Attention/norms/rope reuse the Llama components, so sp (ring attention)
+  and tp compose with ep via the same rule table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import rope_frequencies
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    base: llama.LlamaConfig = dataclasses.field(
+        default_factory=lambda: llama.CONFIGS["tiny"])
+    n_experts: int = 8
+    top_k: int = 2
+    # per-expert slots = ceil(top_k * tokens / n_experts * capacity_factor)
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_z_coef: float = 1e-3
+
+    def capacity(self, tokens: int) -> int:
+        return max(1, math.ceil(
+            self.top_k * tokens * self.capacity_factor / self.n_experts))
+
+    def num_params(self) -> int:
+        c = self.base
+        dense = llama.LlamaConfig.num_params(
+            dataclasses.replace(c, mlp_dim=0))
+        experts = self.n_experts * 3 * c.hidden * c.mlp_dim * c.n_layers
+        router = c.hidden * self.n_experts * c.n_layers
+        return dense + experts + router
+
+    def active_params(self) -> int:
+        """Params touched per token (what FLOPs scale with)."""
+        c = self.base
+        dense = llama.LlamaConfig.num_params(
+            dataclasses.replace(c, mlp_dim=0))
+        experts = self.top_k * 3 * c.hidden * c.mlp_dim * c.n_layers
+        router = c.hidden * self.n_experts * c.n_layers
+        return dense + experts + router
+
+    def flops_per_token(self, seq: Optional[int] = None) -> float:
+        c = self.base
+        seq = c.max_seq if seq is None else seq
+        return 6.0 * self.active_params() + 6.0 * c.n_layers * seq * c.q_dim
+
+
+CONFIGS: Dict[str, MoEConfig] = {
+    "debug": MoEConfig(base=llama.CONFIGS["debug"], n_experts=4, top_k=2),
+    "tiny": MoEConfig(base=llama.CONFIGS["tiny"], n_experts=8, top_k=2),
+    # Mixtral-8x7B-ish shapes on the Llama-8B backbone
+    "8x7b": MoEConfig(base=dataclasses.replace(
+        llama.CONFIGS["8b"], hidden=4096, n_layers=32, mlp_dim=14336),
+        n_experts=8, top_k=2),
+}
+
+
+def param_logical_axes(config: MoEConfig) -> Params:
+    axes = llama.param_logical_axes(config.base)
+    layer_axes = dict(axes["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        layer_axes.pop(name)
+    layer_axes.update({
+        "router": ("layers", "embed", None),  # tiny; replicated
+        "we_gate": ("layers", "expert", "embed_fsdp", "mlp"),
+        "we_up": ("layers", "expert", "embed_fsdp", "mlp"),
+        "we_down": ("layers", "expert", "mlp", "embed_fsdp"),
+    })
+    axes["layers"] = layer_axes
+    return axes
+
+
+def init_params(config: MoEConfig, key: jax.Array) -> Params:
+    c = config.base
+    params = llama.init_params(c, key)
+    layers = dict(params["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        layers.pop(name)
+    k = iter(jax.random.split(jax.random.fold_in(key, 7), 8))
+    std = c.hidden ** -0.5
+    out_std = std / (2 * c.n_layers) ** 0.5
+    dt = c.dtype
+    L, E = c.n_layers, config.n_experts
+
+    def tn(key, shape, s):
+        return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32)
+                * s).astype(dt)
+
+    # the router runs in f32: tiny matmul, and gate ordering is precision-
+    # sensitive (bf16 ties reshuffle top-k between devices)
+    layers["router"] = tn(next(k), (L, c.hidden, E), std).astype(jnp.float32)
+    layers["we_gate"] = tn(next(k), (L, E, c.hidden, c.mlp_dim), std)
+    layers["we_up"] = tn(next(k), (L, E, c.hidden, c.mlp_dim), std)
+    layers["we_down"] = tn(next(k), (L, E, c.mlp_dim, c.hidden), out_std)
+    params["layers"] = layers
+    return params
+
+
+def _moe_mlp(x: jax.Array, layer: Params, config: MoEConfig,
+             rules: ShardingRules) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-k routed expert FFN with static capacity.
+
+    x: (B, S, H) → (B, S, H), plus router aux metrics.
+    """
+    c = config.base
+    B, S, H = x.shape
+    T = B * S
+    E, K = config.n_experts, config.top_k
+    C = config.capacity(T)
+    xt = x.reshape(T, H)
+
+    logits = jnp.einsum("th,he->te", xt.astype(jnp.float32), layer["router"])
+    probs = jax.nn.softmax(logits, axis=-1)                      # (T, E)
+    top_w, top_idx = jax.lax.top_k(probs, K)                     # (T, K)
+    top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+
+    # GShard-style slotting: earlier k-choices claim capacity first.
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)
+    frac_dispatched = jnp.zeros((E,), jnp.float32)
+    for k in range(K):  # K is a small static constant: unrolled
+        mask = jax.nn.one_hot(top_idx[:, k], E, dtype=jnp.int32)  # (T, E)
+        pos = counts[None, :] + jnp.cumsum(mask, axis=0) - mask   # (T, E)
+        pos_t = (pos * mask).sum(-1)                              # (T,)
+        kept = (pos_t < C) & (mask.sum(-1) > 0)
+        counts = counts + mask.sum(0)
+        slot = jax.nn.one_hot(pos_t, C, dtype=jnp.float32) \
+            * kept[:, None].astype(jnp.float32)                   # (T, C)
+        dispatch = dispatch + mask.astype(jnp.float32)[:, :, None] \
+            * slot[:, None, :]
+        combine = combine + top_w[:, k, None, None] \
+            * mask.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        frac_dispatched = frac_dispatched + mask.sum(0) / T
+
+    # dispatch: token-major → expert-major; the constraint pins the expert
+    # layout so XLA materializes the resharding as all-to-all over ep axes
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    expert_in = with_logical_constraint(expert_in, ("expert", None, "embed"),
+                                        rules)
+    g = jnp.einsum("ech,ehm->ecm", expert_in, layer["we_gate"].astype(x.dtype))
+    u = jnp.einsum("ech,ehm->ecm", expert_in, layer["we_up"].astype(x.dtype))
+    y = jnp.einsum("ecm,emh->ech", jax.nn.silu(g) * u,
+                   layer["we_down"].astype(x.dtype))
+    y = with_logical_constraint(y, ("expert", None, "embed"), rules)
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), y)
+
+    # Switch aux loss: E * Σ_e fraction_dispatched_e · mean_prob_e — minimized
+    # at uniform routing. frac counts ALL top-k assignments (pre-drop).
+    mean_prob = probs.mean(0)
+    aux = E * jnp.sum((frac_dispatched / K) * mean_prob)
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    # fraction of (token, k) slots that fell over capacity and were dropped
+    dropped = 1.0 - dispatch.sum() / (T * K)
+    return out.reshape(B, S, H), {"aux": aux, "router_z": z,
+                                  "dropped": dropped}
+
+
+def forward(params: Params, tokens: jax.Array, config: MoEConfig,
+            rules: Optional[ShardingRules] = None,
+            positions: Optional[jax.Array] = None, mesh=None):
+    """tokens (B, S) → (logits (B, S, V) f32, moe_metrics dict of scalars)."""
+    c = config.base
+    rules = rules or ShardingRules()
+    tokens = with_logical_constraint(tokens, ("batch", "seq"), rules)
+    table = with_logical_constraint(
+        params["embed"], ("embed_vocab", "embed"), rules)
+    x = table.astype(c.dtype)[tokens]
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block(x, layer):
+        h = llama._attention(rmsnorm(x, layer["attn_norm"], c.norm_eps),
+                             layer, cos, sin, c, rules, positions, mesh)
+        x = x + h
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        h, moe_aux = _moe_mlp(rmsnorm(x, layer["mlp_norm"], c.norm_eps),
+                              layer, config, rules)
+        x = x + h
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        return x, moe_aux
+
+    if c.remat:
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if c.remat_policy == "dots"
+                  else jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(block, policy=policy)
+    x, aux = jax.lax.scan(block, x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+    metrics = {k: v.mean() for k, v in aux.items()}  # mean over layers
+    return logits, metrics
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], config: MoEConfig,
+            rules: Optional[ShardingRules] = None, mesh=None):
+    """Next-token CE + router auxiliary losses. Same contract as
+    ``llama.loss_fn`` so ``training.make_train_step`` takes it unchanged."""
+    tokens = batch["tokens"]
+    logits, moe = forward(params, tokens, config, rules, mesh=mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    mask = batch.get("mask")
+    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask[:, :-1].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    ce = (nll * mask).sum() / denom
+    loss = (ce + config.aux_loss_coef * moe["aux"]
+            + config.router_z_coef * moe["router_z"])
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "ce": ce, "accuracy": acc, "tokens": denom,
+                  "aux_loss": moe["aux"], "router_z": moe["router_z"],
+                  "dropped_frac": moe["dropped"]}

@@ -412,9 +412,16 @@ class Raylet:
 
     def _allocate_local(self, request: ResourceRequest,
                         pg: Optional[Tuple[PlacementGroupID, int]]):
+        """Returns an assignment or None. Availability is RE-CHECKED here:
+        callers may have awaited (env staging) since their _local_available
+        check, and a competing grant can win the resources meanwhile."""
         if pg is not None:
             pg_id, idx = pg
-            bundle = self._bundles[pg_id][idx]
+            bundle = self._bundles.get(pg_id, {}).get(idx)
+            if bundle is None or not bundle.committed or \
+                    not request.resources.is_subset_of(
+                        bundle.available.resources):
+                return None
             bundle.available = ResourceRequest(
                 (bundle.available.resources - request.resources).to_dict()
             )
@@ -611,6 +618,9 @@ class Raylet:
             return {"ok": False, "fatal": True,
                     "reason": f"runtime env setup failed: {e}"}
         assignment = self._allocate_local(request, pg_key)
+        if assignment is None:
+            # a competing grant won the resources during env staging
+            return {"ok": False, "reason": "resources unavailable"}
         w = await self._pop_worker(ctx=ctx)
         if w is None:
             if pg_key is None:

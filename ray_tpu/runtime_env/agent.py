@@ -102,29 +102,52 @@ class RuntimeEnvAgent:
 
     # ------------------------------------------------------------- internals
     def _materialize(self, key: str, env: dict) -> WorkerEnvContext:
-        ctx = WorkerEnvContext(env_key=key, env_vars=dict(env.get("env_vars") or {}))
+        """Stage-then-rename: the env is built in a private tmp dir and
+        atomically renamed to its content-addressed location. The key hashes
+        every file's (size, mtime) — same key ⇒ same content — so an
+        existing staged dir is ALWAYS safe to reuse, never deleted/rebuilt:
+        concurrent materializations (two threads, or the raylet's and the
+        job manager's agent sharing one session dir) race benignly on the
+        rename, and live workers whose cwd is inside a staged dir never
+        have it pulled out from under them."""
+        self._check_pip(env.get("pip") or [])
         stage = os.path.join(self._root, key)
-        os.makedirs(stage, exist_ok=True)
-        wd = env.get("working_dir")
-        if wd is not None:
+        ready = os.path.join(stage, ".ready")
+        if not os.path.exists(ready):
+            tmp = f"{stage}.tmp.{os.getpid()}.{threading.get_ident()}"
+            os.makedirs(tmp, exist_ok=True)
+            try:
+                wd = env.get("working_dir")
+                if wd is not None:
+                    self._stage_path(wd, os.path.join(tmp, "working_dir"))
+                for i, mod in enumerate(env.get("py_modules") or []):
+                    self._stage_path(mod, os.path.join(tmp, f"py_module_{i}"))
+                with open(os.path.join(tmp, ".ready"), "w") as f:
+                    f.write(key)
+                try:
+                    os.rename(tmp, stage)
+                    logger.info("runtime env %s staged at %s", key, stage)
+                except OSError:
+                    # another materializer won the rename: reuse theirs
+                    shutil.rmtree(tmp, ignore_errors=True)
+            except Exception:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+        ctx = WorkerEnvContext(env_key=key,
+                               env_vars=dict(env.get("env_vars") or {}))
+        if env.get("working_dir") is not None:
             target = os.path.join(stage, "working_dir")
-            self._stage_path(wd, target)
             ctx.cwd = target
             ctx.pythonpath_prepend.append(target)
-        for i, mod in enumerate(env.get("py_modules") or []):
-            target = os.path.join(stage, f"py_module_{i}")
-            self._stage_path(mod, target)
+        for i in range(len(env.get("py_modules") or [])):
             # a module DIRECTORY is importable from its parent; a staged
             # tree of plain files is importable from the target itself
-            ctx.pythonpath_prepend.append(target)
-        self._check_pip(env.get("pip") or [])
-        logger.info("runtime env %s materialized at %s", key, stage)
+            ctx.pythonpath_prepend.append(
+                os.path.join(stage, f"py_module_{i}"))
         return ctx
 
     @staticmethod
     def _stage_path(src: str, target: str):
-        if os.path.exists(target):
-            shutil.rmtree(target, ignore_errors=True)
         if not os.path.exists(src):
             raise RuntimeEnvError(f"runtime_env path does not exist: {src}")
         if src.endswith(".zip") and os.path.isfile(src):

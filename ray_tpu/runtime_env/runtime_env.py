@@ -27,6 +27,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 
@@ -104,12 +106,28 @@ def merge(base: Optional[dict], override: Optional[dict]) -> Optional[dict]:
     return out
 
 
+_HASH_TTL_S = 2.0
+_hash_cache: Dict[str, tuple] = {}
+_hash_lock = threading.Lock()
+
+
 def env_hash(env: Optional[dict]) -> Optional[str]:
     """Stable content hash used as the worker-pool key. Local paths are
     hashed by their resolved path + mtime tree signature so an edited
-    working_dir yields a fresh environment."""
+    working_dir yields a fresh environment.
+
+    Tree-walking every file is too hot for per-task submission (a 10k-task
+    storm over one env must not stat the tree 10k times), so results are
+    memoized for a short TTL — an edit is picked up within _HASH_TTL_S, and
+    a task storm pays one walk per window."""
     if not env:
         return None
+    cache_key = json.dumps(env, sort_keys=True, default=str)
+    now = time.monotonic()
+    with _hash_lock:
+        hit = _hash_cache.get(cache_key)
+        if hit is not None and now - hit[1] < _HASH_TTL_S:
+            return hit[0]
     canon: Dict[str, Any] = {}
     for k in sorted(env):
         v = env[k]
@@ -120,7 +138,12 @@ def env_hash(env: Optional[dict]) -> Optional[str]:
         else:
             canon[k] = v
     blob = json.dumps(canon, sort_keys=True, default=str).encode()
-    return hashlib.sha1(blob).hexdigest()[:16]
+    out = hashlib.sha1(blob).hexdigest()[:16]
+    with _hash_lock:
+        _hash_cache[cache_key] = (out, now)
+        if len(_hash_cache) > 1024:
+            _hash_cache.clear()
+    return out
 
 
 def _tree_signature(path: str) -> str:
