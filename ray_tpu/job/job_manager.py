@@ -22,6 +22,7 @@ import logging
 import os
 import signal
 import subprocess
+import threading
 import time
 import uuid
 from typing import AsyncIterator, Dict, List, Optional
@@ -44,7 +45,15 @@ class JobManager:
 
         self._env_agent = RuntimeEnvAgent(session_dir)
         self._procs: Dict[str, subprocess.Popen] = {}
+        # serializes status read-modify-write between stop_job (sync,
+        # caller threads) and the supervisor (via to_thread) so a STOPPED
+        # marker can never be clobbered by a racing RUNNING save
+        self._status_locks: Dict[str, threading.Lock] = {}
         self._io = IoContext.current()
+
+    def _status_lock(self, submission_id: str) -> threading.Lock:
+        return self._status_locks.setdefault(submission_id,
+                                             threading.Lock())
 
     # ----------------------------------------------------------------- state
     def _save(self, info: JobInfo):
@@ -142,11 +151,23 @@ class JobManager:
             self._env_agent.release(ctx.env_key)
             return
         self._procs[info.submission_id] = proc
-        # stop_job may have raced us while the env staged / process spawned
-        # (status PENDING, nothing in _procs to kill): honor the STOPPED
-        # marker instead of clobbering it with RUNNING.
-        latest = await self._get_info_async(info.submission_id)
-        if latest is not None and latest.status == JobStatus.STOPPED:
+
+        def mark_running() -> bool:
+            # atomic check-and-set under the status lock: stop_job may have
+            # raced us while the env staged / process spawned (status
+            # PENDING, nothing in _procs to kill) — honor the STOPPED
+            # marker instead of clobbering it with RUNNING.
+            with self._status_lock(info.submission_id):
+                latest = self.get_job_info(info.submission_id)
+                if latest is not None and \
+                        latest.status == JobStatus.STOPPED:
+                    return False
+                info.status = JobStatus.RUNNING
+                info.driver_pid = proc.pid
+                self._save(info)
+                return True
+
+        if not await asyncio.to_thread(mark_running):
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
@@ -155,9 +176,6 @@ class JobManager:
             self._procs.pop(info.submission_id, None)
             self._env_agent.release(ctx.env_key)
             return
-        info.status = JobStatus.RUNNING
-        info.driver_pid = proc.pid
-        await self._save_async(info)
         logger.info("job %s running (pid %s): %s",
                     info.submission_id, proc.pid, info.entrypoint)
         while proc.poll() is None:
@@ -180,13 +198,14 @@ class JobManager:
 
     # ------------------------------------------------------------------ stop
     def stop_job(self, submission_id: str) -> bool:
-        info = self.get_job_info(submission_id)
-        if info is None or JobStatus.is_terminal(info.status):
-            return False
-        info.status = JobStatus.STOPPED
-        info.message = "stopped via stop_job"
-        info.end_time = time.time()
-        self._save(info)
+        with self._status_lock(submission_id):
+            info = self.get_job_info(submission_id)
+            if info is None or JobStatus.is_terminal(info.status):
+                return False
+            info.status = JobStatus.STOPPED
+            info.message = "stopped via stop_job"
+            info.end_time = time.time()
+            self._save(info)
         proc = self._procs.get(submission_id)
         if proc is not None and proc.poll() is None:
             try:  # TERM the process group, escalate to KILL

@@ -22,3 +22,23 @@ from ray_tpu.rl.impala import (  # noqa: F401,E402
     IMPALAConfig,
     IMPALALearner,
 )
+from ray_tpu.rl.connectors import (  # noqa: F401
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    Lambda,
+    ObsNormalizer,
+)
+from ray_tpu.rl.multi_agent import (  # noqa: F401
+    CoordinationGameEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rl.offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    JsonReader,
+    JsonWriter,
+    collect,
+)

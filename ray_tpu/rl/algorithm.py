@@ -23,6 +23,8 @@ from ray_tpu.rl.module import init_policy_params
 @dataclasses.dataclass
 class AlgorithmConfig:
     env: Union[str, Any] = "CartPole-v1"
+    # factories producing fresh Connector instances per env runner
+    connectors: tuple = ()
     num_env_runners: int = 2
     rollout_fragment_length: int = 256
     gamma: float = 0.99
@@ -61,11 +63,12 @@ class Algorithm:
         self.config = config
         self.iteration = 0
         self._weights_version = 0
-        self._env_probe = _probe_env(config.env)
+        self._env_probe = _probe_env(config.env, config.connectors)
         remote_runner = ray_tpu.remote(EnvRunner)
         actors = [
             remote_runner.remote(config.env, seed=config.seed,
-                                 worker_index=i)
+                                 worker_index=i,
+                                 connectors=list(config.connectors))
             for i in range(config.num_env_runners)
         ]
         self.env_runner_group = FaultTolerantActorManager(actors)
@@ -95,6 +98,15 @@ class Algorithm:
             lambda a: a.set_weights.remote(weights, version))
         results = self.env_runner_group.foreach_actor(
             lambda a: a.sample.remote(self.config.rollout_fragment_length))
+        if self.config.connectors:
+            # sync stateful connector stats (e.g. obs-normalizer running
+            # mean/var) runner 0 -> fleet, so policies see one distribution
+            states = self.env_runner_group.foreach_actor(
+                lambda a: a.get_connector_state.remote())
+            good = [r.value for r in states if r.ok]
+            if good:
+                self.env_runner_group.foreach_actor(
+                    lambda a: a.set_connector_state.remote(good[0]))
         return [r.value for r in results if r.ok]
 
     def episode_return_mean(self) -> float:
@@ -173,7 +185,8 @@ class PPOConfig(AlgorithmConfig):
     algo_class = PPO
 
 
-def _probe_env(env_spec) -> Dict[str, int]:
+def _probe_env(env_spec, connectors=()) -> Dict[str, int]:
+    from ray_tpu.rl.connectors import ConnectorPipeline
     from ray_tpu.rl.envs import make_env
 
     env = make_env(env_spec)
@@ -182,5 +195,14 @@ def _probe_env(env_spec) -> Dict[str, int]:
     if num_actions is None:
         space = getattr(env, "action_space", None)
         num_actions = int(getattr(space, "n"))
-    return {"obs_size": int(np.asarray(obs).size),
-            "num_actions": int(num_actions)}
+    obs_size = int(np.asarray(obs).size)
+    if connectors:
+        from ray_tpu.rl.env_runner import _make_connector
+
+        # size-only computation (output_size chains transformed_size):
+        # running instances on a real obs would mutate stateful connector
+        # INSTANCES that then ship contaminated to every runner
+        pipeline = ConnectorPipeline([_make_connector(c)
+                                      for c in connectors])
+        obs_size = pipeline.output_size(obs_size)
+    return {"obs_size": obs_size, "num_actions": int(num_actions)}

@@ -16,15 +16,41 @@ from ray_tpu.rl.envs import make_env
 from ray_tpu.rl.module import Params, np_sample_action
 
 
+def _make_connector(c):
+    """Accept a Connector instance, a Connector subclass, or a zero-arg
+    factory — factories/classes build per-runner instances (stateful
+    connectors must not share state across runners by accident)."""
+    from ray_tpu.rl.connectors import Connector
+
+    if isinstance(c, Connector):
+        return c
+    return c()
+
+
 class EnvRunner:
     def __init__(self, env_spec: Union[str, Any] = "CartPole-v1",
-                 seed: int = 0, worker_index: int = 0):
+                 seed: int = 0, worker_index: int = 0,
+                 connectors=None):
+        from ray_tpu.rl.connectors import ConnectorPipeline
+
         self.env = make_env(env_spec, seed=seed + worker_index)
         self._rng = np.random.default_rng(seed * 100003 + worker_index)
         self._params: Optional[Params] = None
-        self._obs, _ = self.env.reset(seed=seed + worker_index)
+        # env-to-module pipeline: raw obs -> what the policy consumes
+        # (reference connector_v2 env-runner pipeline)
+        self._pipeline = ConnectorPipeline(
+            [_make_connector(c) for c in (connectors or [])])
+        raw, _ = self.env.reset(seed=seed + worker_index)
+        self._obs = self._pipeline(raw)
         self._episode_return = 0.0
         self._weights_version = -1
+
+    def get_connector_state(self):
+        return self._pipeline.get_state()
+
+    def set_connector_state(self, state) -> bool:
+        self._pipeline.set_state(state)
+        return True
 
     def ping(self) -> bool:
         return True
@@ -54,8 +80,8 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = logp
             val_buf[t] = value
-            self._obs, reward, terminated, truncated, _ = self.env.step(
-                action)
+            raw, reward, terminated, truncated, _ = self.env.step(action)
+            self._obs = self._pipeline(raw)
             rew_buf[t] = reward
             # Truncation treated as termination for GAE (standard
             # simplification: no next-state bootstrap at the cut).
@@ -64,7 +90,9 @@ class EnvRunner:
             if terminated or truncated:
                 episode_returns.append(self._episode_return)
                 self._episode_return = 0.0
-                self._obs, _ = self.env.reset()
+                self._pipeline.reset()
+                raw, _ = self.env.reset()
+                self._obs = self._pipeline(raw)
 
         # Bootstrap value for the (possibly mid-episode) final state.
         from ray_tpu.rl.module import np_forward

@@ -68,8 +68,15 @@ class CartPoleEnv:
                 truncated, {})
 
 
+def _coordination_factory(seed=None):
+    from ray_tpu.rl.multi_agent import CoordinationGameEnv
+
+    return CoordinationGameEnv(seed=seed)
+
+
 _REGISTRY: Dict[str, Callable[..., Any]] = {
     "CartPole-v1": CartPoleEnv,
+    "coordination": _coordination_factory,
 }
 
 

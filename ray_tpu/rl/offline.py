@@ -1,0 +1,209 @@
+"""Offline RL: experience datasets on disk + behavior cloning.
+
+Reference: ``rllib/offline/`` (JsonWriter/JsonReader sample IO,
+``rllib/algorithms/bc/bc.py`` behavior cloning on logged actions). Data
+interop: fragments written by env runners load back as column arrays, and
+``to_dataset`` bridges into ray_tpu.data for pipeline-style transforms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+
+class JsonWriter:
+    """Append rollout fragments as JSONL (one fragment per line)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self._dir = path
+        os.makedirs(path, exist_ok=True)
+        self._max = max_file_size
+        self._index = 0
+        self._file = None
+
+    def _rotate(self):
+        if self._file is not None:
+            self._file.close()
+        name = os.path.join(self._dir, f"output-{self._index:05d}.jsonl")
+        self._index += 1
+        self._file = open(name, "a")
+
+    def write(self, fragment: Dict[str, Any]) -> None:
+        if self._file is None or self._file.tell() > self._max:
+            self._rotate()
+        row = {}
+        for k, v in fragment.items():
+            row[k] = v.tolist() if isinstance(v, np.ndarray) else v
+        self._file.write(json.dumps(row) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Read fragments back as numpy column dicts."""
+
+    _ARRAY_DTYPES = {"obs": np.float32, "actions": np.int32,
+                     "rewards": np.float32, "dones": np.bool_,
+                     "logp": np.float32, "values": np.float32}
+
+    def __init__(self, path: str):
+        self._files = sorted(glob.glob(os.path.join(path, "*.jsonl"))) \
+            if os.path.isdir(path) else [path]
+        if not self._files:
+            raise FileNotFoundError(f"no .jsonl files under {path}")
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        for fn in self._files:
+            with open(fn) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    for k, dt in self._ARRAY_DTYPES.items():
+                        if k in row:
+                            row[k] = np.asarray(row[k], dt)
+                    yield row
+
+    def read_all(self) -> Dict[str, np.ndarray]:
+        cols: Dict[str, List[np.ndarray]] = {}
+        for row in self:
+            for k, v in row.items():
+                if isinstance(v, np.ndarray):
+                    cols.setdefault(k, []).append(v)
+        return {k: np.concatenate(v) for k, v in cols.items()}
+
+
+def to_dataset(path: str):
+    """Bridge into ray_tpu.data: one block row per transition."""
+    from ray_tpu import data
+
+    cols = JsonReader(path).read_all()
+    n = len(cols["actions"])
+    return data.from_items([
+        {k: cols[k][i].tolist() if cols[k][i].ndim else cols[k][i].item()
+         for k in cols} for i in range(n)
+    ])
+
+
+def collect(env_spec, policy_params, path: str, *, num_steps: int = 2048,
+            seed: int = 0) -> str:
+    """Roll out a policy and persist the experience (reference
+    ``rllib ... output`` config): the offline-data entry point."""
+    from ray_tpu.rl.env_runner import EnvRunner
+
+    runner = EnvRunner(env_spec, seed=seed)
+    runner.set_weights(policy_params)
+    writer = JsonWriter(path)
+    wrote = 0
+    while wrote < num_steps:
+        frag = runner.sample(min(512, num_steps - wrote))
+        writer.write({k: v for k, v in frag.items()
+                      if k in JsonReader._ARRAY_DTYPES})
+        wrote += len(frag["actions"])
+    writer.close()
+    return path
+
+
+@dataclasses.dataclass
+class BCConfig:
+    input_path: str = ""
+    lr: float = 1e-3
+    num_epochs: int = 1
+    minibatch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    env: Union[str, Any] = "CartPole-v1"  # only needed for evaluate()
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning: maximize log π(a_logged | s) over the dataset
+    (reference ``rllib/algorithms/bc``). The simplest offline algorithm —
+    and the correctness anchor for the offline data path."""
+
+    def __init__(self, config: BCConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.module import init_policy_params, jax_forward
+
+        self.config = config
+        data = JsonReader(config.input_path).read_all()
+        self._obs = np.asarray(data["obs"], np.float32)
+        self._actions = np.asarray(data["actions"], np.int32)
+        self.params = init_policy_params(
+            self._obs.shape[-1], int(self._actions.max()) + 1,
+            hidden=tuple(config.hidden), seed=config.seed)
+        self._opt = optax.adam(config.lr)
+        self._opt_state = self._opt.init(self.params)
+        self.iteration = 0
+
+        def loss(params, obs, actions):
+            logits, _ = jax_forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None].astype(jnp.int32), axis=-1)
+            return nll.mean()
+
+        @jax.jit
+        def step(params, opt_state, obs, actions):
+            l, g = jax.value_and_grad(loss)(params, obs, actions)
+            updates, opt_state = self._opt.update(g, opt_state, params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state, l
+
+        self._step = step
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        n = len(self._obs)
+        mb = min(self.config.minibatch_size, n)
+        losses = []
+        for _ in range(self.config.num_epochs):
+            order = self._rng.permutation(n)
+            for i in range(0, n - mb + 1, mb):
+                idx = order[i:i + mb]
+                self.params, self._opt_state, l = self._step(
+                    self.params, self._opt_state, self._obs[idx],
+                    self._actions[idx])
+                losses.append(float(l))
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(np.mean(losses))}
+
+    def evaluate(self, num_episodes: int = 5,
+                 seed: int = 100) -> Dict[str, float]:
+        from ray_tpu.rl.envs import make_env
+        from ray_tpu.rl.module import np_forward
+
+        env = make_env(self.config.env, seed=seed)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = np_forward(
+                    jax_to_np(self.params), np.asarray(obs)[None])
+                obs, r, term, trunc, _ = env.step(int(logits[0].argmax()))
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}
+
+
+def jax_to_np(params):
+    return {k: np.asarray(v) for k, v in params.items()}

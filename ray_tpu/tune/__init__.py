@@ -14,9 +14,11 @@ from ray_tpu.tune.search import (  # noqa: F401
 )
 from ray_tpu.tune.tuner import (  # noqa: F401
     ASHAScheduler,
+    PopulationBasedTraining,
     Result,
     ResultGrid,
     TuneConfig,
     Tuner,
+    get_checkpoint,
     report,
 )

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import threading
 import time
 import traceback
@@ -23,12 +24,21 @@ from ray_tpu.tune.search import expand_param_space
 _trial_ctx = threading.local()
 
 
-def report(metrics: Dict[str, Any]) -> None:
-    """Report metrics from inside a trial (reference ``tune.report``)."""
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Dict[str, Any]] = None) -> None:
+    """Report metrics from inside a trial (reference ``tune.report``).
+    ``checkpoint`` is a small state dict kept with the trial — PBT exploit
+    clones it into other trials, and experiment restore resumes from it."""
     sink = getattr(_trial_ctx, "sink", None)
     if sink is None:
         raise RuntimeError("tune.report() called outside a trial")
-    sink(metrics)
+    sink(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Dict[str, Any]]:
+    """Inside a trial: the checkpoint to resume from (None = fresh start).
+    Set when PBT exploits another trial or the experiment was restored."""
+    return getattr(_trial_ctx, "checkpoint", None)
 
 
 class TrialActor:
@@ -40,17 +50,19 @@ class TrialActor:
         self._status = "idle"
         self._error: Optional[str] = None
 
-    def run(self, fn_blob: bytes, config: dict) -> bool:
+    def run(self, fn_blob: bytes, config: dict,
+            checkpoint: Optional[dict] = None) -> bool:
         import cloudpickle
 
         fn = cloudpickle.loads(fn_blob)
 
-        def sink(metrics):
+        def sink(metrics, ckpt=None):
             with self._lock:
-                self._reports.append(dict(metrics))
+                self._reports.append((dict(metrics), ckpt))
 
         def target():
             _trial_ctx.sink = sink
+            _trial_ctx.checkpoint = checkpoint
             try:
                 out = fn(config)
                 if isinstance(out, dict):
@@ -61,6 +73,7 @@ class TrialActor:
                 self._status = "error"
             finally:
                 _trial_ctx.sink = None
+                _trial_ctx.checkpoint = None
 
         self._status = "running"
         threading.Thread(target=target, daemon=True, name="trial").start()
@@ -126,6 +139,75 @@ class ASHAScheduler:
         return "continue"
 
 
+@dataclasses.dataclass
+class PopulationBasedTraining:
+    """PBT (reference ``tune/schedulers/pbt.py``): at each perturbation
+    interval, bottom-quantile trials EXPLOIT a top-quantile trial (copy its
+    config + latest checkpoint) and EXPLORE (perturb each hyperparameter by
+    a factor, or resample from the search space)."""
+
+    time_attr: str = "training_iteration"
+    perturbation_interval: int = 4
+    quantile_fraction: float = 0.25
+    perturbation_factors: tuple = (0.8, 1.2)
+    resample_probability: float = 0.25
+    # {name: Domain | list} — hyperparams PBT may mutate
+    hyperparam_mutations: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    seed: int = 0
+
+    def __post_init__(self):
+        import numpy as np
+
+        self._rng = np.random.default_rng(self.seed)
+        self._last_perturb: Dict[int, int] = {}
+        self._scores: Dict[int, float] = {}
+
+    def on_result(self, trial_id: int, step: int, score: float) -> str:
+        """"continue" or "exploit"; the controller then calls
+        :meth:`exploit` for the clone instructions."""
+        self._scores[trial_id] = score
+        last = self._last_perturb.get(trial_id, 0)
+        if step - last < self.perturbation_interval:
+            return "continue"
+        self._last_perturb[trial_id] = step
+        pop = sorted(self._scores.items(), key=lambda kv: kv[1])
+        k = max(1, int(len(pop) * self.quantile_fraction))
+        if len(pop) < 2 * k:
+            return "continue"
+        bottom = {tid for tid, _ in pop[:k]}
+        if trial_id not in bottom:
+            return "continue"
+        self._exploit_src = [tid for tid, _ in pop[-k:]]
+        return "exploit"
+
+    def exploit(self, trial_id: int, configs: Dict[int, dict]) -> tuple:
+        """Returns (source_trial_id, explored_config)."""
+        src_tid = int(self._rng.choice(self._exploit_src))
+        new_config = self.explore(dict(configs[src_tid]))
+        return src_tid, new_config
+
+    def explore(self, config: dict) -> dict:
+        from ray_tpu.tune.search import Domain
+
+        for name, domain in self.hyperparam_mutations.items():
+            if self._rng.random() < self.resample_probability:
+                if isinstance(domain, Domain):
+                    config[name] = domain.sample(self._rng)
+                else:
+                    config[name] = domain[int(self._rng.integers(len(domain)))]
+            elif isinstance(config.get(name), (int, float)) and \
+                    not isinstance(config.get(name), bool):
+                factor = self.perturbation_factors[
+                    int(self._rng.integers(len(self.perturbation_factors)))]
+                val = config[name] * factor
+                config[name] = type(config[name])(val) \
+                    if isinstance(config[name], int) else val
+            elif isinstance(domain, (list, tuple)):
+                config[name] = domain[int(self._rng.integers(len(domain)))]
+        return config
+
+
 # ---------------------------------------------------------------- tuner
 
 
@@ -135,7 +217,7 @@ class TuneConfig:
     mode: str = "max"                  # "max" | "min"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Optional[ASHAScheduler] = None
+    scheduler: Optional[Any] = None     # ASHAScheduler | PopulationBasedTraining
     seed: int = 0
 
 
@@ -177,10 +259,52 @@ class ResultGrid:
 class Tuner:
     def __init__(self, trainable: Callable[[dict], Any], *,
                  param_space: Dict[str, Any],
-                 tune_config: Optional[TuneConfig] = None):
+                 tune_config: Optional[TuneConfig] = None,
+                 storage_path: Optional[str] = None):
         self._trainable = trainable
         self._space = param_space
         self._cfg = tune_config or TuneConfig()
+        self._storage_path = storage_path
+        self._restored: Optional[dict] = None
+
+    @classmethod
+    def restore(cls, storage_path: str,
+                trainable: Callable[[dict], Any]) -> "Tuner":
+        """Resume an interrupted experiment (reference ``Tuner.restore``):
+        completed trials keep their results; unfinished trials re-run from
+        their last reported checkpoint."""
+        import pickle
+
+        with open(os.path.join(storage_path, "experiment_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        tuner = cls(trainable, param_space=state["param_space"],
+                    tune_config=state["tune_config"],
+                    storage_path=storage_path)
+        tuner._restored = state
+        return tuner
+
+    def _save_experiment(self, configs, results, steps, checkpoints,
+                         last_metrics):
+        if self._storage_path is None:
+            return
+        import pickle
+
+        os.makedirs(self._storage_path, exist_ok=True)
+        tmp = os.path.join(self._storage_path, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump({
+                "param_space": self._space,
+                "tune_config": self._cfg,
+                "configs": configs,
+                "results": {tid: (r.config, r.metrics, r.error)
+                            for tid, r in results.items()},
+                "steps": dict(steps),
+                "checkpoints": dict(checkpoints),
+                "last_metrics": dict(last_metrics),
+            }, f)
+        os.replace(tmp, os.path.join(self._storage_path,
+                                     "experiment_state.pkl"))
 
     def fit(self, timeout_s: float = 600.0) -> ResultGrid:
         import cloudpickle
@@ -188,16 +312,30 @@ class Tuner:
         import ray_tpu
 
         cfg = self._cfg
-        configs = expand_param_space(self._space, cfg.num_samples, cfg.seed)
         fn_blob = cloudpickle.dumps(self._trainable)
         remote_cls = ray_tpu.remote(TrialActor)
         sign = 1 if cfg.mode == "max" else -1
 
-        pending = list(enumerate(configs))
-        running: Dict[int, dict] = {}   # trial_id -> {actor, config, ...}
         results: Dict[int, Result] = {}
         steps: Dict[int, int] = {}
         last_metrics: Dict[int, dict] = {}
+        checkpoints: Dict[int, Optional[dict]] = {}
+        if self._restored is not None:
+            st = self._restored
+            configs = st["configs"]
+            for tid, (rconf, rmet, rerr) in st["results"].items():
+                if rerr is None:  # completed trials stay done
+                    results[tid] = Result(rconf, rmet)
+            steps.update(st["steps"])
+            checkpoints.update(st["checkpoints"])
+            last_metrics.update(st["last_metrics"])
+            pending = [(tid, configs[tid]) for tid in sorted(configs)
+                       if tid not in results]
+        else:
+            configs = dict(enumerate(
+                expand_param_space(self._space, cfg.num_samples, cfg.seed)))
+            pending = sorted(configs.items())
+        running: Dict[int, dict] = {}   # trial_id -> {actor, config}
         deadline = time.monotonic() + timeout_s
 
         def launch():
@@ -207,62 +345,97 @@ class Tuner:
             while pending and len(running) < cfg.max_concurrent_trials:
                 tid, config = pending.pop(0)
                 actor = remote_cls.remote()
-                started.append(actor.run.remote(fn_blob, config))
+                started.append(actor.run.remote(
+                    fn_blob, config, checkpoints.get(tid)))
                 running[tid] = {"actor": actor, "config": config}
-                steps[tid] = 0
+                steps.setdefault(tid, 0)
             if started:
                 ray_tpu.get(started)
+
+        def finish(tid, error=None):
+            tr = running.pop(tid)
+            results[tid] = Result(tr["config"], last_metrics.get(tid, {}),
+                                  error=error)
+            try:
+                ray_tpu.kill(tr["actor"])
+            except Exception:  # noqa: BLE001
+                pass
 
         launch()
         while running:
             if time.monotonic() > deadline:
-                for tid, tr in running.items():
-                    results[tid] = Result(tr["config"],
-                                          last_metrics.get(tid, {}),
-                                          error="tune timeout")
-                    ray_tpu.kill(tr["actor"])
+                for tid in list(running):
+                    finish(tid, error="tune timeout")
                 break
             time.sleep(0.05)
+            dirty = False
             for tid in list(running):
                 tr = running[tid]
                 try:
                     st = ray_tpu.get([tr["actor"].poll.remote()],
                                      timeout=30.0)[0]
                 except Exception as e:  # noqa: BLE001 — trial actor died
-                    results[tid] = Result(tr["config"],
-                                          last_metrics.get(tid, {}),
-                                          error=f"trial actor died: {e}")
-                    del running[tid]
+                    finish(tid, error=f"trial actor died: {e}")
+                    dirty = True
                     continue
-                stopped = False
+                decision = "continue"
+                if st["reports"]:
+                    dirty = True
                 for rep in st["reports"]:
+                    rep, ckpt = rep if isinstance(rep, tuple) else (rep, None)
                     steps[tid] += 1
                     rep.setdefault("training_iteration", steps[tid])
                     last_metrics[tid] = rep
+                    if ckpt is not None:
+                        checkpoints[tid] = ckpt
                     if cfg.scheduler and cfg.metric in rep:
                         decision = cfg.scheduler.on_result(
                             tid, rep["training_iteration"],
                             sign * rep[cfg.metric])
-                        if decision == "stop":
-                            stopped = True
-                            break  # later reports are past the stop point
-                if stopped:
-                    results[tid] = Result(tr["config"],
-                                          last_metrics.get(tid, {}))
-                    ray_tpu.kill(tr["actor"])
-                    del running[tid]
+                        if decision != "continue":
+                            break  # later reports are past the decision
+                if decision == "exploit" and st["status"] != "running":
+                    # the trainable already returned: there is nothing to
+                    # relaunch — exploiting would re-run the whole function
+                    decision = "continue"
+                if decision == "stop":
+                    finish(tid)
+                elif decision == "exploit":
+                    # PBT: clone a top trial's config+checkpoint, explore,
+                    # and relaunch this trial in-place (same trial id).
+                    all_configs = {t: r["config"]
+                                   for t, r in running.items()}
+                    all_configs.update(
+                        {t: results[t].config for t in results})
+                    all_configs[tid] = tr["config"]
+                    src_tid, new_config = cfg.scheduler.exploit(
+                        tid, all_configs)
+                    src_ckpt = checkpoints.get(src_tid)
+                    try:
+                        ray_tpu.kill(tr["actor"])
+                    except Exception:  # noqa: BLE001
+                        pass
+                    actor = remote_cls.remote()
+                    ray_tpu.get([actor.run.remote(
+                        fn_blob, new_config, src_ckpt)])
+                    running[tid] = {"actor": actor, "config": new_config}
+                    configs[tid] = new_config
+                    if src_ckpt is not None:
+                        checkpoints[tid] = src_ckpt
                 elif st["status"] == "finished":
-                    results[tid] = Result(tr["config"],
-                                          last_metrics.get(tid, {}))
-                    ray_tpu.kill(tr["actor"])
-                    del running[tid]
+                    finish(tid)
+                    dirty = True
                 elif st["status"] == "error":
-                    results[tid] = Result(tr["config"],
-                                          last_metrics.get(tid, {}),
-                                          error=st["error"])
-                    ray_tpu.kill(tr["actor"])
-                    del running[tid]
+                    finish(tid, error=st["error"])
+                    dirty = True
+            if pending:
+                dirty = True
             launch()
+            if dirty:  # ~20 Hz poll loop: only persist actual progress
+                self._save_experiment(configs, results, steps, checkpoints,
+                                      last_metrics)
 
+        self._save_experiment(configs, results, steps, checkpoints,
+                              last_metrics)
         ordered = [results[tid] for tid in sorted(results)]
         return ResultGrid(ordered, cfg.metric, cfg.mode)

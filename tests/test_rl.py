@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu import rl
 from ray_tpu.rl.envs import CartPoleEnv
 from ray_tpu.rl.learner import compute_gae
 from ray_tpu.rl.module import init_policy_params, jax_forward, np_forward
@@ -215,3 +216,175 @@ class TestIMPALA:
         # discounted returns with gamma=0.5: [1+0.5+0.25, 1+0.5, 1]
         np.testing.assert_allclose(np.asarray(vs), [1.75, 1.5, 1.0],
                                    rtol=1e-6)
+
+
+class TestConnectors:
+    def test_obs_normalizer_and_state(self):
+        from ray_tpu.rl.connectors import ObsNormalizer
+
+        import numpy as np
+
+        norm = ObsNormalizer()
+        rng = np.random.default_rng(0)
+        for _ in range(500):
+            norm(rng.normal(5.0, 3.0, size=4))
+        out = norm(np.array([5.0, 5.0, 5.0, 5.0]))
+        assert np.abs(out).max() < 1.0  # near the running mean → ~0
+        # state transplants into a fresh connector
+        other = ObsNormalizer()
+        other.set_state(norm.get_state())
+        np.testing.assert_allclose(
+            other(np.array([5.0] * 4)), norm(np.array([5.0] * 4)),
+            rtol=1e-3)
+
+    def test_frame_stack(self):
+        import numpy as np
+
+        from ray_tpu.rl.connectors import FrameStack
+
+        fs = FrameStack(k=3)
+        o1 = fs(np.array([1.0]))
+        o2 = fs(np.array([2.0]))
+        np.testing.assert_allclose(o2, [0.0, 1.0, 2.0])
+        fs.reset()
+        np.testing.assert_allclose(fs(np.array([9.0])), [0.0, 0.0, 9.0])
+        assert fs.transformed_size(4) == 12
+
+    def test_ppo_with_connectors_runs(self, rt):
+        from ray_tpu.rl.connectors import ObsNormalizer
+
+        algo = (rl.PPOConfig(env="CartPole-v1")
+                .env_runners(1)
+                .training(rollout_fragment_length=64, num_epochs=1,
+                          connectors=(ObsNormalizer,))
+                .build())
+        try:
+            res = algo.train()
+            assert res["env_runners"]["num_env_steps_sampled"] == 64
+        finally:
+            algo.stop()
+
+
+class TestMultiAgent:
+    def test_coordination_game_learns(self, rt):
+        """Two independent policies in the matching game must converge on
+        a convention: per-step joint reward climbs toward 2 (both agents
+        rewarded each matching step x 32 steps => ~64/episode)."""
+        algo = (rl.MultiAgentPPOConfig(env="coordination")
+                .env_runners(2)
+                .training(rollout_fragment_length=256, lr=3e-3,
+                          minibatch_size=256, num_epochs=4)
+                .build())
+        try:
+            first = None
+            for i in range(30):
+                res = algo.train()
+                ret = res["env_runners"]["episode_return_mean"]
+                if first is None and ret == ret:  # first non-nan
+                    first = ret
+                if ret == ret and ret > 55:
+                    break
+            assert ret > 55, f"no convention learned: {ret} (start {first})"
+            assert set(res["learners"]) == {"agent_0", "agent_1"}
+        finally:
+            algo.stop()
+
+    def test_policies_to_train_freezes_others(self, rt):
+        algo = (rl.MultiAgentPPOConfig(env="coordination")
+                .env_runners(1)
+                .training(rollout_fragment_length=64, num_epochs=1)
+                .multi_agent(policies_to_train=["agent_0"])
+                .build())
+        try:
+            before = {k: {n: v.copy() for n, v in lr.get_weights().items()}
+                      for k, lr in algo.learners.items()}
+            algo.train()
+            import numpy as np
+
+            after = {k: lr.get_weights() for k, lr in algo.learners.items()}
+            changed = any(
+                not np.allclose(before["agent_0"][n], after["agent_0"][n])
+                for n in before["agent_0"])
+            frozen = all(
+                np.allclose(before["agent_1"][n], after["agent_1"][n])
+                for n in before["agent_1"])
+            assert changed and frozen
+        finally:
+            algo.stop()
+
+
+class TestOffline:
+    def _expert_params(self):
+        """A hand-built linear 'expert' for CartPole: push toward
+        theta + theta_dot (classic stabilizing heuristic, returns ~500)."""
+        import numpy as np
+
+        from ray_tpu.rl.module import init_policy_params
+
+        params = init_policy_params(4, 2, hidden=(8,), seed=0)
+        # logits = W2·tanh(W1·obs): make tower linear-ish in theta+theta_dot
+        params["p0_w"][:] = 0.0
+        params["p0_w"][2, 0] = 2.0   # theta
+        params["p0_w"][3, 0] = 1.0   # theta_dot
+        params["pi_w"][:] = 0.0
+        params["pi_w"][0, 1] = 10.0  # positive tilt → push right
+        params["pi_w"][0, 0] = -10.0
+        return params
+
+    def test_collect_read_roundtrip(self, rt, tmp_path):
+        import numpy as np
+
+        from ray_tpu.rl import offline
+
+        path = offline.collect("CartPole-v1", self._expert_params(),
+                               str(tmp_path / "data"), num_steps=600)
+        cols = offline.JsonReader(path).read_all()
+        assert len(cols["actions"]) == 600
+        assert cols["obs"].shape == (600, 4)
+        assert cols["obs"].dtype == np.float32
+
+    def test_bc_learns_from_expert_data(self, rt, tmp_path):
+        from ray_tpu.rl import offline
+
+        path = offline.collect("CartPole-v1", self._expert_params(),
+                               str(tmp_path / "data"), num_steps=3000)
+        bc = offline.BCConfig(input_path=path, num_epochs=4,
+                              lr=3e-3).build()
+        for _ in range(8):
+            metrics = bc.train()
+        # the expert SAMPLES from its softmax, so the loss floor is the
+        # behavior entropy (~0.28 here), not zero — eval return below is
+        # the meaningful imitation criterion
+        assert metrics["bc_loss"] < 0.45
+        ev = bc.evaluate(num_episodes=3)
+        assert ev["episode_return_mean"] > 150  # random policy is ~20
+
+    def test_to_dataset_bridge(self, rt, tmp_path):
+        from ray_tpu.rl import offline
+
+        path = offline.collect("CartPole-v1", self._expert_params(),
+                               str(tmp_path / "data"), num_steps=100)
+        ds = offline.to_dataset(path)
+        assert ds.count() == 100
+
+    def test_shared_policy_mapping(self, rt):
+        """Both agents mapped to ONE shared policy: trajectories must stay
+        per-agent for GAE (interleaving would corrupt every TD delta), and
+        the shared policy still learns the convention."""
+        algo = (rl.MultiAgentPPOConfig(env="coordination")
+                .env_runners(2)
+                .training(rollout_fragment_length=256, lr=3e-3,
+                          minibatch_size=256, num_epochs=4)
+                .multi_agent(policy_mapping_fn=lambda a: "shared")
+                .build())
+        try:
+            assert set(algo.learners) == {"shared"}
+            for i in range(40):
+                res = algo.train()
+                ret = res["env_runners"]["episode_return_mean"]
+                if ret == ret and ret > 45:
+                    break
+            # random matching is ~21 (64/3); >45 demands a real convention
+            assert ret > 45, f"shared policy failed to learn: {ret}"
+        finally:
+            algo.stop()

@@ -103,3 +103,77 @@ class TestTuner:
             tune_config=tune.TuneConfig(metric="loss", mode="min"),
         ).fit(timeout_s=120)
         assert grid.get_best_result().config["x"] == 2
+
+
+class TestPBT:
+    def test_pbt_exploits_and_converges(self, rt):
+        """Trials with a bad multiplier get cloned from good ones: after
+        fit, the bad trial's FINAL config must carry an exploited (higher)
+        multiplier and its score must ride the donor's checkpoint."""
+        def trainable(config):
+            import time as _t
+
+            state = tune.get_checkpoint() or {"acc": 0.0}
+            acc = state["acc"]
+            for _ in range(30):
+                acc += config["lr"]  # good lr climbs faster
+                tune.report({"score": acc}, checkpoint={"acc": acc})
+                _t.sleep(0.1)  # pace steps so the controller can interleave
+
+        pbt = tune.PopulationBasedTraining(
+            perturbation_interval=4, quantile_fraction=0.25,
+            hyperparam_mutations={"lr": [0.01, 1.0]}, seed=3)
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"lr": tune.grid_search([0.01, 0.01, 1.0, 1.0])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", scheduler=pbt,
+                max_concurrent_trials=4))
+        grid = tuner.fit(timeout_s=300)
+        best = grid.get_best_result()
+        assert best.metrics["score"] > 10  # 20 steps of lr=1.0 territory
+        # every surviving config should have been pulled toward lr=1.0
+        final_lrs = [r.config["lr"] for r in grid if r.error is None]
+        assert sum(1 for lr in final_lrs if lr > 0.5) >= 3
+
+    def test_explore_perturbs_numeric(self):
+        pbt = tune.PopulationBasedTraining(
+            hyperparam_mutations={"lr": [0.1, 0.2]},
+            resample_probability=0.0, seed=0)
+        out = pbt.explore({"lr": 1.0})
+        assert out["lr"] in (0.8, 1.2)
+
+
+class TestRestore:
+    def test_experiment_restore_completes_unfinished(self, rt, tmp_path):
+        """Interrupt an experiment (timeout), restore, finish: completed
+        trials keep results, unfinished resume FROM THEIR CHECKPOINT
+        (reference Tuner.restore)."""
+        def trainable(config):
+            import time as _t
+
+            state = tune.get_checkpoint() or {"i": 0}
+            for i in range(state["i"], 10):
+                tune.report({"score": i + 1, "resumed_from": state["i"]},
+                            checkpoint={"i": i + 1})
+                if config["slow"]:
+                    _t.sleep(0.5)
+
+        storage = str(tmp_path / "exp")
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"slow": tune.grid_search([False, True])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            storage_path=storage)
+        grid1 = tuner.fit(timeout_s=2.5)  # fast trial done, slow cut off
+        by_err = {bool(r.error): r for r in grid1}
+        assert False in by_err  # at least the fast one finished
+
+        restored = tune.Tuner.restore(storage, trainable)
+        grid2 = restored.fit(timeout_s=120)
+        assert len(grid2) == 2
+        assert all(r.error is None for r in grid2)
+        assert all(r.metrics["score"] == 10 for r in grid2)
+        # the slow trial resumed from its checkpoint, not from zero
+        slow = [r for r in grid2 if r.config["slow"]][0]
+        assert slow.metrics["resumed_from"] > 0
