@@ -29,6 +29,7 @@ logger = logging.getLogger(__name__)
 
 _global_lock = threading.RLock()
 _head: Optional[dict] = None  # {"gcs": GcsServer, "raylet": Raylet} when we started them
+_client = None  # ClientContext when connected via ray://
 
 
 def init(
@@ -51,7 +52,7 @@ def init(
     cluster; a submitted job's runtime env becomes the driver's job-level
     default via ``RT_JOB_RUNTIME_ENV``.
     """
-    global _head
+    global _head, _client
     import json as _json
     import os as _os
 
@@ -59,6 +60,30 @@ def init(
 
     if address is None:
         address = _os.environ.get("RT_ADDRESS")
+    if address is not None and address.startswith("ray://"):
+        # Client mode (reference ray://): the driver lives OUTSIDE the
+        # cluster network and speaks only to the head's client server;
+        # a server-side session driver proxies the whole API. runtime_env
+        # ships to the session driver as its job default; node-shape args
+        # are meaningless off-cluster and rejected loudly.
+        unsupported = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                       "resources": resources, "labels": labels,
+                       "system_config": system_config}
+        bad = [k for k, v in unsupported.items() if v]
+        if bad or dashboard:
+            raise ValueError(
+                f"init(address='ray://...') does not accept {bad or ['dashboard']}: "
+                "these configure a NODE; a ray:// client joins no node")
+        from ray_tpu.client.client import ClientContext
+
+        host, _, port = address[len("ray://"):].partition(":")
+        with _global_lock:
+            if _client is not None or CoreWorker._current is not None:
+                raise RuntimeError(
+                    "ray_tpu.init() already called; call shutdown() first")
+            _client = ClientContext(host, int(port),
+                                    runtime_env=runtime_env)
+        return {"client": True, "address": address}
     if runtime_env is None and _os.environ.get("RT_JOB_RUNTIME_ENV"):
         runtime_env = _json.loads(_os.environ["RT_JOB_RUNTIME_ENV"])
     if runtime_env:
@@ -154,10 +179,14 @@ def _shutdown_atexit():
 
 
 def shutdown() -> None:
-    global _head
+    global _head, _client
     from ray_tpu.core_worker.worker import CoreWorker
 
     with _global_lock:
+        if _client is not None:
+            _client.disconnect()
+            _client = None
+            return
         cw = CoreWorker._current
         if cw is not None:
             try:
@@ -180,7 +209,7 @@ def shutdown() -> None:
 def is_initialized() -> bool:
     from ray_tpu.core_worker.worker import CoreWorker
 
-    return CoreWorker._current is not None
+    return CoreWorker._current is not None or _client is not None
 
 
 def _core_worker():
@@ -214,6 +243,13 @@ class RemoteFunction:
     def _invoke(self, args, kwargs, opts):
         import cloudpickle
 
+        if _client is not None:
+            # defined before init("ray://...") (the normal import-time
+            # decorator pattern): dispatch to the client at CALL time
+            from ray_tpu.client.client import ClientRemoteFunction
+
+            return ClientRemoteFunction(
+                self._fn, _client, opts).remote(*args, **kwargs)
         cw = _core_worker()
         if self._serialized is None:
             self._serialized = cloudpickle.dumps(self._fn)
@@ -258,6 +294,20 @@ class _RemoteFunctionOptions:
 def remote(*args, **options):
     """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)`` on functions
     and classes."""
+    if _client is not None:
+        from ray_tpu.client.client import (ClientActorClass,
+                                           ClientRemoteFunction)
+
+        def client_wrap(target):
+            if isinstance(target, type):
+                return ClientActorClass(target, _client, options)
+            return ClientRemoteFunction(target, _client, options)
+
+        if len(args) == 1 and callable(args[0]) and not options:
+            return client_wrap(args[0])
+        if args:
+            raise TypeError("@remote takes keyword options only")
+        return client_wrap
     if len(args) == 1 and callable(args[0]) and not options:
         target = args[0]
         if isinstance(target, type):
@@ -286,11 +336,19 @@ def method(**opts):
 
 # -------------------------------------------------------------------- core ops
 
-def put(value: Any) -> ObjectRef:
+def put(value: Any):
+    if _client is not None:
+        return _client.put(value)
+    return _put_local(value)
+
+
+def _put_local(value: Any) -> ObjectRef:
     return _core_worker().put(value)
 
 
-def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+def get(refs, *, timeout: Optional[float] = None):
+    if _client is not None:
+        return _client.get(refs, timeout=timeout)
     single = isinstance(refs, ObjectRef)
     ref_list = [refs] if single else list(refs)
     for r in ref_list:
@@ -300,14 +358,19 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     return values[0] if single else values
 
 
-def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+def wait(refs, *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
+    if _client is not None:
+        return _client.wait(list(refs), num_returns=num_returns,
+                            timeout=timeout)
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
     return _core_worker().wait(list(refs), num_returns, timeout, fetch_local)
 
 
-def kill(actor: ActorHandle, *, no_restart: bool = True):
+def kill(actor, *, no_restart: bool = True):
+    if _client is not None:
+        return _client.kill(actor, no_restart=no_restart)
     _core_worker().kill_actor(actor._actor_id, no_restart)
 
 
@@ -315,7 +378,9 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     logger.warning("cancel() is best-effort: not yet propagated to executors")
 
 
-def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+def get_actor(name: str, namespace: str = "default"):
+    if _client is not None:
+        return _client.get_actor(name, namespace)
     info = _core_worker().gcs.get_actor_by_name(name, namespace)
     if info is None or info["state"] == "DEAD":
         raise ValueError(f"no alive actor named {name!r}")
@@ -325,6 +390,8 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
 # ----------------------------------------------------------------- inspection
 
 def nodes() -> List[dict]:
+    if _client is not None:
+        return _client.nodes()
     out = []
     for n in _core_worker().gcs.get_all_nodes():
         out.append({
@@ -339,10 +406,14 @@ def nodes() -> List[dict]:
 
 
 def cluster_resources() -> Dict[str, float]:
+    if _client is not None:
+        return _client.cluster_resources()
     return _core_worker().gcs.cluster_resources()["total"]
 
 
 def available_resources() -> Dict[str, float]:
+    if _client is not None:
+        return _client.available_resources()
     return _core_worker().gcs.cluster_resources()["available"]
 
 

@@ -1,0 +1,13 @@
+"""Ray-Client equivalent: drive a cluster from OUTSIDE its network.
+
+Reference: ``python/ray/util/client/`` (``ray://`` — a proxy server on the
+head spawns one server-side driver per client session; the client speaks
+one connection and never needs to be reachable from the cluster).
+
+``ray_tpu.init(address="ray://host:port")`` enters client mode.
+"""
+
+from .client import ClientContext, ClientObjectRef
+from .server import ClientServer
+
+__all__ = ["ClientContext", "ClientObjectRef", "ClientServer"]

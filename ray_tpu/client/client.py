@@ -1,0 +1,247 @@
+"""Thin client — the user-side half of ``ray://``.
+
+Reference: ``python/ray/util/client/worker.py`` (client worker translating
+the ray API onto the wire) + ``api.py`` (client-side handle types). One
+connection to the head's client server; the full framework never loads on
+the client — refs are opaque ids, values cross as pickled blobs.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from ray_tpu.rpc.rpc import RetryableRpcClient
+
+
+class ClientObjectRef:
+    __slots__ = ("_raw", "_ctx", "__weakref__")
+
+    def __init__(self, raw: bytes, ctx: "ClientContext"):
+        self._raw = raw
+        self._ctx = ctx
+
+    def hex(self) -> str:
+        return self._raw.hex()
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._raw.hex()[:16]})"
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and self._raw == other._raw
+
+    def __hash__(self):
+        return hash(self._raw)
+
+    def __del__(self):
+        ctx = self._ctx
+        if ctx is not None and not ctx._closed:
+            ctx._queue_release(self._raw)
+
+
+class _ClientPickler(cloudpickle.CloudPickler):
+    def persistent_id(self, obj):
+        if isinstance(obj, ClientObjectRef):
+            return ("rt_ref", obj._raw)
+        return None
+
+
+class _ClientUnpickler(pickle.Unpickler):
+    def __init__(self, f, ctx: "ClientContext"):
+        super().__init__(f)
+        self._ctx = ctx
+
+    def persistent_load(self, pid):
+        tag, raw = pid
+        if tag != "rt_ref":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+        return ClientObjectRef(raw, self._ctx)
+
+
+class ClientContext:
+    """One connected ``ray://`` session."""
+
+    def __init__(self, host: str, port: int,
+                 runtime_env: Optional[dict] = None):
+        self._closed = False
+        self._proxy = RetryableRpcClient((host, port))
+        self.session_id = f"client-{uuid.uuid4().hex[:12]}"
+        reply = self._proxy.call("new_session", session_id=self.session_id,
+                                 runtime_env=runtime_env, timeout=120.0)
+        if not reply.get("ok"):
+            raise ConnectionError(
+                f"client session failed: {reply.get('error')}")
+        self._session = RetryableRpcClient(tuple(reply["address"]))
+        self._release_buf: List[bytes] = []
+        self._release_lock = threading.Lock()
+        self._hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb.start()
+
+    # ------------------------------------------------------------ plumbing
+    def _dumps(self, value) -> bytes:
+        buf = io.BytesIO()
+        _ClientPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(value)
+        return buf.getvalue()
+
+    def _loads(self, blob: bytes):
+        return _ClientUnpickler(io.BytesIO(blob), self).load()
+
+    def _queue_release(self, raw: bytes):
+        with self._release_lock:
+            self._release_buf.append(raw)
+
+    def _heartbeat_loop(self):
+        while not self._closed:
+            time.sleep(5.0)
+            if self._closed:
+                return
+            with self._release_lock:
+                batch, self._release_buf = self._release_buf, []
+            try:
+                if batch:
+                    self._session.call("release", raw_ids=batch)
+                self._session.call("heartbeat")
+            except Exception:  # noqa: BLE001 - reconnect handled by client
+                pass
+
+    # ------------------------------------------------------------- surface
+    def put(self, value: Any) -> ClientObjectRef:
+        raw = self._session.call("put", blob=self._dumps(value))
+        return ClientObjectRef(raw, self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        reply = self._session.call(
+            "get", raw_ids=[r._raw for r in refs], timeout_s=timeout,
+            timeout=(timeout + 10.0) if timeout else 600.0)
+        if not reply["ok"]:
+            raise self._loads(reply["error"])
+        values = [self._loads(b) for b in reply["values"]]
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int,
+             timeout: Optional[float]):
+        ready_raw = set(self._session.call(
+            "wait", raw_ids=[r._raw for r in refs], num_returns=num_returns,
+            timeout_s=timeout,
+            timeout=(timeout + 10.0) if timeout else 600.0))
+        ready = [r for r in refs if r._raw in ready_raw]
+        not_ready = [r for r in refs if r._raw not in ready_raw]
+        return ready, not_ready
+
+    def submit(self, fn, args, kwargs, opts: dict) -> List[ClientObjectRef]:
+        raws = self._session.call(
+            "submit", fn_blob=cloudpickle.dumps(fn),
+            args_blob=self._dumps((args, kwargs)), opts=opts, timeout=600.0)
+        return [ClientObjectRef(r, self) for r in raws]
+
+    def create_actor(self, cls, args, kwargs, opts: dict) -> "ClientActorHandle":
+        raw = self._session.call(
+            "create_actor", cls_blob=cloudpickle.dumps(cls),
+            args_blob=self._dumps((args, kwargs)), opts=opts, timeout=600.0)
+        methods = [m for m in dir(cls)
+                   if not m.startswith("_") and callable(getattr(cls, m))]
+        return ClientActorHandle(raw, self, methods)
+
+    def actor_call(self, actor_raw: bytes, method: str, args, kwargs,
+                   num_returns: int = 1) -> List[ClientObjectRef]:
+        raws = self._session.call(
+            "actor_call", actor_raw=actor_raw, method_name=method,
+            args_blob=self._dumps((args, kwargs)), num_returns=num_returns,
+            timeout=600.0)
+        return [ClientObjectRef(r, self) for r in raws]
+
+    def kill(self, handle: "ClientActorHandle", no_restart: bool = True):
+        self._session.call("kill_actor", actor_raw=handle._raw,
+                           no_restart=no_restart)
+
+    def get_actor(self, name: str, namespace: str = "default"):
+        raw = self._session.call("get_named_actor", name=name,
+                                 namespace=namespace)
+        if raw is None:
+            raise ValueError(f"no alive actor named {name!r}")
+        return ClientActorHandle(raw, self, [])
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._session.call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._session.call("available_resources")
+
+    def nodes(self) -> List[dict]:
+        return self._session.call("nodes")
+
+    def disconnect(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._proxy.call("end_session", session_id=self.session_id,
+                             timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        self._session.close()
+        self._proxy.close()
+
+
+class ClientRemoteFunction:
+    def __init__(self, fn, ctx: ClientContext, opts: Optional[dict] = None):
+        self._fn = fn
+        self._ctx = ctx
+        self._opts = opts or {}
+
+    def remote(self, *args, **kwargs):
+        num_returns = self._opts.get("num_returns", 1)
+        refs = self._ctx.submit(self._fn, args, kwargs, self._opts)
+        return refs[0] if num_returns == 1 else refs
+
+    def options(self, **opts):
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ClientRemoteFunction(self._fn, self._ctx, merged)
+
+
+class ClientActorClass:
+    def __init__(self, cls, ctx: ClientContext, opts: Optional[dict] = None):
+        self._cls = cls
+        self._ctx = ctx
+        self._opts = opts or {}
+
+    def remote(self, *args, **kwargs) -> "ClientActorHandle":
+        return self._ctx.create_actor(self._cls, args, kwargs, self._opts)
+
+    def options(self, **opts):
+        merged = dict(self._opts)
+        merged.update(opts)
+        return ClientActorClass(self._cls, self._ctx, merged)
+
+
+class ClientActorHandle:
+    def __init__(self, raw: bytes, ctx: ClientContext, methods: List[str]):
+        self._raw = raw
+        self._ctx = ctx
+        self._methods = methods
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientMethod(self, name)
+
+
+class _ClientMethod:
+    def __init__(self, handle: ClientActorHandle, name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        refs = self._handle._ctx.actor_call(
+            self._handle._raw, self._name, args, kwargs)
+        return refs[0]

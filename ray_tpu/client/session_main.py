@@ -1,0 +1,225 @@
+"""Session driver — the server-side driver backing ONE ray:// client.
+
+Reference: the per-client "server-side driver" the proxier spawns
+(``python/ray/util/client/server/server.py`` + proxier). It joins the
+cluster as a normal driver (so tasks/actors it creates belong to its own
+job and die with it) and serves the session RPC surface the thin client
+speaks. ObjectRefs cross the wire as opaque ids via pickle persistent_id —
+nested refs inside arbitrary argument structures round-trip losslessly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core_worker.reference import ObjectRef
+from ray_tpu.rpc.rpc import RpcServer
+
+HEARTBEAT_TIMEOUT_S = 60.0
+
+
+class _RefPickler(cloudpickle.CloudPickler):
+    """Server->client: ObjectRefs become persistent ids."""
+
+    def persistent_id(self, obj):
+        if isinstance(obj, ObjectRef):
+            return ("rt_ref", obj.object_id.binary())
+        return None
+
+
+def _dumps_with_refs(value) -> bytes:
+    buf = io.BytesIO()
+    _RefPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(value)
+    return buf.getvalue()
+
+
+class _RefUnpickler(pickle.Unpickler):
+    """Client->server: persistent ids resolve to live ObjectRefs."""
+
+    def __init__(self, f, refs: Dict[bytes, ObjectRef]):
+        super().__init__(f)
+        self._refs = refs
+
+    def persistent_load(self, pid):
+        tag, raw = pid
+        if tag != "rt_ref":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+        return self._refs[raw]
+
+
+class SessionDriver:
+    def __init__(self):
+        host = os.environ.get("RT_CLIENT_SESSION_HOST", "127.0.0.1")
+        self.server = RpcServer(host, 0)
+        # every ref the client holds is pinned here until released — the
+        # client-side refcount is authoritative (reference client ref
+        # counting), the server keeps the object alive meanwhile
+        self._refs: Dict[bytes, ObjectRef] = {}
+        self._actors: Dict[bytes, ray_tpu.api.ActorHandle] = {}
+        self._fns: Dict[bytes, object] = {}       # fn blob hash -> callable
+        self._last_heartbeat = time.monotonic()
+        for name in ("put", "get", "wait", "submit", "create_actor",
+                     "actor_call", "kill_actor", "get_named_actor",
+                     "release", "cluster_resources", "available_resources",
+                     "nodes", "heartbeat"):
+            self.server.register(name, getattr(self, f"h_{name}"))
+
+    # ------------------------------------------------------------- helpers
+    def _loads(self, blob: bytes):
+        return _RefUnpickler(io.BytesIO(blob), self._refs).load()
+
+    def _track(self, ref: ObjectRef) -> bytes:
+        raw = ref.object_id.binary()
+        self._refs[raw] = ref
+        return raw
+
+    def _fn(self, fn_blob: bytes):
+        # keyed by the blob itself: a 64-bit hash() collision would
+        # silently run the WRONG function
+        fn = self._fns.get(fn_blob)
+        if fn is None:
+            fn = cloudpickle.loads(fn_blob)
+            self._fns[fn_blob] = fn
+        return fn
+
+    # ------------------------------------------------------------ handlers
+    async def h_heartbeat(self):
+        self._last_heartbeat = time.monotonic()
+        return True
+
+    async def h_put(self, blob: bytes):
+        # sync API calls park the shared IO loop on themselves: to_thread
+        ref = await asyncio.to_thread(lambda: ray_tpu.put(self._loads(blob)))
+        return self._track(ref)
+
+    async def h_get(self, raw_ids: List[bytes],
+                    timeout_s: Optional[float]):
+        refs = [self._refs[r] for r in raw_ids]
+
+        def do():
+            try:
+                values = ray_tpu.get(refs, timeout=timeout_s)
+                if len(refs) == 1:
+                    values = [values] if not isinstance(values, list) \
+                        else values
+                return {"ok": True,
+                        "values": [_dumps_with_refs(v) for v in values]}
+            except Exception as e:  # noqa: BLE001
+                return {"ok": False, "error": _dumps_with_refs(e)}
+
+        return await asyncio.to_thread(do)
+
+    async def h_wait(self, raw_ids: List[bytes], num_returns: int,
+                     timeout_s: Optional[float]):
+        refs = [self._refs[r] for r in raw_ids]
+        ready, not_ready = await asyncio.to_thread(
+            ray_tpu.wait, refs, num_returns=num_returns, timeout=timeout_s)
+        ready_set = {r.object_id.binary() for r in ready}
+        return [r for r in raw_ids if r in ready_set]
+
+    async def h_submit(self, fn_blob: bytes, args_blob: bytes, opts: dict):
+        fn = self._fn(fn_blob)
+        args, kwargs = self._loads(args_blob)
+        rf = ray_tpu.remote(fn)
+        if opts:
+            rf = rf.options(**opts)
+
+        def do():
+            out = rf.remote(*args, **kwargs)
+            refs = out if isinstance(out, list) else [out]
+            return [self._track(r) for r in refs]
+
+        return await asyncio.to_thread(do)
+
+    async def h_create_actor(self, cls_blob: bytes, args_blob: bytes,
+                             opts: dict):
+        cls = self._fn(cls_blob)
+        args, kwargs = self._loads(args_blob)
+        ac = ray_tpu.remote(cls)
+        if opts:
+            ac = ac.options(**opts)
+
+        def do():
+            handle = ac.remote(*args, **kwargs)
+            raw = handle._actor_id.binary()
+            self._actors[raw] = handle
+            return raw
+
+        return await asyncio.to_thread(do)
+
+    async def h_actor_call(self, actor_raw: bytes, method_name: str,
+                           args_blob: bytes, num_returns: int):
+        handle = self._actors[actor_raw]
+        args, kwargs = self._loads(args_blob)
+
+        def do():
+            out = getattr(handle, method_name).remote(*args, **kwargs)
+            refs = out if isinstance(out, list) else [out]
+            return [self._track(r) for r in refs]
+
+        return await asyncio.to_thread(do)
+
+    async def h_kill_actor(self, actor_raw: bytes, no_restart: bool):
+        handle = self._actors.get(actor_raw)
+        if handle is None:
+            return False
+        await asyncio.to_thread(ray_tpu.kill, handle, no_restart=no_restart)
+        return True
+
+    async def h_get_named_actor(self, name: str, namespace: str):
+        def do():
+            try:
+                handle = ray_tpu.get_actor(name, namespace)
+            except ValueError:
+                return None
+            raw = handle._actor_id.binary()
+            self._actors[raw] = handle
+            return raw
+
+        return await asyncio.to_thread(do)
+
+    async def h_release(self, raw_ids: List[bytes]):
+        for r in raw_ids:
+            self._refs.pop(r, None)
+        return True
+
+    async def h_cluster_resources(self):
+        return await asyncio.to_thread(ray_tpu.cluster_resources)
+
+    async def h_available_resources(self):
+        return await asyncio.to_thread(ray_tpu.available_resources)
+
+    async def h_nodes(self):
+        nodes = await asyncio.to_thread(ray_tpu.nodes)
+        for n in nodes:
+            if isinstance(n.get("node_id"), bytes):
+                n["node_id"] = n["node_id"].hex()
+        return nodes
+
+    # ---------------------------------------------------------------- main
+    def run(self):
+        ray_tpu.init()  # RT_ADDRESS from the client server
+        self.server.start()
+        host, port = self.server.address
+        print(f"SESSION_READY {host} {port}", flush=True)
+        try:
+            while True:
+                time.sleep(1.0)
+                if time.monotonic() - self._last_heartbeat > \
+                        HEARTBEAT_TIMEOUT_S:
+                    break  # client gone: release the job and exit
+        finally:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    SessionDriver().run()

@@ -145,6 +145,14 @@ _D("object_spilling_dir", str, "", "external storage dir ('' = session dir)")
 _D("max_direct_call_object_size", int, 100 * 1024, "inline-in-RPC threshold bytes")
 _D("memory_store_max_bytes", int, 512 * 1024 * 1024, "in-process store cap")
 
+# --- memory / isolation ------------------------------------------------------
+_D("memory_monitor_enabled", bool, True, "kill workers before kernel OOM")
+_D("memory_usage_threshold", float, 0.95, "node memory fraction that triggers"
+   " the OOM killing policy")
+_D("memory_monitor_refresh_ms", int, 250, "memory usage poll interval")
+_D("cgroup_isolation_enabled", bool, False,
+   "place workers in per-worker cgroups with memory limits")
+
 # --- retries / lineage -------------------------------------------------------
 _D("max_task_retries", int, 3, "default retries for normal tasks")
 _D("actor_max_restarts", int, 0, "default actor restarts")

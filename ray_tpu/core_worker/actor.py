@@ -72,7 +72,14 @@ class ActorClass:
 
         return ClassNode(self, args, kwargs, self._default_options)
 
-    def _create(self, args, kwargs, opts) -> ActorHandle:
+    def _create(self, args, kwargs, opts):
+        from ray_tpu import api as _api
+
+        if _api._client is not None:
+            from ray_tpu.client.client import ClientActorClass
+
+            return ClientActorClass(
+                self._cls, _api._client, opts).remote(*args, **kwargs)
         from .worker import CoreWorker
 
         cw = CoreWorker._current

@@ -21,7 +21,16 @@ def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
 
 
 def block_from_batch(batch: Dict[str, np.ndarray]) -> pa.Table:
-    return pa.table({k: pa.array(np.asarray(v)) for k, v in batch.items()})
+    cols = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if arr.dtype == object or arr.ndim > 1:
+            # ragged / nested columns (lists of token ids, 2-D features):
+            # build from the python values — arrow infers a list type
+            cols[k] = pa.array(list(v))
+        else:
+            cols[k] = pa.array(arr)
+    return pa.table(cols)
 
 
 def block_to_rows(block: pa.Table) -> List[Dict[str, Any]]:

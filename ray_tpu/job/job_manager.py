@@ -183,18 +183,26 @@ class JobManager:
         logfile.close()
         self._procs.pop(info.submission_id, None)
         self._env_agent.release(ctx.env_key)
-        # a stop_job transition wins over the exit-code classification
-        latest = await self._get_info_async(info.submission_id)
-        if latest is not None and latest.status == JobStatus.STOPPED:
-            return
-        info.driver_exit_code = proc.returncode
-        info.end_time = time.time()
-        if proc.returncode == 0:
-            info.status = JobStatus.SUCCEEDED
-        else:
-            info.status = JobStatus.FAILED
-            info.message = f"driver exited with code {proc.returncode}"
-        await self._save_async(info)
+
+        def classify_exit():
+            # read-classify-save under the same lock as stop_job: a
+            # STOPPED marker must never be clobbered by SUCCEEDED/FAILED
+            with self._status_lock(info.submission_id):
+                latest = self.get_job_info(info.submission_id)
+                if latest is not None and \
+                        latest.status == JobStatus.STOPPED:
+                    return
+                info.driver_exit_code = proc.returncode
+                info.end_time = time.time()
+                if proc.returncode == 0:
+                    info.status = JobStatus.SUCCEEDED
+                else:
+                    info.status = JobStatus.FAILED
+                    info.message = \
+                        f"driver exited with code {proc.returncode}"
+                self._save(info)
+
+        await asyncio.to_thread(classify_exit)
 
     # ------------------------------------------------------------------ stop
     def stop_job(self, submission_id: str) -> bool:

@@ -1,0 +1,11 @@
+"""LLM batch + serving entry points.
+
+Reference: ``python/ray/llm/`` — ``ray.data.llm`` batch processors and
+``ray.serve.llm`` deployments. Serving lives in ``ray_tpu.serve.llm``
+(native continuous-batching engine); this package hosts the DATA side:
+offline batch inference pipelines over ``ray_tpu.data`` datasets.
+"""
+
+from .batch import (ByteTokenizer, ProcessorConfig, build_llm_processor)
+
+__all__ = ["ByteTokenizer", "ProcessorConfig", "build_llm_processor"]

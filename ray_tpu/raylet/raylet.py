@@ -107,6 +107,19 @@ class Raylet:
         from ray_tpu.runtime_env.agent import RuntimeEnvAgent
 
         self.runtime_env_agent = RuntimeEnvAgent(self.session_dir)
+        from ray_tpu.raylet.memory_monitor import MemoryMonitor
+
+        self.memory_monitor = MemoryMonitor(
+            GLOBAL_CONFIG.get("memory_usage_threshold"),
+            min_interval_s=GLOBAL_CONFIG.get(
+                "memory_monitor_refresh_ms") / 1000.0)
+        self._oom_kills = 0
+        self.cgroups = None
+        if GLOBAL_CONFIG.get("cgroup_isolation_enabled"):
+            from ray_tpu.raylet.cgroups import CgroupManager
+
+            mgr = CgroupManager(self.node_id.hex())
+            self.cgroups = mgr if mgr.enabled else None
         self._register_handlers()
 
     # ------------------------------------------------------------------ wiring
@@ -182,6 +195,8 @@ class Raylet:
                     w.proc.kill()
         self.gcs.close()
         self.server.stop()
+        if self.cgroups is not None:
+            self.cgroups.cleanup()
         # reclaim this node's shm object-store segment (every raylet owns
         # its node's segment — not just the head; tmpfs leaks are RAM leaks)
         try:
@@ -256,12 +271,17 @@ class Raylet:
             await asyncio.sleep(period)
 
     async def _reap_loop(self):
-        """Detect dead worker processes; free leases; reap idle workers."""
+        """Detect dead worker processes; free leases; reap idle workers;
+        relieve memory pressure (reference memory_monitor.h loop)."""
         idle_ttl = GLOBAL_CONFIG.get("idle_worker_killing_time_threshold_ms") / 1000.0
         while not self._stopped:
             for w in list(self._workers.values()):
                 if w.proc is not None and w.proc.poll() is not None and w.state != "DEAD":
                     await self._on_worker_dead(w, f"exit code {w.proc.returncode}")
+            if GLOBAL_CONFIG.get("memory_monitor_enabled"):
+                pressured, frac = self.memory_monitor.is_pressured()
+                if pressured:
+                    await self._relieve_memory_pressure(frac)
             # reap long-idle workers beyond a small cache
             idle = [w for w in self._workers.values() if w.state == "IDLE"]
             keep = max(2, GLOBAL_CONFIG.get("num_prestart_workers"))
@@ -272,6 +292,43 @@ class Raylet:
                     if now - w.idle_since > idle_ttl:
                         self._kill_worker_proc(w)
             await asyncio.sleep(0.2)
+
+    async def _relieve_memory_pressure(self, frac: float):
+        """Kill one policy-chosen worker per check (reference
+        worker_killing_policy): retriable leased tasks first, newest
+        first — converting an imminent kernel OOM into one attributable,
+        retriable failure."""
+        from ray_tpu.raylet.memory_monitor import pick_victim
+
+        victim = pick_victim(list(self._workers.values()))
+        if victim is None:
+            return
+        self._oom_kills += 1
+        logger.warning(
+            "memory pressure %.1f%% >= %.1f%%: killing worker %s (%s) "
+            "per OOM policy", frac * 100,
+            self.memory_monitor.threshold * 100,
+            victim.worker_id.hex()[:8], victim.state)
+        # kill FIRST, account after: freeing the lease before the hog is
+        # dead would re-grant pending work while pressure is still rising,
+        # and the cgroup can only be removed once its member is gone
+        if victim.proc is not None and victim.proc.poll() is None:
+            victim.proc.kill()
+            import asyncio as _asyncio
+
+            await _asyncio.to_thread(self._wait_proc, victim.proc, 5.0)
+        await self._on_worker_dead(
+            victim,
+            f"killed by the memory monitor: node memory usage "
+            f"{frac:.0%} >= threshold "
+            f"{self.memory_monitor.threshold:.0%}")
+
+    @staticmethod
+    def _wait_proc(proc, timeout: float):
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pass
 
     async def _on_worker_dead(self, w: WorkerHandle, reason: str):
         if w.state == "DEAD":
@@ -294,6 +351,8 @@ class Raylet:
                     pass
         self._workers.pop(w.worker_id, None)
         self.runtime_env_agent.release(w.env_key)
+        if self.cgroups is not None:
+            self.cgroups.remove_worker_cgroup(w.worker_id.hex())
         self._try_grant_pending()
 
     def _kill_worker_proc(self, w: WorkerHandle):
@@ -340,6 +399,10 @@ class Raylet:
         )
         w = WorkerHandle(worker_id=worker_id, proc=proc, env_key=ctx.env_key)
         self.runtime_env_agent.acquire(ctx.env_key)
+        if self.cgroups is not None:
+            cg = self.cgroups.create_worker_cgroup(worker_id.hex())
+            if cg is not None:
+                self.cgroups.attach(cg, proc.pid)
         self._workers[worker_id] = w
         logger.debug("forked worker %s (pid %s)", worker_id.hex()[:8], proc.pid)
         return w
@@ -728,6 +791,7 @@ class Raylet:
                 for pid, bs in self._bundles.items()
             },
             "resources": self.resources.snapshot(),
+            "oom_kills": self._oom_kills,
             "io_stats": dict(self._io.stats),
         }
 

@@ -63,14 +63,24 @@ def cmd_start(args) -> int:
             dash = Dashboard(gcs.address, raylet.session_dir,
                              port=args.dashboard_port)
             dash.start()
+        cserver = None
+        if args.client_server:
+            from ray_tpu.client import ClientServer
+
+            cserver = ClientServer(gcs.address, port=args.client_port)
+            cserver.start()
         _write_pidfile("head")
         print(f"RAY_TPU_HEAD {gcs.address[0]}:{gcs.address[1]}", flush=True)
         if dash is not None:
             print(f"RAY_TPU_DASHBOARD {dash.url}", flush=True)
+        if cserver is not None:
+            print(f"RAY_TPU_CLIENT ray://{cserver.address[0]}:"
+                  f"{cserver.address[1]}", flush=True)
         print("To connect: ray_tpu.init(address="
               f"'{gcs.address[0]}:{gcs.address[1]}')", flush=True)
         _block([lambda: raylet.stop(), lambda: gcs.stop()]
-               + ([lambda: dash.stop()] if dash else []))
+               + ([lambda: dash.stop()] if dash else [])
+               + ([lambda: cserver.stop()] if cserver else []))
         return 0
     if not args.address:
         print("either --head or --address is required", file=sys.stderr)
@@ -190,6 +200,9 @@ def main(argv=None) -> int:
     ps.add_argument("--port", type=int, default=6379)
     ps.add_argument("--dashboard", action="store_true")
     ps.add_argument("--dashboard-port", type=int, default=8265)
+    ps.add_argument("--client-server", action="store_true",
+                    help="serve ray:// client connections")
+    ps.add_argument("--client-port", type=int, default=10001)
     ps.add_argument("--num-cpus", type=int)
     ps.add_argument("--num-tpus", type=int)
     ps.add_argument("--resources", help="JSON dict")

@@ -338,7 +338,7 @@ class Tuner:
         running: Dict[int, dict] = {}   # trial_id -> {actor, config}
         deadline = time.monotonic() + timeout_s
 
-        def launch():
+        def launch() -> int:
             # start the whole wave in parallel: sequential worker spawn
             # (~0.5s each) would stagger trials against the poll loop
             started = []
@@ -351,6 +351,7 @@ class Tuner:
                 steps.setdefault(tid, 0)
             if started:
                 ray_tpu.get(started)
+            return len(started)
 
         def finish(tid, error=None):
             tr = running.pop(tid)
@@ -428,9 +429,8 @@ class Tuner:
                 elif st["status"] == "error":
                     finish(tid, error=st["error"])
                     dirty = True
-            if pending:
+            if launch():
                 dirty = True
-            launch()
             if dirty:  # ~20 Hz poll loop: only persist actual progress
                 self._save_experiment(configs, results, steps, checkpoints,
                                       last_metrics)
