@@ -93,6 +93,16 @@ class TaskArg:
 
 
 @dataclass
+class _FastArgs:
+    """Single-pickle argument bundle for the native actor-call fast path:
+    the whole (args, kwargs) is ONE serialized value instead of one
+    TaskArg frame per argument."""
+
+    args: tuple
+    kwargs: dict
+
+
+@dataclass
 class TaskSpec:
     task_id: TaskID
     job_id: JobID
@@ -133,6 +143,33 @@ class TaskSpec:
 
     def dependencies(self) -> List[ObjectID]:
         return [a.object_id for a in self.args if not a.is_inline and a.object_id is not None]
+
+    @classmethod
+    def from_fast(cls, blob: bytes) -> "TaskSpec":
+        """Rebuild an ACTOR_TASK from a native fastspec buffer (see
+        rpc/native/fastspec.c). Only fields the executee reads are
+        populated; the rest hold cheap defaults."""
+        from ray_tpu.rpc.native import unpack_fastspec
+
+        (task_raw, job_raw, actor_raw, wid_raw, host, method, payload,
+         seq, num_returns, port) = unpack_fastspec(blob)
+        method_s = method.decode()
+        return cls(
+            task_id=TaskID(task_raw),
+            job_id=JobID(job_raw),
+            task_type=TaskType.ACTOR_TASK,
+            function=FunctionDescriptor("", method_s),
+            serialized_func=None,
+            args=[TaskArg.inline(payload)],
+            num_returns=num_returns,
+            required_resources=ResourceRequest({}),
+            actor_id=ActorID(actor_raw),
+            actor_method_name=method_s,
+            sequence_number=seq,
+            caller_worker_id=WorkerID(wid_raw),
+            caller_address=(host.decode(), port),
+            name=method_s,
+        )
 
     def shape_key(self) -> tuple:
         """Lease-pooling key: tasks with the same shape can share leases.

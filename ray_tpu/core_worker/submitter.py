@@ -287,12 +287,34 @@ class ActorTaskSubmitter:
             await asyncio.sleep(0.2)
         self._mark_dead(ActorDiedError(self.actor_id, "timed out resolving actor address"))
 
+    def _encode_spec(self, spec: TaskSpec) -> bytes:
+        """Native submit record when eligible (plain-value args + a loaded
+        codec); pickle otherwise. Packed per push — the resend path
+        renumbers sequence_numbers, so the buffer must not be cached."""
+        payload = getattr(spec, "_fast_payload", None)
+        if payload is not None:
+            from ray_tpu.rpc.native import load_fastspec
+
+            fs = load_fastspec()
+            if fs is not None:
+                host, port = spec.caller_address
+                try:
+                    return fs.pack(
+                        spec.task_id.binary(), spec.job_id.binary(),
+                        spec.actor_id.binary(),
+                        spec.caller_worker_id.binary(), host.encode(),
+                        spec.actor_method_name.encode(), payload,
+                        spec.sequence_number, spec.num_returns, port)
+                except OverflowError:
+                    pass  # >u32 payload: frame it the general way
+        return pickle.dumps(spec)
+
     async def _push(self, spec: TaskSpec):
         client = self._client
         logger.debug("PUSH seq=%d task=%s", spec.sequence_number,
                      spec.task_id.hex()[:8])
         try:
-            reply = await client.call_async("push_task", spec=pickle.dumps(spec), timeout=None)
+            reply = await client.call_async("push_task", spec=self._encode_spec(spec), timeout=None)
         except Exception as e:  # noqa: BLE001 - actor worker died / restarting
             logger.debug("PUSH FAIL seq=%d: %r", spec.sequence_number, e)
             await self._on_connection_failure(e)

@@ -52,6 +52,7 @@ from ray_tpu.common.task_spec import (
     TaskArg,
     TaskSpec,
     TaskType,
+    _FastArgs,
 )
 from ray_tpu.gcs.client import GcsClient
 from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcServer
@@ -556,13 +557,23 @@ class CoreWorker:
         sub = self._actor_submitter(actor_id)
         seq = sub.next_seq()
         task_id = TaskID.for_actor_task(actor_id, self.current_task_id(), self.next_task_index())
+        # Fast path (native submit record): plain-value calls serialize
+        # (args, kwargs) as ONE payload; by-ref args need the TaskArg
+        # handoff protocol and take the general path.
+        fast_payload = None
+        if not any(isinstance(v, ObjectRef) for v in args) and \
+                not any(isinstance(v, ObjectRef) for v in kwargs.values()):
+            fast_payload = self.serialize(_FastArgs(tuple(args), dict(kwargs)))
+            task_args = [TaskArg.inline(fast_payload)]
+        else:
+            task_args = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK,
             function=FunctionDescriptor("", method_name),
             serialized_func=None,
-            args=self._serialize_args(args, kwargs),
+            args=task_args,
             num_returns=num_returns,
             required_resources=ResourceRequest({}),
             actor_id=actor_id,
@@ -572,6 +583,7 @@ class CoreWorker:
             caller_address=self.server.address,
             name=name or method_name,
         )
+        spec._fast_payload = fast_payload
         return self._register_and_submit(spec)
 
     def _actor_submitter(self, actor_id: ActorID) -> ActorTaskSubmitter:
@@ -1082,7 +1094,10 @@ class CoreWorker:
 
     # ------------------------------------------------------------- execution
     async def h_push_task(self, spec: bytes):
-        task: TaskSpec = pickle.loads(spec)
+        if spec[:4] == b"RTFS":
+            task = TaskSpec.from_fast(spec)
+        else:
+            task = pickle.loads(spec)
         # Inherit the task's runtime env as this worker's job-level default:
         # children submitted from inside the task stay in the parent's env
         # (reference: runtime_env parent-to-child inheritance). The worker
@@ -1355,6 +1370,9 @@ class CoreWorker:
                 value = self._get_dependency(arg)
             if isinstance(value, _KwArgsMarker):
                 kwargs = value.kwargs
+            elif isinstance(value, _FastArgs):
+                args.extend(value.args)
+                kwargs.update(value.kwargs)
             else:
                 args.append(value)
         return args, kwargs

@@ -1,0 +1,74 @@
+"""Native (C) codecs for RPC hot paths — built on first import.
+
+The CPython extension is compiled with the system toolchain against this
+interpreter's headers (no pybind11 / pip in this image), same build-on-
+demand pattern as ``object_store/native/shm_store.cc``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import struct
+import subprocess
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_fastspec.so")
+_SRC = os.path.join(_DIR, "fastspec.c")
+_lock = threading.Lock()
+_mod = None
+_FAILED = object()  # build attempted and lost — don't re-run gcc per call
+
+
+def load_fastspec():
+    """Returns the _fastspec extension module (building it if stale), or
+    None when no compiler is available (pure-pickle fallback). A failed
+    build is cached: the hot path must not re-spawn gcc per call."""
+    global _mod
+    if _mod is not None:
+        return None if _mod is _FAILED else _mod
+    with _lock:
+        if _mod is not None:
+            return None if _mod is _FAILED else _mod
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                include = sysconfig.get_paths()["include"]
+                tmp = _SO + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["gcc", "-O2", "-fPIC", "-shared", f"-I{include}",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, _SO)
+            spec = importlib.util.spec_from_file_location("_fastspec", _SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception:  # noqa: BLE001 - no compiler / arch mismatch
+            _mod = _FAILED
+        return None if _mod is _FAILED else _mod
+
+
+def unpack_fastspec(blob: bytes):
+    """Decode a fastspec buffer with the C codec when available, else a
+    pure-Python reader — a receiver without a compiler must still accept
+    fast-path pushes from nodes that have one."""
+    mod = load_fastspec()
+    if mod is not None:
+        return mod.unpack(blob)
+    if len(blob) < 21 or blob[:4] != b"RTFS" or blob[4] != 1:
+        raise ValueError("not a fastspec v1 buffer")
+    seq, num_returns, port = struct.unpack_from("<QII", blob, 5)
+    blobs, off = [], 21
+    for _ in range(7):
+        if off + 4 > len(blob):
+            raise ValueError("truncated fastspec buffer")
+        (ln,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if off + ln > len(blob):
+            raise ValueError("truncated fastspec buffer")
+        blobs.append(blob[off:off + ln])
+        off += ln
+    return (*blobs, seq, num_returns, port)

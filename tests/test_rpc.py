@@ -180,3 +180,44 @@ class TestPubsub:
         assert got == ["obj-A"]
         sub.close()
         s.stop()
+
+
+class TestFastspec:
+    """Native submit-record codec (rpc/native/fastspec.c)."""
+
+    FIELDS = (b"T" * 16, b"J" * 4, b"A" * 12, b"W" * 16, b"10.0.0.7",
+              b"step", b"\x80\x05payload", 2**40 + 7, 300, 50051)
+
+    def test_roundtrip_and_wide_num_returns(self):
+        from ray_tpu.rpc.native import load_fastspec
+
+        fs = load_fastspec()
+        assert fs is not None, "C toolchain present in this image"
+        buf = fs.pack(*self.FIELDS)
+        assert buf[:4] == b"RTFS"
+        out = fs.unpack(buf)
+        assert out == self.FIELDS  # num_returns=300 must not truncate mod 256
+
+    def test_python_fallback_agrees(self, monkeypatch):
+        import ray_tpu.rpc.native as native
+
+        buf = native.load_fastspec().pack(*self.FIELDS)
+        monkeypatch.setattr(native, "load_fastspec", lambda: None)
+        assert native.unpack_fastspec(buf) == self.FIELDS
+
+    def test_from_fast_rebuilds_actor_task(self):
+        import pickle
+
+        from ray_tpu.common.task_spec import TaskSpec, TaskType, _FastArgs
+        from ray_tpu.rpc.native import load_fastspec
+
+        payload = pickle.dumps(_FastArgs((1, 2), {"k": 3}))
+        buf = load_fastspec().pack(b"T" * 24, b"J" * 4, b"A" * 16, b"W" * 16,
+                                   b"10.0.0.7", b"step", payload, 9, 2, 50051)
+        spec = TaskSpec.from_fast(buf)
+        assert spec.task_type == TaskType.ACTOR_TASK
+        assert spec.actor_method_name == "step"
+        assert spec.sequence_number == 9
+        assert spec.num_returns == 2
+        assert spec.caller_address == ("10.0.0.7", 50051)
+        assert pickle.loads(spec.args[0].value).args == (1, 2)
