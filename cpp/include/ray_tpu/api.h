@@ -1,0 +1,431 @@
+// ray_tpu C++ API.
+//
+// The C++ surface of the framework, same role as the reference's
+// cpp/include/ray/api.h: Init/Shutdown, Put/Get/Wait, remote functions
+// (RAY_REMOTE + Task(fn).Remote(...)), C++ actors (RAY_ACTOR /
+// RAY_ACTOR_METHOD + Actor<T>(...).Remote(...)), and cross-language
+// calls into Python (PyTask / PyActor) when connected to a cluster via
+// Init("ray://host:port"). Two modes:
+//
+//   ray_tpu::Init();                    // local mode: in-process execution
+//   ray_tpu::Init("ray://127.0.0.1:10001");  // driver on a live cluster
+//
+// Values crossing task boundaries are plain data (numbers, strings,
+// bytes, vectors, maps) — the same restriction as the reference's
+// msgpack serializer; they surface as native Python objects on the
+// other side.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+class Runtime;
+struct SubmitOptions;
+
+namespace internal {
+Runtime& Rt();                       // throws unless Init() was called
+bool RtAlive();
+void QueueRelease(const std::string& id);
+void RegisterFunction(const std::string& name,
+                      std::function<Value(const ValueList&)> fn,
+                      void* fn_ptr);
+void RegisterActorClass(const std::string& name,
+                        std::function<std::shared_ptr<void>(const ValueList&)> f);
+void RegisterActorMethod(const std::string& name,
+                         std::function<Value(void*, const ValueList&)> m);
+const std::string& FunctionName(void* fn_ptr);
+std::string RtPut(const Value& v);
+Value RtGetRaw(const std::string& id, int timeout_ms);
+std::string RtSubmitCpp(const std::string& name, ValueList args);
+std::string RtSubmitPy(const std::string& mod, const std::string& name,
+                       ValueList args, const SubmitOptions* opts);
+std::string RtCreateCppActor(const std::string& cls, ValueList args,
+                             const SubmitOptions* opts);
+std::string RtCreatePyActor(const std::string& mod, const std::string& cls,
+                            ValueList args, const std::string& name);
+std::string RtActorCall(const std::string& actor_id, const std::string& method,
+                        ValueList args);
+void RtKillActor(const std::string& actor_id);
+std::string RtGetNamedActor(const std::string& name);
+std::vector<std::string> RtWait(const std::vector<std::string>& ids,
+                                int num_returns, int timeout_ms);
+Value RtClusterResources();
+}  // namespace internal
+
+// ------------------------------------------------------- value conversion
+
+template <typename T>
+struct is_vector : std::false_type {};
+template <typename E>
+struct is_vector<std::vector<E>> : std::true_type {};
+template <typename T>
+struct is_str_map : std::false_type {};
+template <typename V>
+struct is_str_map<std::map<std::string, V>> : std::true_type {};
+
+template <typename T>
+Value ToValue(const T& v) {
+  using D = std::decay_t<T>;
+  if constexpr (std::is_same_v<D, Value>) {
+    return v;
+  } else if constexpr (std::is_same_v<D, bool>) {
+    return Value::Bool(v);
+  } else if constexpr (std::is_integral_v<D>) {
+    return Value::Int(static_cast<int64_t>(v));
+  } else if constexpr (std::is_floating_point_v<D>) {
+    return Value::Float(static_cast<double>(v));
+  } else if constexpr (std::is_same_v<D, std::string>) {
+    return Value::Str(v);
+  } else if constexpr (is_vector<D>::value) {
+    ValueList items;
+    items.reserve(v.size());
+    for (const auto& e : v) items.push_back(ToValue(e));
+    return Value::List(std::move(items));
+  } else if constexpr (is_str_map<D>::value) {
+    ValueDict d;
+    for (const auto& kv : v)
+      d.emplace_back(Value::Str(kv.first), ToValue(kv.second));
+    return Value::Dict(std::move(d));
+  } else {
+    static_assert(sizeof(D) == 0,
+                  "unsupported task-boundary type: use plain data "
+                  "(numbers/strings/vectors/maps) or ray_tpu::Value");
+  }
+}
+
+inline Value ToValue(const char* s) { return Value::Str(s); }
+
+template <typename T>
+T FromValue(const Value& v) {
+  using D = std::decay_t<T>;
+  if constexpr (std::is_same_v<D, Value>) {
+    return v;
+  } else if constexpr (std::is_same_v<D, bool>) {
+    return v.as_bool();
+  } else if constexpr (std::is_integral_v<D>) {
+    return static_cast<D>(v.as_int());
+  } else if constexpr (std::is_floating_point_v<D>) {
+    return static_cast<D>(v.as_float());
+  } else if constexpr (std::is_same_v<D, std::string>) {
+    return v.kind() == Value::Kind::Bytes ? v.as_bytes() : v.as_str();
+  } else if constexpr (is_vector<D>::value) {
+    D out;
+    for (const auto& e : v.items())
+      out.push_back(FromValue<typename D::value_type>(e));
+    return out;
+  } else if constexpr (is_str_map<D>::value) {
+    D out;
+    for (const auto& kv : v.dict())
+      out[kv.first.as_str()] = FromValue<typename D::mapped_type>(kv.second);
+    return out;
+  } else {
+    static_assert(sizeof(D) == 0, "unsupported task-boundary type");
+  }
+}
+
+// --------------------------------------------------------------- ObjectRef
+
+template <typename T = Value>
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  explicit ObjectRef(std::string id)
+      : id_(std::shared_ptr<const std::string>(
+            new std::string(std::move(id)), [](const std::string* p) {
+              internal::QueueRelease(*p);  // client-side refcount authority
+              delete p;
+            })) {}
+
+  const std::string& Id() const { return *id_; }
+  bool Valid() const { return id_ != nullptr; }
+
+ private:
+  std::shared_ptr<const std::string> id_;
+};
+
+// ---------------------------------------------------------- init/shutdown
+
+void Init();                          // local mode
+void Init(const std::string& address);  // "ray://host:port"
+void Shutdown();
+bool IsInitialized();
+
+// ------------------------------------------------------------- put/get/wait
+
+template <typename T>
+ObjectRef<std::decay_t<T>> Put(const T& v) {
+  return ObjectRef<std::decay_t<T>>(internal::RtPut(ToValue(v)));
+}
+
+template <typename T>
+T Get(const ObjectRef<T>& ref, int timeout_ms = 0) {
+  return FromValue<T>(internal::RtGetRaw(ref.Id(), timeout_ms));
+}
+
+template <typename T>
+std::vector<T> Get(const std::vector<ObjectRef<T>>& refs, int timeout_ms = 0) {
+  std::vector<T> out;
+  out.reserve(refs.size());
+  for (const auto& r : refs) out.push_back(Get(r, timeout_ms));
+  return out;
+}
+
+// Returns the subset of `refs` that became ready.
+template <typename T>
+std::vector<ObjectRef<T>> Wait(const std::vector<ObjectRef<T>>& refs,
+                               int num_returns, int timeout_ms = 0) {
+  std::vector<std::string> ids;
+  ids.reserve(refs.size());
+  for (const auto& r : refs) ids.push_back(r.Id());
+  auto ready = internal::RtWait(ids, num_returns, timeout_ms);
+  std::vector<ObjectRef<T>> out;
+  for (const auto& r : refs)
+    for (const auto& id : ready)
+      if (r.Id() == id) out.push_back(r);
+  return out;
+}
+
+inline Value ClusterResources() { return internal::RtClusterResources(); }
+
+// ------------------------------------------------------- remote functions
+
+namespace internal {
+
+template <typename R, typename... As, size_t... Is>
+std::function<Value(const ValueList&)> WrapFn(R (*f)(As...),
+                                              std::index_sequence<Is...>) {
+  return [f](const ValueList& args) -> Value {
+    if (args.size() != sizeof...(As))
+      throw std::runtime_error("arity mismatch in remote call");
+    if constexpr (std::is_void_v<R>) {
+      f(FromValue<std::decay_t<As>>(args[Is])...);
+      return Value::None();
+    } else {
+      return ToValue(f(FromValue<std::decay_t<As>>(args[Is])...));
+    }
+  };
+}
+
+template <typename T, typename R, typename... As, size_t... Is>
+std::function<Value(void*, const ValueList&)> WrapMethod(
+    R (T::*m)(As...), std::index_sequence<Is...>) {
+  return [m](void* inst, const ValueList& args) -> Value {
+    if (args.size() != sizeof...(As))
+      throw std::runtime_error("arity mismatch in actor call");
+    T* t = static_cast<T*>(inst);
+    if constexpr (std::is_void_v<R>) {
+      (t->*m)(FromValue<std::decay_t<As>>(args[Is])...);
+      return Value::None();
+    } else {
+      return ToValue((t->*m)(FromValue<std::decay_t<As>>(args[Is])...));
+    }
+  };
+}
+
+template <typename T, typename... CtorArgs, size_t... Is>
+std::function<std::shared_ptr<void>(const ValueList&)> WrapFactory(
+    std::index_sequence<Is...>) {
+  return [](const ValueList& args) -> std::shared_ptr<void> {
+    if (args.size() != sizeof...(CtorArgs))
+      throw std::runtime_error("arity mismatch constructing actor");
+    return std::make_shared<T>(FromValue<std::decay_t<CtorArgs>>(args[Is])...);
+  };
+}
+
+struct FnRegistrar {
+  template <typename R, typename... As>
+  FnRegistrar(const char* name, R (*f)(As...)) {
+    RegisterFunction(name, WrapFn(f, std::index_sequence_for<As...>{}),
+                     reinterpret_cast<void*>(f));
+  }
+};
+
+template <typename T, typename... CtorArgs>
+struct ActorRegistrar {
+  explicit ActorRegistrar(const char* name) {
+    RegisterActorClass(name, WrapFactory<T, CtorArgs...>(
+                                 std::index_sequence_for<CtorArgs...>{}));
+  }
+};
+
+struct MethodRegistrar {
+  template <typename T, typename R, typename... As>
+  MethodRegistrar(const char* name, R (T::*m)(As...)) {
+    RegisterActorMethod(name, WrapMethod(m, std::index_sequence_for<As...>{}));
+  }
+};
+
+}  // namespace internal
+
+template <typename R, typename... As>
+class TaskCaller {
+ public:
+  explicit TaskCaller(R (*f)(As...))
+      : name_(internal::FunctionName(reinterpret_cast<void*>(f))) {}
+
+  template <typename... Args>
+  ObjectRef<R> Remote(Args&&... args) {
+    ValueList vs{ToValue(std::forward<Args>(args))...};
+    return ObjectRef<R>(internal::RtSubmitCpp(name_, std::move(vs)));
+  }
+
+ private:
+  std::string name_;
+};
+
+template <typename R, typename... As>
+TaskCaller<std::decay_t<R>, As...> Task(R (*f)(As...)) {
+  return TaskCaller<std::decay_t<R>, As...>(f);
+}
+
+// Cross-language: Python function by module + name (cluster mode).
+template <typename R = Value>
+class PyTaskCaller {
+ public:
+  PyTaskCaller(std::string module, std::string name)
+      : module_(std::move(module)), name_(std::move(name)) {}
+
+  template <typename... Args>
+  ObjectRef<R> Remote(Args&&... args) {
+    ValueList vs{ToValue(std::forward<Args>(args))...};
+    return ObjectRef<R>(
+        internal::RtSubmitPy(module_, name_, std::move(vs), nullptr));
+  }
+
+ private:
+  std::string module_, name_;
+};
+
+template <typename R = Value>
+PyTaskCaller<R> PyTask(std::string module, std::string name) {
+  return PyTaskCaller<R>(std::move(module), std::move(name));
+}
+
+// ------------------------------------------------------------------ actors
+
+class ActorTaskCaller {
+ public:
+  ActorTaskCaller(std::string actor_id, std::string method)
+      : actor_id_(std::move(actor_id)), method_(std::move(method)) {}
+
+  template <typename R = Value, typename... Args>
+  ObjectRef<R> Remote(Args&&... args) {
+    ValueList vs{ToValue(std::forward<Args>(args))...};
+    return ObjectRef<R>(
+        internal::RtActorCall(actor_id_, method_, std::move(vs)));
+  }
+
+ private:
+  std::string actor_id_, method_;
+};
+
+// Handle to a C++ actor (local mode) — methods addressed as
+// "ClassName.Method" per RAY_ACTOR_METHOD registration.
+template <typename T>
+class ActorHandle {
+ public:
+  ActorHandle(std::string id, std::string cls)
+      : id_(std::move(id)), cls_(std::move(cls)) {}
+
+  ActorTaskCaller Task(const std::string& method) const {
+    return ActorTaskCaller(id_, cls_ + "." + method);
+  }
+  void Kill() const { internal::RtKillActor(id_); }
+  const std::string& Id() const { return id_; }
+
+ private:
+  std::string id_, cls_;
+};
+
+template <typename T>
+class ActorCreator {
+ public:
+  explicit ActorCreator(std::string cls) : cls_(std::move(cls)) {}
+
+  template <typename... Args>
+  ActorHandle<T> Remote(Args&&... args) {
+    ValueList vs{ToValue(std::forward<Args>(args))...};
+    return ActorHandle<T>(
+        internal::RtCreateCppActor(cls_, std::move(vs), nullptr), cls_);
+  }
+
+ private:
+  std::string cls_;
+};
+
+template <typename T>
+ActorCreator<T> Actor(const std::string& registered_class_name) {
+  return ActorCreator<T>(registered_class_name);
+}
+
+// Handle to a Python actor on the cluster (cross-language).
+class PyActorHandle {
+ public:
+  explicit PyActorHandle(std::string id) : id_(std::move(id)) {}
+
+  ActorTaskCaller Task(const std::string& method) const {
+    return ActorTaskCaller(id_, method);
+  }
+  void Kill() const { internal::RtKillActor(id_); }
+  const std::string& Id() const { return id_; }
+
+ private:
+  std::string id_;
+};
+
+class PyActorCreator {
+ public:
+  PyActorCreator(std::string module, std::string qualname)
+      : module_(std::move(module)), qualname_(std::move(qualname)) {}
+
+  PyActorCreator& SetName(std::string name) {
+    name_ = std::move(name);
+    return *this;
+  }
+
+  template <typename... Args>
+  PyActorHandle Remote(Args&&... args);
+
+ private:
+  std::string module_, qualname_, name_;
+};
+
+inline PyActorCreator PyActor(std::string module, std::string qualname) {
+  return PyActorCreator(std::move(module), std::move(qualname));
+}
+
+inline PyActorHandle GetNamedActor(const std::string& name) {
+  return PyActorHandle(internal::RtGetNamedActor(name));
+}
+
+// ------------------------------------------------------------------ macros
+
+#define RAY_REMOTE(fn)                                             \
+  static ::ray_tpu::internal::FnRegistrar _ray_tpu_fn_##fn{#fn, fn};
+
+#define RAY_ACTOR(CLASS, ...)                                      \
+  static ::ray_tpu::internal::ActorRegistrar<CLASS, ##__VA_ARGS__> \
+      _ray_tpu_actor_##CLASS{#CLASS};
+
+#define RAY_ACTOR_METHOD(CLASS, METHOD)                            \
+  static ::ray_tpu::internal::MethodRegistrar                      \
+      _ray_tpu_method_##CLASS##_##METHOD{#CLASS "." #METHOD,       \
+                                         &CLASS::METHOD};
+
+template <typename... Args>
+PyActorHandle PyActorCreator::Remote(Args&&... args) {
+  ValueList vs{ToValue(std::forward<Args>(args))...};
+  return PyActorHandle(
+      internal::RtCreatePyActor(module_, qualname_, std::move(vs), name_));
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,105 @@
+// Dynamic value model for the ray_tpu C++ API.
+//
+// Role of the reference's msgpack-based C++ serialization
+// (cpp/include/ray/api/serializer.h): C++ task args and objects cross
+// the wire in a language-neutral plain-data form. Here that form maps
+// 1:1 onto Python natives (None/bool/int/float/str/bytes/list/tuple/
+// dict), so values written by C++ are ordinary Python objects on the
+// other side and vice versa — cross-language by construction, with the
+// same "plain data only" restriction the reference's msgpack layer has.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ray_tpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::vector<std::pair<Value, Value>>;  // insertion order
+
+class Value {
+ public:
+  enum class Kind {
+    None, Bool, Int, Float, Str, Bytes, List, Tuple, Dict,
+    Ref,     // persistent-id object reference (raw object-id bytes)
+    Opaque,  // unpicklable-here Python object (repr text only)
+  };
+
+  Value() : kind_(Kind::None) {}
+  static Value None() { return Value(); }
+  static Value Bool(bool b) { Value v; v.kind_ = Kind::Bool; v.i_ = b; return v; }
+  static Value Int(int64_t i) { Value v; v.kind_ = Kind::Int; v.i_ = i; return v; }
+  static Value Float(double f) { Value v; v.kind_ = Kind::Float; v.f_ = f; return v; }
+  static Value Str(std::string s) { Value v; v.kind_ = Kind::Str; v.s_ = std::move(s); return v; }
+  static Value Bytes(std::string b) { Value v; v.kind_ = Kind::Bytes; v.s_ = std::move(b); return v; }
+  static Value List(ValueList items) { Value v; v.kind_ = Kind::List; v.items_ = std::move(items); return v; }
+  static Value Tuple(ValueList items) { Value v; v.kind_ = Kind::Tuple; v.items_ = std::move(items); return v; }
+  static Value Dict(ValueDict d) { Value v; v.kind_ = Kind::Dict; v.dict_ = std::move(d); return v; }
+  static Value Ref(std::string raw_id) { Value v; v.kind_ = Kind::Ref; v.s_ = std::move(raw_id); return v; }
+  static Value Opaque(std::string desc) { Value v; v.kind_ = Kind::Opaque; v.s_ = std::move(desc); return v; }
+
+  Kind kind() const { return kind_; }
+  bool is_none() const { return kind_ == Kind::None; }
+
+  bool as_bool() const { check(Kind::Bool); return i_ != 0; }
+  int64_t as_int() const {
+    if (kind_ == Kind::Bool) return i_;
+    check(Kind::Int);
+    return i_;
+  }
+  double as_float() const {
+    if (kind_ == Kind::Int) return static_cast<double>(i_);
+    check(Kind::Float);
+    return f_;
+  }
+  const std::string& as_str() const { check(Kind::Str); return s_; }
+  const std::string& as_bytes() const { check(Kind::Bytes); return s_; }
+  const std::string& ref_id() const { check(Kind::Ref); return s_; }
+  const std::string& opaque_desc() const { check(Kind::Opaque); return s_; }
+  const ValueList& items() const {
+    if (kind_ != Kind::List && kind_ != Kind::Tuple) bad("list/tuple");
+    return items_;
+  }
+  ValueList& items() {
+    if (kind_ != Kind::List && kind_ != Kind::Tuple) bad("list/tuple");
+    return items_;
+  }
+  const ValueDict& dict() const { check(Kind::Dict); return dict_; }
+  ValueDict& dict() { check(Kind::Dict); return dict_; }
+
+  // Dict lookup by string key; returns nullptr when absent.
+  const Value* find(const std::string& key) const {
+    if (kind_ != Kind::Dict) return nullptr;
+    for (const auto& kv : dict_) {
+      if (kv.first.kind() == Kind::Str && kv.first.as_str() == key) return &kv.second;
+    }
+    return nullptr;
+  }
+
+  std::string repr() const;
+
+ private:
+  void check(Kind k) const {
+    if (kind_ != k) bad(kind_name(k));
+  }
+  [[noreturn]] void bad(const char* want) const {
+    throw std::runtime_error(std::string("Value: expected ") + want +
+                             ", held " + kind_name(kind_));
+  }
+  static const char* kind_name(Kind k);
+
+  Kind kind_;
+  int64_t i_ = 0;
+  double f_ = 0.0;
+  std::string s_;
+  ValueList items_;
+  ValueDict dict_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,178 @@
+// Global runtime plumbing behind the public API (reference:
+// cpp/src/ray/api.cc + abstract_ray_runtime.cc).
+#include "ray_tpu/api.h"
+
+#include <mutex>
+#include <stdexcept>
+
+#include "runtime.h"
+
+namespace ray_tpu {
+
+namespace {
+std::unique_ptr<Runtime> g_runtime;
+std::mutex g_mu;
+
+// Function-local static: RAY_REMOTE registrars in other translation
+// units run during static init, before namespace-scope globals here
+// would be constructed.
+std::map<void*, std::string>& FnNames() {
+  static std::map<void*, std::string> m;
+  return m;
+}
+
+// Ref releases batch up and flush every kReleaseBatch: one RPC per
+// batch instead of one blocking round-trip per ObjectRef destructor
+// (the session's h_release takes a list; stragglers are reaped by the
+// session teardown anyway).
+constexpr size_t kReleaseBatch = 64;
+std::vector<std::string>& PendingReleases() {
+  static std::vector<std::string> v;
+  return v;
+}
+}  // namespace
+
+void Init() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_runtime) throw std::runtime_error("ray_tpu::Init called twice");
+  g_runtime = MakeLocalRuntime();
+}
+
+void Init(const std::string& address) {
+  std::string a = address;
+  const std::string scheme = "ray://";
+  if (a.rfind(scheme, 0) == 0) a = a.substr(scheme.size());
+  size_t colon = a.rfind(':');
+  if (colon == std::string::npos)
+    throw std::runtime_error("address must be ray://host:port");
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_runtime) throw std::runtime_error("ray_tpu::Init called twice");
+  g_runtime = MakeClusterRuntime(a.substr(0, colon),
+                                 std::stoi(a.substr(colon + 1)));
+}
+
+void Shutdown() {
+  std::unique_ptr<Runtime> rt;
+  std::vector<std::string> pending;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    rt = std::move(g_runtime);
+    pending.swap(PendingReleases());
+  }
+  if (!rt) return;
+  if (!pending.empty()) {
+    try {
+      rt->Release(pending);
+    } catch (const std::exception&) {
+    }
+  }
+  rt->Shutdown();
+}
+
+bool IsInitialized() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_runtime != nullptr;
+}
+
+namespace internal {
+
+Runtime& Rt() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_runtime) throw std::runtime_error("call ray_tpu::Init() first");
+  return *g_runtime;
+}
+
+bool RtAlive() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_runtime != nullptr;
+}
+
+void QueueRelease(const std::string& id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_runtime) return;
+  auto& pending = PendingReleases();
+  pending.push_back(id);
+  if (pending.size() < kReleaseBatch) return;
+  std::vector<std::string> batch;
+  batch.swap(pending);
+  try {
+    g_runtime->Release(batch);
+  } catch (const std::exception&) {
+  }
+}
+
+void RegisterFunction(const std::string& name,
+                      std::function<Value(const ValueList&)> fn,
+                      void* fn_ptr) {
+  FunctionRegistry::Instance().Register(name, std::move(fn));
+  FnNames()[fn_ptr] = name;
+}
+
+void RegisterActorClass(
+    const std::string& name,
+    std::function<std::shared_ptr<void>(const ValueList&)> f) {
+  ActorRegistry::Instance().RegisterFactory(name, std::move(f));
+}
+
+void RegisterActorMethod(const std::string& name,
+                         std::function<Value(void*, const ValueList&)> m) {
+  ActorRegistry::Instance().RegisterMethod(name, std::move(m));
+}
+
+const std::string& FunctionName(void* fn_ptr) {
+  auto& names = FnNames();
+  auto it = names.find(fn_ptr);
+  if (it == names.end())
+    throw std::runtime_error("function not registered with RAY_REMOTE");
+  return it->second;
+}
+
+std::string RtPut(const Value& v) { return Rt().Put(v); }
+
+Value RtGetRaw(const std::string& id, int timeout_ms) {
+  return Rt().Get(id, timeout_ms);
+}
+
+std::string RtSubmitCpp(const std::string& name, ValueList args) {
+  return Rt().SubmitCpp(name, std::move(args), SubmitOptions{});
+}
+
+std::string RtSubmitPy(const std::string& mod, const std::string& name,
+                       ValueList args, const SubmitOptions* opts) {
+  return Rt().SubmitPy(mod, name, std::move(args),
+                       opts ? *opts : SubmitOptions{});
+}
+
+std::string RtCreateCppActor(const std::string& cls, ValueList args,
+                             const SubmitOptions* opts) {
+  return Rt().CreateCppActor(cls, std::move(args),
+                             opts ? *opts : SubmitOptions{});
+}
+
+std::string RtCreatePyActor(const std::string& mod, const std::string& cls,
+                            ValueList args, const std::string& name) {
+  SubmitOptions opts;
+  opts.name = name;
+  return Rt().CreatePyActor(mod, cls, std::move(args), opts);
+}
+
+std::string RtActorCall(const std::string& actor_id, const std::string& method,
+                        ValueList args) {
+  return Rt().ActorCall(actor_id, method, std::move(args), 1).at(0);
+}
+
+void RtKillActor(const std::string& actor_id) { Rt().KillActor(actor_id); }
+
+std::string RtGetNamedActor(const std::string& name) {
+  return Rt().GetNamedActor(name);
+}
+
+std::vector<std::string> RtWait(const std::vector<std::string>& ids,
+                                int num_returns, int timeout_ms) {
+  return Rt().Wait(ids, num_returns, timeout_ms);
+}
+
+Value RtClusterResources() { return Rt().ClusterResources(); }
+
+}  // namespace internal
+}  // namespace ray_tpu

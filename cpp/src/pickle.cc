@@ -1,0 +1,443 @@
+#include "pickle.h"
+
+#include <cstring>
+#include <sstream>
+
+namespace ray_tpu {
+
+const char* Value::kind_name(Kind k) {
+  switch (k) {
+    case Kind::None: return "None";
+    case Kind::Bool: return "bool";
+    case Kind::Int: return "int";
+    case Kind::Float: return "float";
+    case Kind::Str: return "str";
+    case Kind::Bytes: return "bytes";
+    case Kind::List: return "list";
+    case Kind::Tuple: return "tuple";
+    case Kind::Dict: return "dict";
+    case Kind::Ref: return "ref";
+    case Kind::Opaque: return "object";
+  }
+  return "?";
+}
+
+std::string Value::repr() const {
+  std::ostringstream o;
+  switch (kind_) {
+    case Kind::None: o << "None"; break;
+    case Kind::Bool: o << (i_ ? "True" : "False"); break;
+    case Kind::Int: o << i_; break;
+    case Kind::Float: o << f_; break;
+    case Kind::Str: o << '\'' << s_ << '\''; break;
+    case Kind::Bytes: o << "b<" << s_.size() << " bytes>"; break;
+    case Kind::Ref: o << "ObjectRef(...)"; break;
+    case Kind::Opaque: o << s_; break;
+    case Kind::List:
+    case Kind::Tuple: {
+      o << (kind_ == Kind::List ? '[' : '(');
+      for (size_t i = 0; i < items_.size(); ++i) {
+        if (i) o << ", ";
+        o << items_[i].repr();
+      }
+      o << (kind_ == Kind::List ? ']' : ')');
+      break;
+    }
+    case Kind::Dict: {
+      o << '{';
+      for (size_t i = 0; i < dict_.size(); ++i) {
+        if (i) o << ", ";
+        o << dict_[i].first.repr() << ": " << dict_[i].second.repr();
+      }
+      o << '}';
+      break;
+    }
+  }
+  return o.str();
+}
+
+// ------------------------------------------------------------------ writer
+
+namespace {
+
+void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  b[0] = char(v); b[1] = char(v >> 8); b[2] = char(v >> 16); b[3] = char(v >> 24);
+  out.append(b, 4);
+}
+
+// BINUNICODE must be valid UTF-8 or the Python peer's pickle.loads
+// raises mid-connection with no reply frame. Reject here with a
+// pointed error instead: binary payloads belong in Value::Bytes.
+bool valid_utf8(const std::string& s) {
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    unsigned char c = s[i];
+    size_t extra;
+    if (c < 0x80) { i++; continue; }
+    else if ((c & 0xE0) == 0xC0 && c >= 0xC2) extra = 1;
+    else if ((c & 0xF0) == 0xE0) extra = 2;
+    else if ((c & 0xF8) == 0xF0 && c <= 0xF4) extra = 3;
+    else return false;
+    if (i + extra >= n) return false;
+    for (size_t j = 1; j <= extra; ++j)
+      if ((static_cast<unsigned char>(s[i + j]) & 0xC0) != 0x80) return false;
+    // reject overlong / surrogate / out-of-range encodings
+    unsigned char c1 = s[i + 1];
+    if (c == 0xE0 && c1 < 0xA0) return false;
+    if (c == 0xED && c1 >= 0xA0) return false;
+    if (c == 0xF0 && c1 < 0x90) return false;
+    if (c == 0xF4 && c1 >= 0x90) return false;
+    i += extra + 1;
+  }
+  return true;
+}
+
+void dump(const Value& v, std::string& out) {
+  using K = Value::Kind;
+  switch (v.kind()) {
+    case K::None:
+      out += 'N';
+      break;
+    case K::Bool:
+      out += v.as_bool() ? '\x88' : '\x89';
+      break;
+    case K::Int: {
+      int64_t i = v.as_int();
+      if (i >= INT32_MIN && i <= INT32_MAX) {
+        out += 'J';
+        put_u32(out, static_cast<uint32_t>(static_cast<int32_t>(i)));
+      } else {
+        // LONG1: minimal two's-complement little-endian
+        char bytes[9];
+        int n = 0;
+        uint64_t u = static_cast<uint64_t>(i);
+        for (; n < 8; ++n) bytes[n] = char(u >> (8 * n));
+        n = 8;
+        // trim redundant sign bytes
+        while (n > 1) {
+          unsigned char hi = bytes[n - 1], next = bytes[n - 2];
+          if ((hi == 0x00 && !(next & 0x80)) || (hi == 0xFF && (next & 0x80)))
+            --n;
+          else
+            break;
+        }
+        out += '\x8a';
+        out += char(n);
+        out.append(bytes, n);
+      }
+      break;
+    }
+    case K::Float: {
+      double d = v.as_float();
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      out += 'G';
+      for (int i = 7; i >= 0; --i) out += char(bits >> (8 * i));  // big-endian
+      break;
+    }
+    case K::Str:
+      if (!valid_utf8(v.as_str()))
+        throw std::runtime_error(
+            "non-UTF-8 std::string crossing a task boundary: wrap binary "
+            "data in ray_tpu::Value::Bytes");
+      out += 'X';
+      put_u32(out, static_cast<uint32_t>(v.as_str().size()));
+      out += v.as_str();
+      break;
+    case K::Bytes:
+      out += 'B';
+      put_u32(out, static_cast<uint32_t>(v.as_bytes().size()));
+      out += v.as_bytes();
+      break;
+    case K::List:
+      out += ']';
+      if (!v.items().empty()) {
+        out += '(';
+        for (const auto& it : v.items()) dump(it, out);
+        out += 'e';
+      }
+      break;
+    case K::Tuple: {
+      const auto& it = v.items();
+      if (it.empty()) {
+        out += ')';
+      } else if (it.size() <= 3) {
+        for (const auto& e : it) dump(e, out);
+        out += char(0x84 + it.size());  // TUPLE1/2/3
+      } else {
+        out += '(';
+        for (const auto& e : it) dump(e, out);
+        out += 't';
+      }
+      break;
+    }
+    case K::Dict:
+      out += '}';
+      if (!v.dict().empty()) {
+        out += '(';
+        for (const auto& kv : v.dict()) {
+          dump(kv.first, out);
+          dump(kv.second, out);
+        }
+        out += 'u';
+      }
+      break;
+    case K::Ref: {
+      // persistent id ("rt_ref", raw) + BINPERSID — session protocol refs
+      dump(Value::Tuple({Value::Str("rt_ref"), Value::Bytes(v.ref_id())}), out);
+      out += 'Q';
+      break;
+    }
+    case K::Opaque:
+      throw std::runtime_error("cannot serialize opaque Python object from C++");
+  }
+}
+
+}  // namespace
+
+std::string PickleDumps(const Value& v) {
+  std::string out;
+  out += '\x80';
+  out += '\x03';
+  dump(v, out);
+  out += '.';
+  return out;
+}
+
+// ------------------------------------------------------------------ reader
+
+namespace {
+
+class Reader {
+ public:
+  explicit Reader(const std::string& b) : buf_(b) {}
+
+  Value load() {
+    while (true) {
+      unsigned char op = u8();
+      switch (op) {
+        case 0x80: u8(); break;                       // PROTO
+        case 0x95: skip(8); break;                    // FRAME
+        case '.':                                     // STOP
+          if (stack_.empty()) throw err("empty stack at STOP");
+          return stack_.back();
+        case 'N': push(Value::None()); break;
+        case 0x88: push(Value::Bool(true)); break;    // NEWTRUE
+        case 0x89: push(Value::Bool(false)); break;   // NEWFALSE
+        case 'J': push(Value::Int(static_cast<int32_t>(u32()))); break;
+        case 'K': push(Value::Int(u8())); break;      // BININT1
+        case 'M': push(Value::Int(u16())); break;     // BININT2
+        case 0x8a: push(read_long(u8())); break;      // LONG1
+        case 0x8b: push(read_long(u32())); break;     // LONG4
+        case 'G': {                                   // BINFLOAT (big-endian)
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; ++i) bits = (bits << 8) | u8();
+          double d;
+          std::memcpy(&d, &bits, 8);
+          push(Value::Float(d));
+          break;
+        }
+        case 'X': push(Value::Str(bytes(u32()))); break;        // BINUNICODE
+        case 0x8c: push(Value::Str(bytes(u8()))); break;        // SHORT_BINUNICODE
+        case 0x8d: push(Value::Str(bytes(u64()))); break;       // BINUNICODE8
+        case 'B': push(Value::Bytes(bytes(u32()))); break;      // BINBYTES
+        case 'C': push(Value::Bytes(bytes(u8()))); break;       // SHORT_BINBYTES
+        case 0x8e: push(Value::Bytes(bytes(u64()))); break;     // BINBYTES8
+        case 0x96: push(Value::Bytes(bytes(u64()))); break;     // BYTEARRAY8
+        case ']': push(Value::List({})); break;       // EMPTY_LIST
+        case '}': push(Value::Dict({})); break;       // EMPTY_DICT
+        case ')': push(Value::Tuple({})); break;      // EMPTY_TUPLE
+        case 0x8f: push(Value::List({})); break;      // EMPTY_SET -> list
+        case '(': marks_.push_back(stack_.size()); break;  // MARK
+        case 'a': {                                   // APPEND
+          Value v = pop();
+          top().items().push_back(std::move(v));
+          break;
+        }
+        case 'e': {                                   // APPENDS
+          ValueList vs = pop_to_mark();
+          auto& t = top().items();
+          for (auto& v : vs) t.push_back(std::move(v));
+          break;
+        }
+        case 0x91: {                                  // ADDITEMS (set)
+          ValueList vs = pop_to_mark();
+          auto& t = top().items();
+          for (auto& v : vs) t.push_back(std::move(v));
+          break;
+        }
+        case 0x90: push(Value::List(pop_to_mark())); break;  // FROZENSET
+        case 's': {                                   // SETITEM
+          Value v = pop(), k = pop();
+          top().dict().emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': {                                   // SETITEMS
+          ValueList vs = pop_to_mark();
+          auto& d = top().dict();
+          for (size_t i = 0; i + 1 < vs.size(); i += 2)
+            d.emplace_back(std::move(vs[i]), std::move(vs[i + 1]));
+          break;
+        }
+        case 't': push(Value::Tuple(pop_to_mark())); break;  // TUPLE
+        case 0x85: case 0x86: case 0x87: {            // TUPLE1/2/3
+          size_t n = op - 0x84;
+          ValueList vs(n);
+          for (size_t i = n; i-- > 0;) vs[i] = pop();
+          push(Value::Tuple(std::move(vs)));
+          break;
+        }
+        case 'q': memo_put(u8()); break;              // BINPUT
+        case 'r': memo_put(u32()); break;             // LONG_BINPUT
+        case 0x94: memo_put(static_cast<uint32_t>(memo_.size())); break;  // MEMOIZE
+        case 'h': memo_get(u8()); break;              // BINGET
+        case 'j': memo_get(u32()); break;             // LONG_BINGET
+        case '0': pop(); break;                       // POP
+        case '1': pop_to_mark(); break;               // POP_MARK
+        case '2': push(Value(stack_.back())); break;  // DUP
+        case 'Q': {                                   // BINPERSID
+          Value pid = pop();
+          const auto& t = pid.items();
+          if (t.size() == 2 && t[0].kind() == Value::Kind::Str &&
+              t[0].as_str() == "rt_ref") {
+            push(Value::Ref(t[1].as_bytes()));
+          } else {
+            push(Value::Opaque("persistent:" + pid.repr()));
+          }
+          break;
+        }
+        case 'c': {                                   // GLOBAL
+          std::string mod = line(), name = line();
+          push(Value::Opaque(mod + "." + name));
+          break;
+        }
+        case 0x93: {                                  // STACK_GLOBAL
+          Value name = pop(), mod = pop();
+          push(Value::Opaque(mod.repr() + "." + name.repr()));
+          break;
+        }
+        case 'R': case 0x81: {                        // REDUCE / NEWOBJ
+          Value args = pop(), callee = pop();
+          push(Value::Opaque(desc(callee) + args.repr()));
+          break;
+        }
+        case 0x92: {                                  // NEWOBJ_EX
+          Value kw = pop(), args = pop(), cls = pop();
+          (void)kw;
+          push(Value::Opaque(desc(cls) + args.repr()));
+          break;
+        }
+        case 'b': {                                   // BUILD
+          Value state = pop();
+          Value obj = pop();
+          if (obj.kind() == Value::Kind::Opaque)
+            push(Value::Opaque(obj.opaque_desc() + "#" + state.repr()));
+          else
+            push(std::move(obj));
+          break;
+        }
+        default:
+          throw err("unsupported pickle opcode 0x" + hex(op));
+      }
+    }
+  }
+
+ private:
+  std::runtime_error err(const std::string& m) const {
+    return std::runtime_error("pickle: " + m + " at offset " + std::to_string(pos_));
+  }
+  static std::string hex(unsigned char c) {
+    static const char* d = "0123456789abcdef";
+    return {d[c >> 4], d[c & 15]};
+  }
+  static std::string desc(const Value& v) {
+    return v.kind() == Value::Kind::Opaque ? v.opaque_desc() : v.repr();
+  }
+
+  unsigned char u8() {
+    if (pos_ >= buf_.size()) throw err("truncated");
+    return static_cast<unsigned char>(buf_[pos_++]);
+  }
+  uint16_t u16() { uint16_t v = u8(); return v | (uint16_t(u8()) << 8); }
+  uint32_t u32() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= uint32_t(u8()) << (8 * i);
+    return v;
+  }
+  uint64_t u64() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= uint64_t(u8()) << (8 * i);
+    return v;
+  }
+  void skip(size_t n) {
+    if (pos_ + n > buf_.size()) throw err("truncated");
+    pos_ += n;
+  }
+  std::string bytes(uint64_t n) {
+    if (n > buf_.size() - pos_) throw err("truncated");
+    std::string s = buf_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  std::string line() {
+    size_t nl = buf_.find('\n', pos_);
+    if (nl == std::string::npos) throw err("unterminated line");
+    std::string s = buf_.substr(pos_, nl - pos_);
+    pos_ = nl + 1;
+    return s;
+  }
+  Value read_long(uint32_t n) {  // two's-complement little-endian
+    std::string b = bytes(n);
+    if (n > 8) throw err("LONG too wide for int64");
+    uint64_t u = 0;
+    for (uint32_t i = 0; i < n; ++i)
+      u |= uint64_t(static_cast<unsigned char>(b[i])) << (8 * i);
+    if (n > 0 && n < 8 && (b[n - 1] & 0x80))  // sign-extend
+      u |= ~uint64_t(0) << (8 * n);
+    return Value::Int(static_cast<int64_t>(u));
+  }
+
+  void push(Value v) { stack_.push_back(std::move(v)); }
+  Value pop() {
+    if (stack_.empty()) throw err("stack underflow");
+    Value v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  Value& top() {
+    if (stack_.empty()) throw err("stack underflow");
+    return stack_.back();
+  }
+  ValueList pop_to_mark() {
+    if (marks_.empty()) throw err("no mark");
+    size_t m = marks_.back();
+    marks_.pop_back();
+    if (m > stack_.size()) throw err("bad mark");
+    ValueList vs(std::make_move_iterator(stack_.begin() + m),
+                 std::make_move_iterator(stack_.end()));
+    stack_.resize(m);
+    return vs;
+  }
+  void memo_put(uint32_t idx) {
+    if (stack_.empty()) throw err("memo of empty stack");
+    memo_[idx] = stack_.back();  // aliasing not preserved: plain data only
+  }
+  void memo_get(uint32_t idx) {
+    auto it = memo_.find(idx);
+    if (it == memo_.end()) throw err("memo miss");
+    push(it->second);
+  }
+
+  const std::string& buf_;
+  size_t pos_ = 0;
+  ValueList stack_;
+  std::vector<size_t> marks_;
+  std::map<uint32_t, Value> memo_;
+};
+
+}  // namespace
+
+Value PickleLoads(const std::string& blob) { return Reader(blob).load(); }
+
+}  // namespace ray_tpu

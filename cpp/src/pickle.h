@@ -1,0 +1,26 @@
+// Pickle-subset codec: the C++ side of the runtime's wire envelope.
+//
+// The Python runtime frames RPC messages as pickled dicts
+// (ray_tpu/rpc/rpc.py:_write_frame). This codec writes protocol-3
+// pickles covering the plain-data subset (what the reference's msgpack
+// C++ serializer covers), and reads protocol <=5 pickles, degrading
+// anything outside the subset (class instances, e.g. exceptions inside
+// error replies) to Value::Opaque carrying a printable description.
+#pragma once
+
+#include <string>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+// Serialize a Value as a pickle the Python side loads as native objects.
+// Kind::Ref emits a BINPERSID ("rt_ref", raw) — the ray:// session
+// protocol's persistent-id convention (ray_tpu/client/session_main.py).
+std::string PickleDumps(const Value& v);
+
+// Parse a pickle produced by CPython (protocol <= 5) into a Value.
+// Throws std::runtime_error on malformed input.
+Value PickleLoads(const std::string& blob);
+
+}  // namespace ray_tpu

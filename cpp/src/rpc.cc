@@ -1,0 +1,162 @@
+#include "rpc.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "pickle.h"
+
+namespace ray_tpu {
+
+namespace {
+constexpr uint8_t kFrameReq = 1;
+constexpr uint8_t kFrameResp = 2;
+
+bool read_exact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::write(fd, buf + sent, n - sent);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+}  // namespace
+
+RpcClient::RpcClient(const std::string& host, int port) {
+  struct addrinfo hints {}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
+    throw RpcError("resolve failed: " + host);
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    ::freeaddrinfo(res);
+    if (fd_ >= 0) ::close(fd_);
+    throw RpcError("connect failed: " + host + ":" + port_s);
+  }
+  ::freeaddrinfo(res);
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  reader_ = std::thread([this] { ReaderLoop(); });
+}
+
+RpcClient::~RpcClient() {
+  Close();
+  if (reader_.joinable()) reader_.join();
+}
+
+void RpcClient::Close() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (closed_) return;
+    closed_ = true;
+    if (close_reason_.empty()) close_reason_ = "closed";
+  }
+  ::shutdown(fd_, SHUT_RDWR);
+  cv_.notify_all();
+}
+
+void RpcClient::ReaderLoop() {
+  while (true) {
+    char header[5];
+    if (!read_exact(fd_, header, 5)) break;
+    uint32_t len;
+    std::memcpy(&len, header, 4);  // little-endian hosts only (x86/ARM)
+    uint8_t ftype = static_cast<uint8_t>(header[4]);
+    std::string body(len, '\0');
+    if (!read_exact(fd_, body.data(), len)) break;
+    if (ftype != kFrameResp) continue;
+    Value reply;
+    try {
+      reply = PickleLoads(body);
+    } catch (const std::exception&) {
+      continue;  // unparseable frame: the pending call times out
+    }
+    const Value* id = reply.find("id");
+    if (!id || id->kind() != Value::Kind::Int) continue;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(id->as_int());
+    if (it != pending_.end()) {
+      it->second.reply = std::move(reply);
+      it->second.done = true;
+      cv_.notify_all();
+    }
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  closed_ = true;
+  if (close_reason_.empty()) close_reason_ = "connection lost";
+  cv_.notify_all();
+}
+
+Value RpcClient::Call(const std::string& method, ValueDict kwargs, int timeout_ms) {
+  int64_t id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (closed_) throw RpcError("rpc client " + close_reason_);
+    id = next_id_++;
+    pending_[id];
+  }
+  Value env = Value::Dict({
+      {Value::Str("id"), Value::Int(id)},
+      {Value::Str("method"), Value::Str(method)},
+      {Value::Str("kwargs"), Value::Dict(std::move(kwargs))},
+  });
+  std::string body = PickleDumps(env);
+  std::string frame;
+  frame.reserve(5 + body.size());
+  uint32_t len = static_cast<uint32_t>(body.size());
+  frame.append(reinterpret_cast<const char*>(&len), 4);
+  frame += static_cast<char>(kFrameReq);
+  frame += body;
+  {
+    // serialize writers; write() on a blocking socket can interleave
+    std::lock_guard<std::mutex> lk(mu_);
+    if (closed_ || !write_all(fd_, frame.data(), frame.size())) {
+      pending_.erase(id);
+      throw RpcError("rpc send failed: " + method);
+    }
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  auto ready = [&] { return pending_[id].done || closed_; };
+  if (timeout_ms > 0) {
+    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready)) {
+      pending_.erase(id);
+      throw RpcError("rpc timeout: " + method);
+    }
+  } else {
+    cv_.wait(lk, ready);
+  }
+  auto node = pending_.extract(id);
+  if (!node.mapped().done)
+    throw RpcError("rpc connection lost during " + method);
+  Value reply = std::move(node.mapped().reply);
+  lk.unlock();
+  if (const Value* err = reply.find("error")) {
+    const auto& t = err->items();
+    std::string kind = t.size() > 0 && t[0].kind() == Value::Kind::Str
+                           ? t[0].as_str() : "error";
+    std::string detail = t.size() > 1 ? t[1].repr() : "";
+    throw RpcError("remote " + kind + " in " + method + ": " + detail);
+  }
+  const Value* result = reply.find("result");
+  return result ? *result : Value::None();
+}
+
+}  // namespace ray_tpu

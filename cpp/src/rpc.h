@@ -1,0 +1,55 @@
+// Framed RPC client — C++ peer of ray_tpu/rpc/rpc.py.
+//
+// Wire format (rpc.py:_HEADER): <u32 little-endian payload length, u8
+// frame type> followed by a pickled envelope. Requests are
+// {"id": int, "method": str, "kwargs": dict}; replies {"id", "result"}
+// or {"id", "error": (kind, exception, traceback_str)}.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+class RpcError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class RpcClient {
+ public:
+  RpcClient(const std::string& host, int port);
+  ~RpcClient();
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  // Blocking call; timeout_ms <= 0 means wait forever. Throws RpcError on
+  // transport failure or remote handler error.
+  Value Call(const std::string& method, ValueDict kwargs, int timeout_ms = 0);
+
+  void Close();
+
+ private:
+  void ReaderLoop();
+
+  int fd_ = -1;
+  std::thread reader_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool closed_ = false;
+  std::string close_reason_;
+  int64_t next_id_ = 1;
+  struct Pending {
+    bool done = false;
+    Value reply;
+  };
+  std::map<int64_t, Pending> pending_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,98 @@
+// Runtime backends for the C++ API.
+//
+// Mirrors the reference's split (cpp/src/ray/runtime/
+// local_mode_ray_runtime.cc vs native cluster runtime): LocalRuntime
+// executes everything in-process (thread pool + object table) for
+// development and tests; ClusterRuntime joins a running cluster as a
+// driver over the ray:// client protocol (ray_tpu/client/session_main.py
+// serves the peer side), so C++ drivers get real cluster objects, Python
+// cross-language tasks, and named actors.
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+using TaskFn = std::function<Value(const ValueList&)>;
+
+// C++ remote-function registry (reference: cpp RAY_REMOTE registration,
+// cpp/src/ray/runtime/task/task_executor.cc function lookup by name).
+class FunctionRegistry {
+ public:
+  static FunctionRegistry& Instance();
+  void Register(const std::string& name, TaskFn fn);
+  const TaskFn* Find(const std::string& name) const;
+
+ private:
+  std::vector<std::pair<std::string, TaskFn>> fns_;
+};
+
+// C++ actor registry: type-erased factories ("ClassName" -> instance)
+// and methods ("ClassName.Method" -> call on instance).
+using ActorFactory = std::function<std::shared_ptr<void>(const ValueList&)>;
+using ActorMethod = std::function<Value(void*, const ValueList&)>;
+
+class ActorRegistry {
+ public:
+  static ActorRegistry& Instance();
+  void RegisterFactory(const std::string& name, ActorFactory f);
+  void RegisterMethod(const std::string& name, ActorMethod m);
+  const ActorFactory* FindFactory(const std::string& name) const;
+  const ActorMethod* FindMethod(const std::string& name) const;
+
+ private:
+  std::vector<std::pair<std::string, ActorFactory>> factories_;
+  std::vector<std::pair<std::string, ActorMethod>> methods_;
+};
+
+struct SubmitOptions {
+  int num_returns = 1;
+  std::string name;                                  // actor name (named actors)
+  ValueDict resources;                               // {"CPU": 1.0, "TPU": ...}
+  int max_restarts = 0;
+};
+
+class Runtime {
+ public:
+  virtual ~Runtime() = default;
+
+  virtual std::string Put(const Value& v) = 0;
+  virtual Value Get(const std::string& id, int timeout_ms) = 0;
+  virtual std::vector<Value> GetMany(const std::vector<std::string>& ids,
+                                     int timeout_ms) = 0;
+  virtual std::vector<std::string> Wait(const std::vector<std::string>& ids,
+                                        int num_returns, int timeout_ms) = 0;
+
+  // C++ function by registry name (local mode; cluster mode needs a C++
+  // worker pool — not yet wired).
+  virtual std::string SubmitCpp(const std::string& fn_name, ValueList args,
+                                const SubmitOptions& opts) = 0;
+  // Cross-language: Python function `module.name` (cluster mode).
+  virtual std::string SubmitPy(const std::string& module, const std::string& name,
+                               ValueList args, const SubmitOptions& opts) = 0;
+
+  virtual std::string CreateCppActor(const std::string& factory_name,
+                                     ValueList args, const SubmitOptions& opts) = 0;
+  virtual std::string CreatePyActor(const std::string& module,
+                                    const std::string& qualname, ValueList args,
+                                    const SubmitOptions& opts) = 0;
+  virtual std::vector<std::string> ActorCall(const std::string& actor_id,
+                                             const std::string& method,
+                                             ValueList args, int num_returns) = 0;
+  virtual void KillActor(const std::string& actor_id) = 0;
+  virtual std::string GetNamedActor(const std::string& name) = 0;
+
+  virtual void Release(const std::vector<std::string>& ids) = 0;
+  virtual Value ClusterResources() = 0;
+  virtual void Shutdown() = 0;
+};
+
+std::unique_ptr<Runtime> MakeLocalRuntime();
+std::unique_ptr<Runtime> MakeClusterRuntime(const std::string& host, int port);
+
+}  // namespace ray_tpu

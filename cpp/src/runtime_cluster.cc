@@ -1,0 +1,259 @@
+// Cluster-mode runtime: joins a running cluster as a driver over the
+// ray:// client protocol.
+//
+// Peer: ray_tpu/client/server.py (new_session handshake) and
+// session_main.py (per-session driver serving put/get/wait/submit_named/
+// create_named_actor/actor_call/...). Values cross as pickled plain data
+// (see pickle.h), so C++ args become native Python objects server-side
+// and Python results come back as Values — the same xlang contract as
+// the reference's msgpack layer (cpp/src/ray/runtime/task/
+// task_executor.cc cross-language notes).
+#include <atomic>
+#include <chrono>
+#include <random>
+#include <sstream>
+#include <thread>
+
+#include "pickle.h"
+#include "rpc.h"
+#include "runtime.h"
+
+namespace ray_tpu {
+
+namespace {
+
+std::string HexId() {
+  static std::mt19937_64 rng(std::random_device{}());
+  static const char* d = "0123456789abcdef";
+  std::string s;
+  for (int i = 0; i < 12; ++i) {
+    uint64_t v = rng();
+    s += d[v & 15];
+  }
+  return s;
+}
+
+// Pickle of (args_tuple, kwargs_dict) — the session API's args_blob shape
+// (session_main.py _loads: `args, kwargs = ...`).
+std::string PackArgs(const ValueList& args) {
+  Value pair = Value::Tuple({Value::Tuple(args), Value::Dict({})});
+  return PickleDumps(pair);
+}
+
+ValueDict PackOpts(const SubmitOptions& opts) {
+  ValueDict d;
+  if (opts.num_returns != 1)
+    d.emplace_back(Value::Str("num_returns"), Value::Int(opts.num_returns));
+  if (!opts.name.empty())
+    d.emplace_back(Value::Str("name"), Value::Str(opts.name));
+  if (opts.max_restarts != 0)
+    d.emplace_back(Value::Str("max_restarts"), Value::Int(opts.max_restarts));
+  if (!opts.resources.empty())
+    d.emplace_back(Value::Str("resources"), Value::Dict(opts.resources));
+  return d;
+}
+
+class ClusterRuntime final : public Runtime {
+ public:
+  ClusterRuntime(const std::string& host, int port)
+      : session_id_("cpp-" + HexId()) {
+    proxy_ = std::make_unique<RpcClient>(host, port);
+    Value reply = proxy_->Call(
+        "new_session",
+        {{Value::Str("session_id"), Value::Str(session_id_)},
+         {Value::Str("runtime_env"), Value::None()}},
+        120000);
+    const Value* ok = reply.find("ok");
+    if (!ok || !ok->as_bool()) {
+      const Value* e = reply.find("error");
+      throw RpcError("client session failed: " + (e ? e->repr() : "?"));
+    }
+    const Value* addr = reply.find("address");
+    const auto& hp = addr->items();
+    session_ = std::make_unique<RpcClient>(hp[0].as_str(),
+                                           static_cast<int>(hp[1].as_int()));
+    heartbeat_ = std::thread([this] { HeartbeatLoop(); });
+  }
+
+  ~ClusterRuntime() override { Shutdown(); }
+
+  void Shutdown() override {
+    bool was = stopping_.exchange(true);
+    if (was) return;
+    if (heartbeat_.joinable()) heartbeat_.join();
+    try {
+      // prompt session teardown (the Python thin client does the same,
+      // client.py end_session) instead of the 60 s heartbeat reaper
+      proxy_->Call("end_session",
+                   {{Value::Str("session_id"), Value::Str(session_id_)}}, 10000);
+    } catch (const std::exception&) {
+    }
+    proxy_->Close();
+    session_->Close();
+  }
+
+  std::string Put(const Value& v) override {
+    Value raw = session_->Call(
+        "put", {{Value::Str("blob"), Value::Bytes(PickleDumps(v))}});
+    return raw.as_bytes();
+  }
+
+  Value Get(const std::string& id, int timeout_ms) override {
+    return GetMany({id}, timeout_ms).at(0);
+  }
+
+  std::vector<Value> GetMany(const std::vector<std::string>& ids,
+                             int timeout_ms) override {
+    ValueList raw;
+    raw.reserve(ids.size());
+    for (const auto& id : ids) raw.push_back(Value::Bytes(id));
+    Value reply = session_->Call(
+        "get",
+        {{Value::Str("raw_ids"), Value::List(std::move(raw))},
+         {Value::Str("timeout_s"),
+          timeout_ms > 0 ? Value::Float(timeout_ms / 1000.0) : Value::None()}},
+        timeout_ms > 0 ? timeout_ms + 5000 : 0);
+    const Value* ok = reply.find("ok");
+    if (!ok || !ok->as_bool()) {
+      const Value* e = reply.find("error");
+      std::string detail = "task failed";
+      if (e) {
+        try {
+          detail = PickleLoads(e->as_bytes()).repr();
+        } catch (const std::exception&) {
+        }
+      }
+      throw std::runtime_error(detail);
+    }
+    std::vector<Value> out;
+    for (const auto& blob : reply.find("values")->items())
+      out.push_back(PickleLoads(blob.as_bytes()));
+    return out;
+  }
+
+  std::vector<std::string> Wait(const std::vector<std::string>& ids,
+                                int num_returns, int timeout_ms) override {
+    ValueList raw;
+    for (const auto& id : ids) raw.push_back(Value::Bytes(id));
+    Value ready = session_->Call(
+        "wait",
+        {{Value::Str("raw_ids"), Value::List(std::move(raw))},
+         {Value::Str("num_returns"), Value::Int(num_returns)},
+         {Value::Str("timeout_s"),
+          timeout_ms > 0 ? Value::Float(timeout_ms / 1000.0) : Value::None()}});
+    std::vector<std::string> out;
+    for (const auto& r : ready.items()) out.push_back(r.as_bytes());
+    return out;
+  }
+
+  std::string SubmitCpp(const std::string& fn_name, ValueList,
+                        const SubmitOptions&) override {
+    throw std::runtime_error(
+        "C++ task " + fn_name +
+        " in cluster mode needs a C++ worker pool (run it in local mode, or "
+        "call a Python function with SubmitPy)");
+  }
+
+  std::string SubmitPy(const std::string& module, const std::string& name,
+                       ValueList args, const SubmitOptions& opts) override {
+    Value ids = session_->Call(
+        "submit_named",
+        {{Value::Str("module"), Value::Str(module)},
+         {Value::Str("name"), Value::Str(name)},
+         {Value::Str("args_blob"), Value::Bytes(PackArgs(args))},
+         {Value::Str("opts"), Value::Dict(PackOpts(opts))}});
+    return ids.items().at(0).as_bytes();
+  }
+
+  std::string CreateCppActor(const std::string& class_name, ValueList,
+                             const SubmitOptions&) override {
+    throw std::runtime_error(
+        "C++ actor " + class_name +
+        " in cluster mode needs a C++ worker pool (use local mode, or a "
+        "Python actor with CreatePyActor)");
+  }
+
+  std::string CreatePyActor(const std::string& module,
+                            const std::string& qualname, ValueList args,
+                            const SubmitOptions& opts) override {
+    Value raw = session_->Call(
+        "create_named_actor",
+        {{Value::Str("module"), Value::Str(module)},
+         {Value::Str("qualname"), Value::Str(qualname)},
+         {Value::Str("args_blob"), Value::Bytes(PackArgs(args))},
+         {Value::Str("opts"), Value::Dict(PackOpts(opts))}});
+    return raw.as_bytes();
+  }
+
+  std::vector<std::string> ActorCall(const std::string& actor_id,
+                                     const std::string& method, ValueList args,
+                                     int num_returns) override {
+    Value ids = session_->Call(
+        "actor_call",
+        {{Value::Str("actor_raw"), Value::Bytes(actor_id)},
+         {Value::Str("method_name"), Value::Str(method)},
+         {Value::Str("args_blob"), Value::Bytes(PackArgs(args))},
+         {Value::Str("num_returns"), Value::Int(num_returns)}});
+    std::vector<std::string> out;
+    for (const auto& r : ids.items()) out.push_back(r.as_bytes());
+    return out;
+  }
+
+  void KillActor(const std::string& actor_id) override {
+    session_->Call("kill_actor",
+                   {{Value::Str("actor_raw"), Value::Bytes(actor_id)},
+                    {Value::Str("no_restart"), Value::Bool(true)}});
+  }
+
+  std::string GetNamedActor(const std::string& name) override {
+    Value raw = session_->Call(
+        "get_named_actor", {{Value::Str("name"), Value::Str(name)},
+                            {Value::Str("namespace"), Value::None()}});
+    if (raw.is_none()) throw std::runtime_error("no actor named " + name);
+    return raw.as_bytes();
+  }
+
+  void Release(const std::vector<std::string>& ids) override {
+    ValueList raw;
+    for (const auto& id : ids) raw.push_back(Value::Bytes(id));
+    try {
+      session_->Call("release", {{Value::Str("raw_ids"), Value::List(std::move(raw))}});
+    } catch (const std::exception&) {
+      // releases are best-effort; the session reaps on disconnect anyway
+    }
+  }
+
+  Value ClusterResources() override {
+    return session_->Call("cluster_resources", {});
+  }
+
+ private:
+  void HeartbeatLoop() {
+    // session_main.py HEARTBEAT_TIMEOUT_S = 60: ping well inside it
+    int ticks = 0;
+    while (!stopping_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      if (++ticks < 50) continue;  // ~10 s between pings, 200 ms stop latency
+      ticks = 0;
+      try {
+        session_->Call("heartbeat", {}, 15000);
+      } catch (const std::exception&) {
+        if (!stopping_.load()) continue;  // transient; retry next tick
+      }
+    }
+  }
+
+  std::string session_id_;
+  std::unique_ptr<RpcClient> proxy_;
+  std::unique_ptr<RpcClient> session_;
+  std::thread heartbeat_;
+  std::atomic<bool> stopping_{false};
+};
+
+}  // namespace
+
+std::unique_ptr<Runtime> MakeClusterRuntime(const std::string& host, int port) {
+  return std::make_unique<ClusterRuntime>(host, port);
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,318 @@
+// Local-mode runtime: the whole API surface in one process.
+//
+// Reference parity: cpp/src/ray/runtime/local_mode_ray_runtime.cc —
+// tasks run on a small thread pool, objects live in an in-process
+// table, actors are heap objects with one mutex each (actor calls keep
+// their sequential semantics).
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <thread>
+
+#include "runtime.h"
+
+namespace ray_tpu {
+
+FunctionRegistry& FunctionRegistry::Instance() {
+  static FunctionRegistry r;
+  return r;
+}
+
+void FunctionRegistry::Register(const std::string& name, TaskFn fn) {
+  fns_.emplace_back(name, std::move(fn));
+}
+
+const TaskFn* FunctionRegistry::Find(const std::string& name) const {
+  for (const auto& p : fns_)
+    if (p.first == name) return &p.second;
+  return nullptr;
+}
+
+ActorRegistry& ActorRegistry::Instance() {
+  static ActorRegistry r;
+  return r;
+}
+
+void ActorRegistry::RegisterFactory(const std::string& name, ActorFactory f) {
+  factories_.emplace_back(name, std::move(f));
+}
+
+void ActorRegistry::RegisterMethod(const std::string& name, ActorMethod m) {
+  methods_.emplace_back(name, std::move(m));
+}
+
+const ActorFactory* ActorRegistry::FindFactory(const std::string& name) const {
+  for (const auto& p : factories_)
+    if (p.first == name) return &p.second;
+  return nullptr;
+}
+
+const ActorMethod* ActorRegistry::FindMethod(const std::string& name) const {
+  for (const auto& p : methods_)
+    if (p.first == name) return &p.second;
+  return nullptr;
+}
+
+namespace {
+
+std::string RandomId() {
+  static std::atomic<uint64_t> counter{0};
+  static std::mt19937_64 rng(std::random_device{}());
+  uint64_t a = rng(), b = counter.fetch_add(1);
+  std::string id(16, '\0');
+  std::memcpy(id.data(), &a, 8);
+  std::memcpy(id.data() + 8, &b, 8);
+  return id;
+}
+
+class LocalRuntime final : public Runtime {
+ public:
+  LocalRuntime() {
+    unsigned n = std::max(2u, std::thread::hardware_concurrency());
+    for (unsigned i = 0; i < n; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~LocalRuntime() override { Shutdown(); }
+
+  void Shutdown() override {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopping_) return;
+      stopping_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  std::string Put(const Value& v) override {
+    std::string id = RandomId();
+    std::lock_guard<std::mutex> lk(mu_);
+    objects_[id] = {true, v, ""};
+    return id;
+  }
+
+  Value Get(const std::string& id, int timeout_ms) override {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [&] {
+      auto it = objects_.find(id);
+      return it != objects_.end() && it->second.ready;
+    };
+    if (timeout_ms > 0) {
+      if (!obj_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready))
+        throw std::runtime_error("Get timed out");
+    } else {
+      obj_cv_.wait(lk, ready);
+    }
+    const auto& slot = objects_[id];
+    if (!slot.error.empty()) throw std::runtime_error("task failed: " + slot.error);
+    return slot.value;
+  }
+
+  std::vector<Value> GetMany(const std::vector<std::string>& ids,
+                             int timeout_ms) override {
+    std::vector<Value> out;
+    out.reserve(ids.size());
+    for (const auto& id : ids) out.push_back(Get(id, timeout_ms));
+    return out;
+  }
+
+  std::vector<std::string> Wait(const std::vector<std::string>& ids,
+                                int num_returns, int timeout_ms) override {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1 << 30);
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      std::vector<std::string> ready;
+      for (const auto& id : ids) {
+        auto it = objects_.find(id);
+        if (it != objects_.end() && it->second.ready) ready.push_back(id);
+      }
+      if (static_cast<int>(ready.size()) >= num_returns ||
+          std::chrono::steady_clock::now() >= deadline)
+        return ready;
+      obj_cv_.wait_until(lk, deadline);
+    }
+  }
+
+  std::string SubmitCpp(const std::string& fn_name, ValueList args,
+                        const SubmitOptions&) override {
+    const TaskFn* fn = FunctionRegistry::Instance().Find(fn_name);
+    if (!fn) throw std::runtime_error("no registered C++ function: " + fn_name);
+    std::string id = RandomId();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      objects_[id] = {false, Value::None(), ""};
+      queue_.push_back([this, id, fn, args = std::move(args)] {
+        RunTask(id, [&] { return (*fn)(args); });
+      });
+    }
+    cv_.notify_one();
+    return id;
+  }
+
+  std::string SubmitPy(const std::string&, const std::string&, ValueList,
+                       const SubmitOptions&) override {
+    throw std::runtime_error("Python tasks need cluster mode: ray_tpu::Init(\"ray://...\")");
+  }
+
+  std::string CreateCppActor(const std::string& class_name, ValueList args,
+                             const SubmitOptions& opts) override {
+    const ActorFactory* f = ActorRegistry::Instance().FindFactory(class_name);
+    if (!f) throw std::runtime_error("no registered actor class: " + class_name);
+    auto slot = std::make_shared<ActorSlot>();
+    slot->instance = (*f)(args);
+    std::string id = RandomId();
+    std::lock_guard<std::mutex> lk(mu_);
+    actors_[id] = std::move(slot);
+    if (!opts.name.empty()) named_actors_[opts.name] = id;
+    return id;
+  }
+
+  std::string CreatePyActor(const std::string&, const std::string&, ValueList,
+                            const SubmitOptions&) override {
+    throw std::runtime_error("Python actors need cluster mode: ray_tpu::Init(\"ray://...\")");
+  }
+
+  std::vector<std::string> ActorCall(const std::string& actor_id,
+                                     const std::string& method, ValueList args,
+                                     int num_returns) override {
+    const ActorMethod* fn = ActorRegistry::Instance().FindMethod(method);
+    if (!fn) throw std::runtime_error("no registered actor method: " + method);
+    std::shared_ptr<ActorSlot> slot;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = actors_.find(actor_id);
+      if (it == actors_.end()) throw std::runtime_error("dead actor");
+      slot = it->second;
+    }
+    std::string id = RandomId();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      objects_[id] = {false, Value::None(), ""};
+    }
+    // Per-actor call queue keeps calls sequential WITHOUT parking a pool
+    // worker on a mutex (two calls to one actor must not eat two
+    // workers, or actors that submit-and-Get subtasks starve the pool).
+    bool start_pump;
+    {
+      std::lock_guard<std::mutex> alk(slot->qmu);
+      slot->calls.push_back([this, id, fn, slot, args = std::move(args)] {
+        RunTask(id, [&] { return (*fn)(slot->instance.get(), args); });
+      });
+      start_pump = !slot->pumping;
+      slot->pumping = true;
+    }
+    if (start_pump) SchedulePump(slot);
+    (void)num_returns;
+    return {id};
+  }
+
+  void KillActor(const std::string& actor_id) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    actors_.erase(actor_id);
+  }
+
+  std::string GetNamedActor(const std::string& name) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = named_actors_.find(name);
+    if (it == named_actors_.end()) throw std::runtime_error("no actor named " + name);
+    return it->second;
+  }
+
+  void Release(const std::vector<std::string>& ids) override {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& id : ids) objects_.erase(id);
+  }
+
+  Value ClusterResources() override {
+    return Value::Dict({{Value::Str("CPU"),
+                         Value::Float(std::thread::hardware_concurrency())}});
+  }
+
+ private:
+  struct ObjectSlot {
+    bool ready;
+    Value value;
+    std::string error;
+  };
+  struct ActorSlot {
+    std::shared_ptr<void> instance;
+    std::mutex qmu;
+    std::deque<std::function<void()>> calls;
+    bool pumping = false;
+  };
+
+  void SchedulePump(std::shared_ptr<ActorSlot> slot) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.push_back([this, slot] {
+        std::function<void()> call;
+        {
+          std::lock_guard<std::mutex> alk(slot->qmu);
+          call = std::move(slot->calls.front());
+          slot->calls.pop_front();
+        }
+        call();  // one call at a time: actor semantics
+        bool more;
+        {
+          std::lock_guard<std::mutex> alk(slot->qmu);
+          more = !slot->calls.empty();
+          slot->pumping = more;
+        }
+        if (more) SchedulePump(slot);
+      });
+    }
+    cv_.notify_one();
+  }
+
+  template <typename F>
+  void RunTask(const std::string& id, F&& body) {
+    Value out;
+    std::string error;
+    try {
+      out = body();
+    } catch (const std::exception& e) {
+      error = e.what();
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    objects_[id] = {true, std::move(out), std::move(error)};
+    obj_cv_.notify_all();
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      std::function<void()> job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stopping_ || !queue_.empty(); });
+        if (stopping_ && queue_.empty()) return;
+        job = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      job();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, obj_cv_;
+  bool stopping_ = false;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> workers_;
+  std::map<std::string, ObjectSlot> objects_;
+  std::map<std::string, std::shared_ptr<ActorSlot>> actors_;
+  std::map<std::string, std::string> named_actors_;
+};
+
+}  // namespace
+
+std::unique_ptr<Runtime> MakeLocalRuntime() {
+  return std::make_unique<LocalRuntime>();
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,72 @@
+// Cross-language driver: a C++ process joins a live cluster via
+// ray://, puts/gets cluster objects, calls Python functions, and
+// drives a Python actor (reference: cpp xlang tests,
+// cpp/src/ray/test/cluster/cluster_mode_xlang_test.cc).
+//
+// Usage: driver_xlang <host> <port>   (the head's client-server port)
+// Prints XLANG-OK and exits 0 on success.
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/api.h"
+
+#define CHECK(cond)                                             \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,        \
+                   __LINE__, #cond);                            \
+      return 1;                                                 \
+    }                                                           \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: driver_xlang <host> <port>\n");
+    return 2;
+  }
+  ray_tpu::Init("ray://" + std::string(argv[1]) + ":" + argv[2]);
+
+  // cluster objects round-trip (C++ -> Python pickle -> C++)
+  auto ref = ray_tpu::Put(std::vector<int>{1, 2, 3});
+  auto back = ray_tpu::Get(ref, 30000);
+  CHECK(back.size() == 3 && back[2] == 3);
+
+  auto sref = ray_tpu::Put(std::map<std::string, double>{{"pi", 3.25}});
+  CHECK(ray_tpu::Get(sref, 30000)["pi"] == 3.25);
+
+  // xlang: call Python stdlib functions from C++
+  auto len = ray_tpu::PyTask<int64_t>("builtins", "len").Remote("hello");
+  CHECK(ray_tpu::Get(len, 60000) == 5);
+  auto sq = ray_tpu::PyTask<double>("math", "sqrt").Remote(16.0);
+  CHECK(ray_tpu::Get(sq, 60000) == 4.0);
+
+  // xlang: Python actor driven from C++ (test helper class)
+  auto actor = ray_tpu::PyActor("tests.xlang_helpers", "Accumulator").Remote(10);
+  auto a1 = actor.Task("add").Remote<int64_t>(5);
+  CHECK(ray_tpu::Get(a1, 60000) == 15);
+  auto a2 = actor.Task("add").Remote<int64_t>(7);
+  CHECK(ray_tpu::Get(a2, 60000) == 22);
+  auto total = actor.Task("total").Remote<int64_t>();
+  CHECK(ray_tpu::Get(total, 60000) == 22);
+
+  // named actors resolve cluster-wide (default namespace)
+  ray_tpu::PyActor("tests.xlang_helpers", "Accumulator")
+      .SetName("xlang-acc")
+      .Remote(100);
+  auto found = ray_tpu::GetNamedActor("xlang-acc");
+  auto ft = found.Task("total").Remote<int64_t>();
+  CHECK(ray_tpu::Get(ft, 60000) == 100);
+
+  // wait over cluster refs
+  std::vector<ray_tpu::ObjectRef<double>> refs;
+  for (int i = 0; i < 4; ++i)
+    refs.push_back(ray_tpu::PyTask<double>("math", "sqrt").Remote(i * 1.0));
+  auto ready = ray_tpu::Wait(refs, 4, 60000);
+  CHECK(ready.size() == 4);
+
+  ray_tpu::Shutdown();
+  std::printf("XLANG-OK\n");
+  return 0;
+}

@@ -67,7 +67,8 @@ class SessionDriver:
         self._actors: Dict[bytes, ray_tpu.api.ActorHandle] = {}
         self._fns: Dict[bytes, object] = {}       # fn blob hash -> callable
         self._last_heartbeat = time.monotonic()
-        for name in ("put", "get", "wait", "submit", "create_actor",
+        for name in ("put", "get", "wait", "submit", "submit_named",
+                     "create_actor", "create_named_actor",
                      "actor_call", "kill_actor", "get_named_actor",
                      "release", "cluster_resources", "available_resources",
                      "nodes", "heartbeat"):
@@ -126,8 +127,7 @@ class SessionDriver:
         ready_set = {r.object_id.binary() for r in ready}
         return [r for r in raw_ids if r in ready_set]
 
-    async def h_submit(self, fn_blob: bytes, args_blob: bytes, opts: dict):
-        fn = self._fn(fn_blob)
+    async def _do_submit(self, fn, args_blob: bytes, opts: dict):
         args, kwargs = self._loads(args_blob)
         rf = ray_tpu.remote(fn)
         if opts:
@@ -140,9 +140,7 @@ class SessionDriver:
 
         return await asyncio.to_thread(do)
 
-    async def h_create_actor(self, cls_blob: bytes, args_blob: bytes,
-                             opts: dict):
-        cls = self._fn(cls_blob)
+    async def _do_create_actor(self, cls, args_blob: bytes, opts: dict):
         args, kwargs = self._loads(args_blob)
         ac = ray_tpu.remote(cls)
         if opts:
@@ -155,6 +153,36 @@ class SessionDriver:
             return raw
 
         return await asyncio.to_thread(do)
+
+    async def h_submit(self, fn_blob: bytes, args_blob: bytes, opts: dict):
+        return await self._do_submit(self._fn(fn_blob), args_blob, opts)
+
+    def _import_obj(self, module: str, qualname: str):
+        """Resolve ``module`` + dotted ``qualname`` to a live object —
+        the xlang function-descriptor path: non-Python drivers (cpp/
+        include/ray_tpu/api.h PyTask/PyActor) name functions instead of
+        shipping cloudpickle blobs (reference: cross-language function
+        descriptors, SURVEY §2.5)."""
+        import importlib
+
+        obj = importlib.import_module(module)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    async def h_submit_named(self, module: str, name: str,
+                             args_blob: bytes, opts: dict):
+        return await self._do_submit(self._import_obj(module, name),
+                                     args_blob, opts)
+
+    async def h_create_named_actor(self, module: str, qualname: str,
+                                   args_blob: bytes, opts: dict):
+        return await self._do_create_actor(self._import_obj(module, qualname),
+                                           args_blob, opts)
+
+    async def h_create_actor(self, cls_blob: bytes, args_blob: bytes,
+                             opts: dict):
+        return await self._do_create_actor(self._fn(cls_blob), args_blob, opts)
 
     async def h_actor_call(self, actor_raw: bytes, method_name: str,
                            args_blob: bytes, num_returns: int):
@@ -178,7 +206,7 @@ class SessionDriver:
     async def h_get_named_actor(self, name: str, namespace: str):
         def do():
             try:
-                handle = ray_tpu.get_actor(name, namespace)
+                handle = ray_tpu.get_actor(name, namespace or "default")
             except ValueError:
                 return None
             raw = handle._actor_id.binary()

@@ -1,0 +1,52 @@
+"""C++ public API (cpp/ — reference parity: cpp/include/ray/api.h).
+
+Local mode runs entirely in the C++ process; cluster mode drives a live
+cluster over ray:// from a C++ driver, including cross-language Python
+tasks and actors (cpp/test/driver_xlang.cc).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from ray_tpu.client import ClientServer
+from ray_tpu.cluster_utils import Cluster
+
+CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "cpp")
+
+
+@pytest.fixture(scope="module")
+def cpp_build():
+    r = subprocess.run(["make", "-C", CPP_DIR], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, f"cpp build failed:\n{r.stdout}\n{r.stderr}"
+    return os.path.join(CPP_DIR, "build")
+
+
+def test_cpp_local_mode(cpp_build):
+    r = subprocess.run([os.path.join(cpp_build, "test_local")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LOCAL-OK" in r.stdout
+
+
+def test_cpp_cluster_xlang(cpp_build):
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    server = ClientServer(c.gcs.address)
+    server.start()
+    try:
+        host, port = server.address
+        env = dict(os.environ)
+        # session drivers import tests.xlang_helpers from the repo root
+        env["PYTHONPATH"] = os.path.dirname(CPP_DIR) + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [os.path.join(cpp_build, "driver_xlang"), host, str(port)],
+            capture_output=True, text=True, timeout=180, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "XLANG-OK" in r.stdout
+    finally:
+        server.stop()
+        c.shutdown()

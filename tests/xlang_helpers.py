@@ -1,0 +1,15 @@
+"""Python classes driven from the C++ API in cross-language tests
+(cpp/test/driver_xlang.cc). Must be importable on the cluster
+(PYTHONPATH includes the repo root)."""
+
+
+class Accumulator:
+    def __init__(self, start=0):
+        self.n = start
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+    def total(self):
+        return self.n
