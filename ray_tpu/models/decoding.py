@@ -198,6 +198,36 @@ def make_prefill(params: Params, config: LlamaConfig):
     return call
 
 
+def make_inject(config: LlamaConfig):
+    """Build the jitted KV-injection step: write an externally computed
+    prompt KV (from a prefill replica or a prefix cache) into one slot.
+
+    This is the TPU-native KV-transfer half of prefill/decode
+    disaggregation (reference: python/ray/llm/_internal/serve/
+    deployments/prefill_decode_disagg/ — there vLLM moves KV via
+    NIXL/NCCL; here KV rides the object plane as arrays and lands in the
+    slot cache with one dynamic_update_slice per array).
+
+    inject(cache, k, v, true_len, slot) → cache
+        k, v: (layers, P, kv_heads, head_dim) padded to a bucket; rows
+        at or beyond true_len must be zero (prefill masks them).
+    """
+    del config
+
+    def inject(cache: Cache, k: jax.Array, v: jax.Array,
+               true_len: jax.Array, slot: jax.Array):
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"], k[:, None].astype(cache["k"].dtype),
+            (0, slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"], v[:, None].astype(cache["v"].dtype),
+            (0, slot, 0, 0, 0))
+        new_len = cache["length"].at[slot].set(true_len)
+        return {"k": kc, "v": vc, "length": new_len}
+
+    return jax.jit(inject, donate_argnums=(0,))
+
+
 def pad_to_bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048)) -> int:
     for b in buckets:
         if n <= b:

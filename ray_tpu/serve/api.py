@@ -56,7 +56,7 @@ def run(app: Application, *, name: Optional[str] = None,
     # wait for at least one replica
     deadline = time.monotonic() + wait_timeout_s
     while True:
-        _, replicas, _ = ray_tpu.get(
+        _, replicas, *_ = ray_tpu.get(
             [controller.get_replicas.remote(app_name)])[0]
         if replicas:
             break

@@ -131,13 +131,15 @@ class ServeController:
 
     # ------------------------------------------------------------- queries
     def get_replicas(self, name: str):
-        """(version, replica handles, max_ongoing) for handle routing."""
+        """(version, replica handles, max_ongoing, router) for handle
+        routing."""
         with self._lock:
             app = self._apps.get(name)
             if app is None:
                 raise KeyError(f"no deployment named {name!r}")
             return (self._version, list(app["replicas"]),
-                    app["deployment"].max_ongoing_requests)
+                    app["deployment"].max_ongoing_requests,
+                    getattr(app["deployment"], "request_router", "pow2"))
 
     def get_route_table(self):
         """(version, {route_prefix: app_name}) for the ingress proxies."""

@@ -33,6 +33,11 @@ class Deployment:
     # HTTP ingress mount point (reference: Deployment.route_prefix);
     # None → "/<name>" at serve.run time.
     route_prefix: Optional[str] = None
+    # "pow2" (power-of-two-choices) or "prefix_aware": requests whose
+    # first argument shares a prefix route to the same replica so its
+    # engine-side prefix cache hits (reference: serve request_router/
+    # prefix-aware router over vLLM's prefix caching).
+    request_router: str = "pow2"
 
     def options(self, **kwargs) -> "Deployment":
         return dataclasses.replace(self, **kwargs)
@@ -62,7 +67,8 @@ def make_deployment(func_or_class=None, *, name: Optional[str] = None,
                     num_replicas: int = 1, max_ongoing_requests: int = 8,
                     ray_actor_options: Optional[dict] = None,
                     autoscaling_config: Optional[dict] = None,
-                    route_prefix: Optional[str] = None) -> Any:
+                    route_prefix: Optional[str] = None,
+                    request_router: str = "pow2") -> Any:
     def wrap(target):
         import functools
 
@@ -81,6 +87,7 @@ def make_deployment(func_or_class=None, *, name: Optional[str] = None,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=asc,
             route_prefix=route_prefix,
+            request_router=request_router,
         )
 
     if func_or_class is not None:

@@ -19,6 +19,11 @@ from typing import Any, Dict, List
 class _RouterState:
     """Replica set + outstanding counts, shared by all handle clones."""
 
+    # prefix-affinity table bounds (prefix_aware router)
+    PREFIX_CHUNK = 16
+    PREFIX_MAX_CHUNKS = 8
+    PREFIX_TABLE_CAP = 4096
+
     def __init__(self, deployment_name: str, controller):
         self.name = deployment_name
         self.controller = controller
@@ -27,7 +32,13 @@ class _RouterState:
         self.replicas: List[Any] = []
         self.outstanding: Dict[int, int] = {}
         self.max_ongoing = 8
+        self.router = "pow2"
         self.last_refresh = 0.0
+        import collections
+
+        # cumulative-prefix hash -> replica index that last served it
+        self._prefix_owner: "collections.OrderedDict" = \
+            collections.OrderedDict()
 
     REFRESH_INTERVAL_S = 1.0
 
@@ -40,29 +51,83 @@ class _RouterState:
                      and self.replicas)
         if not force and fresh:
             return
-        version, replicas, max_ongoing = ray_tpu.get(
+        version, replicas, max_ongoing, router = ray_tpu.get(
             [self.controller.get_replicas.remote(self.name)], timeout=30.0)[0]
         with self.lock:
             if version != self.version:
                 self.version = version
                 self.replicas = replicas
                 self.outstanding = {i: 0 for i in range(len(replicas))}
+                self._prefix_owner.clear()  # indices changed meaning
             self.max_ongoing = max_ongoing
+            self.router = router
             self.last_refresh = now
 
-    def acquire_replica(self):
-        """Pick (power-of-two-choices) + increment under ONE lock hold;
-        returns (replica, index) or None if no replicas."""
+    @classmethod
+    def _prefix_hashes(cls, key) -> List[int]:
+        """Hashes of the cumulative CHUNK-sized prefixes of the routing
+        key (tokens for list/tuple prompts, bytes for str/bytes),
+        longest first."""
+        import hashlib
+
+        def h64(b: bytes) -> int:
+            return int.from_bytes(
+                hashlib.blake2b(b, digest_size=8).digest(), "little")
+
+        hashes = []
+        for n_chunks in range(cls.PREFIX_MAX_CHUNKS, 0, -1):
+            cut = key[:n_chunks * cls.PREFIX_CHUNK]
+            if not len(cut):
+                continue
+            if isinstance(cut, str):
+                b = cut.encode()
+            elif isinstance(cut, bytes):
+                b = cut
+            else:
+                b = repr(tuple(cut)).encode()
+            h = h64(b)
+            if not hashes or hashes[-1] != h:
+                hashes.append(h)
+        return hashes
+
+    def _pick_pow2(self) -> int:
+        n = len(self.replicas)
+        if n == 1:
+            return 0
+        a, b = random.sample(range(n), 2)
+        return a if self.outstanding.get(a, 0) <= \
+            self.outstanding.get(b, 0) else b
+
+    def acquire_replica(self, routing_key=None):
+        """Pick + increment under ONE lock hold; returns
+        (replica, index) or None if no replicas.
+
+        pow2: less-loaded of two random replicas. prefix_aware
+        (reference: serve request_router/ prefix-aware over vLLM prefix
+        caching): the replica that last served the longest matching
+        request prefix, so its engine prefix cache hits — unless it is
+        saturated, then fall back to pow2 and adopt the new owner."""
         with self.lock:
             n = len(self.replicas)
             if n == 0:
                 return None
-            if n == 1:
-                idx = 0
-            else:
-                a, b = random.sample(range(n), 2)
-                idx = a if self.outstanding.get(a, 0) <= \
-                    self.outstanding.get(b, 0) else b
+            idx = None
+            hashes = []
+            if self.router == "prefix_aware" and routing_key is not None:
+                hashes = self._prefix_hashes(routing_key)
+                for h in hashes:  # longest cumulative prefix first
+                    owner = self._prefix_owner.get(h)
+                    if owner is not None and owner < n and \
+                            self.outstanding.get(owner, 0) < self.max_ongoing:
+                        idx = owner
+                        break
+            if idx is None:
+                idx = self._pick_pow2()
+            for h in hashes:  # adopt/refresh ownership
+                self._prefix_owner[h] = idx
+                self._prefix_owner.move_to_end(h)
+            while len(self._prefix_owner) > self.PREFIX_TABLE_CAP:
+                self._prefix_owner.popitem(last=False)
             self.outstanding[idx] = self.outstanding.get(idx, 0) + 1
             return self.replicas[idx], idx
 
@@ -71,11 +136,22 @@ class _RouterState:
             self.outstanding[idx] = max(0, self.outstanding.get(idx, 1) - 1)
 
 
+def _rebuild_handle(name, controller, method):
+    return DeploymentHandle(name, controller, _method=method)
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
                  _state: _RouterState = None, _method: str = "__call__"):
         self._state = _state or _RouterState(deployment_name, controller)
         self._method = _method
+
+    def __reduce__(self):
+        # handles cross process boundaries (e.g. composed deployments
+        # receive downstream handles as init args — reference pattern);
+        # the router state rebuilds fresh on the receiving side
+        return (_rebuild_handle,
+                (self._state.name, self._state.controller, self._method))
 
     @property
     def _name(self):
@@ -87,10 +163,18 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         deadline = time.monotonic() + 30.0
+        # prefix_aware routing keys off the first positional argument of
+        # REQUEST-carrying methods only (the prompt for LLM deployments);
+        # bookkeeping methods like poll(request_id) must not churn the
+        # affinity table or be routed by a meaningless key
+        routing_key = None
+        if self._method in ("__call__", "generate", "submit") and args \
+                and isinstance(args[0], (str, bytes, list, tuple)):
+            routing_key = args[0]
         acquired = None
         while acquired is None:
             self._state.refresh()
-            acquired = self._state.acquire_replica()
+            acquired = self._state.acquire_replica(routing_key)
             if acquired is None:
                 if time.monotonic() > deadline:
                     raise RuntimeError(

@@ -31,6 +31,10 @@ class _Request:
     output: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    # KV handed off from a prefill replica (PD disaggregation): dict with
+    # "k"/"v" (layers, len, kv_heads, hd) numpy + "logits" of the last
+    # prompt token; admission injects instead of prefilling.
+    preload: Optional[dict] = None
 
 
 class LLMEngine:
@@ -38,12 +42,14 @@ class LLMEngine:
 
     def __init__(self, config=None, params=None, *, num_slots: int = 8,
                  max_seq: Optional[int] = None, model: str = "tiny",
-                 seed: int = 0):
+                 seed: int = 0, prefix_cache_size: int = 0):
+        import collections
+
         import jax
 
         from ray_tpu.models import llama
         from ray_tpu.models.decoding import (
-            init_cache, make_decode_step, make_prefill)
+            init_cache, make_decode_step, make_inject, make_prefill)
 
         self.config = config or llama.CONFIGS[model]
         if params is None:
@@ -54,7 +60,20 @@ class LLMEngine:
         self._cache = init_cache(self.config, num_slots, self.max_seq)
         self._decode = make_decode_step(params, self.config)
         self._prefill = make_prefill(params, self.config)
+        self._inject = make_inject(self.config)
         self._key = jax.random.key(seed)
+        # Exact-prompt KV cache (host LRU), OFF by default: storing pays
+        # a device->host copy of the prompt KV per admission, worth it
+        # only for repeat-prompt workloads (enable via prefix_cache_size,
+        # pair with the handle's prefix_aware router). Repeat prompts
+        # skip prefill entirely: KV + last logits are re-injected into a
+        # free slot (reference: prefix-aware routing leans on vLLM's
+        # automatic prefix caching; here the engine owns the cache).
+        self._prefix_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._prefix_cache_size = prefix_cache_size
+        self._prefix_hits = 0
+        self._prefix_misses = 0
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: Dict[str, dict] = {}      # streaming submit/poll
@@ -105,6 +124,36 @@ class LLMEngine:
         self._queue.put(req)
         return rid
 
+    def submit_prefilled(self, prompt: List[int], k, v, logits,
+                         max_tokens: int = 64, temperature: float = 0.0,
+                         eos_token: Optional[int] = None) -> str:
+        """Decode-side half of PD disaggregation: admit a request whose
+        prompt KV was computed by a prefill replica. k/v are
+        (layers, len(prompt), kv_heads, head_dim) arrays, logits the last
+        prompt position's logits."""
+        import uuid
+
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_tokens > self.max_seq:
+            raise ValueError("prompt + max_tokens exceeds max_seq")
+        k, v = np.asarray(k), np.asarray(v)
+        c = self.config
+        want = (c.n_layers, len(prompt), c.n_kv_heads, c.head_dim)
+        if k.shape != want or v.shape != want:
+            # caller thread: surface the mismatch to the submitter rather
+            # than blowing up the engine loop for every in-flight request
+            raise ValueError(
+                f"prefilled KV shape {k.shape}/{v.shape} != expected {want}")
+        req = _Request(list(prompt), max_tokens, temperature, eos_token,
+                       preload={"k": k, "v": v,
+                                "logits": np.asarray(logits)})
+        rid = uuid.uuid4().hex
+        with self._pending_lock:
+            self._pending[rid] = {"req": req, "sent": 0}
+        self._queue.put(req)
+        return rid
+
     def poll(self, request_id: str) -> Dict[str, Any]:
         """New tokens since the last poll + done flag. The entry is dropped
         once fully drained after completion."""
@@ -128,13 +177,39 @@ class LLMEngine:
         return {"steps": self._steps,
                 "tokens_generated": self._tokens_generated,
                 "active_slots": sum(s is not None for s in self._slots),
-                "queued": self._queue.qsize()}
+                "queued": self._queue.qsize(),
+                "prefix_hits": self._prefix_hits,
+                "prefix_misses": self._prefix_misses}
 
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=5)
 
     # ------------------------------------------------------------- engine
+    def _inject_kv(self, slot: int, k: np.ndarray, v: np.ndarray,
+                   true_len: int):
+        """Pad external KV rows to a bucket and write them into `slot`."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import pad_to_bucket
+
+        P = min(pad_to_bucket(true_len), self.max_seq)
+        pad = P - k.shape[1]
+        if pad > 0:
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            k = np.pad(k, widths)
+            v = np.pad(v, widths)
+        self._cache = self._inject(self._cache, jnp.asarray(k),
+                                   jnp.asarray(v), true_len, slot)
+
+    def _extract_kv(self, slot: int, true_len: int):
+        """Device→host copy of one slot's prompt KV (rows [0, true_len))."""
+        import jax
+
+        k, v = jax.device_get((self._cache["k"][:, slot, :true_len],
+                               self._cache["v"][:, slot, :true_len]))
+        return np.asarray(k), np.asarray(v)
+
     def _admit(self):
         import jax.numpy as jnp
 
@@ -147,13 +222,36 @@ class LLMEngine:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return
-            # cap padding at max_seq: a prompt that fits must be admitted
-            P = min(pad_to_bucket(len(req.prompt)), self.max_seq)
-            tokens = np.zeros((1, P), np.int32)
-            tokens[0, :len(req.prompt)] = req.prompt
-            self._cache, logits = self._prefill(
-                self._cache, jnp.asarray(tokens), len(req.prompt), slot)
-            tok = self._sample(np.asarray(logits)[None], req.temperature)[0]
+            plen = len(req.prompt)
+            key = tuple(req.prompt)
+            cached = None if req.preload else self._prefix_cache.get(key)
+            if req.preload is not None:
+                # PD handoff: prompt KV computed by a prefill replica
+                self._inject_kv(slot, req.preload["k"], req.preload["v"],
+                                plen)
+                logits_np = req.preload["logits"]
+                req.preload = None  # free the host copy
+            elif cached is not None:
+                self._prefix_hits += 1
+                self._prefix_cache.move_to_end(key)
+                self._inject_kv(slot, cached["k"], cached["v"], plen)
+                logits_np = cached["logits"]
+            else:
+                # cap padding at max_seq: a prompt that fits must be admitted
+                P = min(pad_to_bucket(plen), self.max_seq)
+                tokens = np.zeros((1, P), np.int32)
+                tokens[0, :plen] = req.prompt
+                self._cache, logits = self._prefill(
+                    self._cache, jnp.asarray(tokens), plen, slot)
+                logits_np = np.asarray(logits)
+                if self._prefix_cache_size > 0:
+                    self._prefix_misses += 1
+                    k, v = self._extract_kv(slot, plen)
+                    self._prefix_cache[key] = {"k": k, "v": v,
+                                               "logits": logits_np}
+                    while len(self._prefix_cache) > self._prefix_cache_size:
+                        self._prefix_cache.popitem(last=False)
+            tok = self._sample(logits_np.reshape(1, -1), req.temperature)[0]
             req.output.append(int(tok))
             self._slots[slot] = req
             self._last_token[slot] = tok
