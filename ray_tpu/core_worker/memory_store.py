@@ -30,12 +30,16 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class Entry:
-    value: Optional[bytes] = None  # serialized value
+    value: Optional[bytes] = None  # serialized value (bytes, or a pinned
+    # shm memoryview for values backed by the node store's shared pages)
     error: Optional[bytes] = None  # serialized exception
     location: Optional[Tuple[str, int]] = None  # remote holder (large objects)
     is_ready: bool = False
     size: int = 0
     spilled_path: Optional[str] = None  # on-disk value (spilled)
+    shm_backed: bool = False  # value aliases shm pages: no heap charge,
+    # never spilled (the pin keeps the pages resident; disk would be a
+    # redundant copy of already-durable shared memory)
 
 
 class MemoryStore:
@@ -58,7 +62,8 @@ class MemoryStore:
         Called under self._cv."""
         candidates = sorted(
             ((e.size, oid) for oid, e in self._entries.items()
-             if e.is_ready and e.value is not None and e.size > 0),
+             if e.is_ready and e.value is not None and e.size > 0
+             and not e.shm_backed),
             key=lambda t: t[0], reverse=True)
         spill_dir = self._ensure_spill_dir()
         for size, oid in candidates:
@@ -111,23 +116,26 @@ class MemoryStore:
     def put(self, object_id: ObjectID, value: Optional[bytes] = None,
             error: Optional[bytes] = None,
             location: Optional[Tuple[str, int]] = None) -> None:
-        size = len(value) if value else 0
+        size = len(value) if value is not None else 0
+        shm_backed = isinstance(value, memoryview)
+        charge = 0 if shm_backed else size  # shm pages aren't heap
         with self._cv:
             cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
             high = cap * GLOBAL_CONFIG.get("object_spilling_threshold")
             existing = self._entries.get(object_id)
             if existing is not None and existing.is_ready:
                 return  # idempotent: first write wins (retries may re-store)
-            if self._bytes_used + size > high:
+            if self._bytes_used + charge > high:
                 # spill down to the configured fullness ratio so later puts
                 # are less likely to pay the spill on their critical path
-                self._spill_locked(int(self._bytes_used + size - high))
-            if self._bytes_used + size > cap:
+                self._spill_locked(int(self._bytes_used + charge - high))
+            if self._bytes_used + charge > cap:
                 raise ObjectStoreFullError(
-                    f"memory store full: {self._bytes_used + size} > {cap}")
+                    f"memory store full: {self._bytes_used + charge} > {cap}")
             self._entries[object_id] = Entry(
-                value=value, error=error, location=location, is_ready=True, size=size)
-            self._bytes_used += size
+                value=value, error=error, location=location, is_ready=True,
+                size=size, shm_backed=shm_backed)
+            self._bytes_used += charge
             callbacks = self._done_callbacks.pop(object_id, [])
             self._cv.notify_all()
         for cb in callbacks:  # outside the lock: callbacks may re-enter
@@ -249,7 +257,7 @@ class MemoryStore:
             for oid in object_ids:
                 e = self._entries.pop(oid, None)
                 if e is not None:
-                    if e.value is not None:
+                    if e.value is not None and not e.shm_backed:
                         self._bytes_used -= e.size
                     if e.spilled_path is not None:
                         try:

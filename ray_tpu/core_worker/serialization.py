@@ -1,0 +1,85 @@
+"""Out-of-band (pickle protocol 5) value serialization.
+
+Reference: ``python/ray/_private/serialization.py`` — cloudpickle +
+pickle5 buffers with zero-copy numpy reads from plasma. Same design
+here: values whose pickle exports buffers (numpy arrays, bytearrays,
+anything implementing the buffer protocol through pickle 5) are framed
+as::
+
+    "RTB5" | u32 n_buffers | u64 meta_len |
+    n x (u64 offset | u64 length)          # absolute, 64-byte aligned
+    meta (cloudpickle, protocol 5)
+    padding + buffer bytes ...
+
+``loads`` reconstructs with buffers ALIASING the input: from a bytes
+blob the arrays share the blob's memory; from a shared-memory view the
+arrays read the store's pages directly — the plasma zero-copy property.
+Like the reference's plasma reads, aliased numpy arrays are READ-ONLY
+(copy explicitly to mutate). Values without buffers round-trip as plain
+cloudpickle (no framing overhead).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Optional, Tuple, Union
+
+import cloudpickle
+
+MAGIC = b"RTB5"
+_ALIGN = 64  # numpy-friendly buffer alignment
+_HEADER = struct.Struct("<4sIQ")
+_SEG = struct.Struct("<QQ")
+
+
+def dumps(value: Any) -> bytes:
+    """Serialize; framed iff the value exports out-of-band buffers."""
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5,
+                             buffer_callback=buffers.append)
+    if not buffers:
+        return meta
+    views = [b.raw() for b in buffers]
+    # layout pass: header | segment table | meta | aligned buffers
+    off = _HEADER.size + _SEG.size * len(views) + len(meta)
+    segs: List[Tuple[int, int]] = []
+    for v in views:
+        off = (off + _ALIGN - 1) // _ALIGN * _ALIGN
+        segs.append((off, v.nbytes))
+        off += v.nbytes
+    out = bytearray(off)
+    _HEADER.pack_into(out, 0, MAGIC, len(views), len(meta))
+    pos = _HEADER.size
+    for seg in segs:
+        _SEG.pack_into(out, pos, *seg)
+        pos += _SEG.size
+    out[pos:pos + len(meta)] = meta
+    for (o, n), v in zip(segs, views):
+        out[o:o + n] = v
+    for b in buffers:
+        b.release()
+    return bytes(out)
+
+
+def is_framed(blob: Union[bytes, memoryview]) -> bool:
+    return len(blob) >= 4 and bytes(blob[:4]) == MAGIC
+
+
+def loads(blob: Union[bytes, memoryview]) -> Any:
+    """Deserialize either format. Framed buffers alias `blob` — pass the
+    shm view directly for zero-copy reads (the view's owner chain keeps
+    the store pin alive; see ShmObjectStore.get_pinned)."""
+    if not is_framed(blob):
+        return pickle.loads(blob)
+    view = blob if isinstance(blob, memoryview) else memoryview(blob)
+    magic, n, meta_len = _HEADER.unpack_from(view, 0)
+    del magic
+    pos = _HEADER.size
+    segs = []
+    for _ in range(n):
+        segs.append(_SEG.unpack_from(view, pos))
+        pos += _SEG.size
+    meta = view[pos:pos + meta_len]
+    bufs = [view[o:o + ln] for o, ln in segs]
+    return pickle.loads(meta, buffers=bufs)

@@ -224,17 +224,14 @@ class CoreWorker:
                 self._shm = probed
         return self._shm
 
-    def _shm_read(self, oid: ObjectID) -> Optional[bytes]:
+    def _shm_read(self, oid: ObjectID) -> Optional[memoryview]:
+        """Zero-copy read: the returned view aliases the store's shared
+        pages and stays pinned until the last alias (including numpy
+        arrays deserialized over it) is garbage-collected."""
         store = self.shm
         if store is None:
             return None
-        view = store.get(oid.binary())
-        if view is None:
-            return None
-        try:
-            return bytes(view)
-        finally:
-            store.release(oid.binary())
+        return store.get_pinned(oid.binary())
 
     # ------------------------------------------------------------- contexts
     def current_task_id(self) -> TaskID:
@@ -278,11 +275,17 @@ class CoreWorker:
     # ---------------------------------------------------------- serialization
     @staticmethod
     def serialize(value: Any) -> bytes:
-        return cloudpickle.dumps(value)
+        # out-of-band pickle-5 framing for buffer-bearing values
+        # (numpy etc.) — reads alias the blob / shm pages, zero-copy
+        from . import serialization
+
+        return serialization.dumps(value)
 
     @staticmethod
-    def deserialize(blob: bytes) -> Any:
-        return pickle.loads(blob)
+    def deserialize(blob) -> Any:
+        from . import serialization
+
+        return serialization.loads(blob)
 
     # ----------------------------------------------------------------- put/get
     def put(self, value: Any) -> ObjectRef:

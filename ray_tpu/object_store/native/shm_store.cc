@@ -30,14 +30,15 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545053544f5245ULL;  // "RTPSTORE"
+constexpr uint64_t kMagic = 0x52545053544f5246ULL;  // "RTPSTORF" (layout v2)
 constexpr uint32_t kIdBytes = 32;
 constexpr uint32_t kTableSize = 1 << 16;       // open addressing, power of 2
 constexpr uint32_t kMaxFreeSpans = 8192;
 
 struct Entry {
-  uint8_t used;        // 0 empty, 1 live, 2 tombstone
+  uint8_t used;            // 0 empty, 1 live, 2 tombstone
   uint8_t sealed;
+  uint8_t pending_delete;  // deleted while pinned: reap on last release
   uint8_t id_len;
   uint8_t id[kIdBytes];
   uint32_t refcount;
@@ -97,12 +98,16 @@ int LockHeld(Header* hdr) {
   return rc;
 }
 
+// Entries in pending_delete state are "zombies": logically deleted
+// (invisible to get/contains/duplicate checks, their id immediately
+// reusable by a fresh put) but their span stays allocated until the
+// last pin releases. Probing continues past them, so chains stay valid.
 Entry* FindEntry(Header* hdr, const uint8_t* id, uint8_t id_len) {
   uint64_t h = HashId(id, id_len);
   for (uint32_t probe = 0; probe < kTableSize; probe++) {
     Entry& e = hdr->table[(h + probe) & (kTableSize - 1)];
     if (e.used == 0) return nullptr;
-    if (e.used == 1 && e.id_len == id_len &&
+    if (e.used == 1 && !e.pending_delete && e.id_len == id_len &&
         memcmp(e.id, id, id_len) == 0)
       return &e;
   }
@@ -116,7 +121,7 @@ Entry* FindSlot(Header* hdr, const uint8_t* id, uint8_t id_len) {
     Entry& e = hdr->table[(h + probe) & (kTableSize - 1)];
     if (e.used == 0) return tomb ? tomb : &e;
     if (e.used == 2 && !tomb) tomb = &e;
-    if (e.used == 1 && e.id_len == id_len &&
+    if (e.used == 1 && !e.pending_delete && e.id_len == id_len &&
         memcmp(e.id, id, id_len) == 0)
       return nullptr;  // exists
   }
@@ -183,6 +188,7 @@ void DeleteEntryLocked(Header* hdr, Entry* e) {
   e->used = 2;  // tombstone keeps probe chains intact
   e->refcount = 0;
   e->sealed = 0;
+  e->pending_delete = 0;
 }
 
 // Evict LRU sealed refcount-0 objects until at least `need` bytes could be
@@ -313,6 +319,7 @@ int rts_put(int h, const uint8_t* id, uint32_t id_len,
   memcpy(st.base + off, data, size);
   e->used = 1;
   e->sealed = 1;
+  e->pending_delete = 0;
   e->id_len = (uint8_t)id_len;
   memcpy(e->id, id, id_len);
   e->refcount = 0;
@@ -351,9 +358,60 @@ int rts_release(int h, const uint8_t* id, uint32_t id_len) {
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
-  if (e && e->refcount > 0) e->refcount--;
+  if (!e || e->refcount == 0) {
+    // The pin may belong to an entry deleted while pinned (now a
+    // zombie that id lookups skip — possibly shadowed by a fresh live
+    // entry under the same id). Zombies keep their id, so they sit on
+    // the id's probe chain: walk it instead of scanning the table. If
+    // the same id cycled through delete-while-pinned more than once
+    // the counts alias across its zombies; each zombie is still reaped
+    // exactly when its own count reaches zero.
+    e = nullptr;
+    uint64_t hh = HashId(id, (uint8_t)id_len);
+    for (uint32_t probe = 0; probe < kTableSize; probe++) {
+      Entry& z = hdr->table[(hh + probe) & (kTableSize - 1)];
+      if (z.used == 0) break;
+      if (z.used == 1 && z.pending_delete && z.refcount > 0 &&
+          z.id_len == (uint8_t)id_len && memcmp(z.id, id, id_len) == 0) {
+        e = &z;
+        break;
+      }
+    }
+  }
+  if (e && e->refcount > 0) {
+    e->refcount--;
+    if (e->refcount == 0 && e->pending_delete) DeleteEntryLocked(hdr, e);
+  }
   pthread_mutex_unlock(&hdr->lock);
   return e ? 0 : -ENOENT;
+}
+
+// Exact-pin release by (id, mapped address). The address disambiguates
+// which generation of the id the pin belongs to when the object was
+// deleted and re-put while the reader held its view; the id makes the
+// lookup a hash-chain probe rather than a table scan.
+int rts_release_addr(int h, const uint8_t* id, uint32_t id_len,
+                     const uint8_t* ptr) {
+  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return -EINVAL;
+  Store& st = g_stores[h];
+  Header* hdr = st.hdr;
+  if (ptr < st.base) return -EINVAL;
+  uint64_t offset = (uint64_t)(ptr - st.base);
+  if (LockHeld(hdr) != 0) return -EINVAL;
+  uint64_t hh = HashId(id, (uint8_t)id_len);
+  for (uint32_t probe = 0; probe < kTableSize; probe++) {
+    Entry& e = hdr->table[(hh + probe) & (kTableSize - 1)];
+    if (e.used == 0) break;
+    if (e.used == 1 && e.offset == offset && e.refcount > 0 &&
+        e.id_len == (uint8_t)id_len && memcmp(e.id, id, id_len) == 0) {
+      e.refcount--;
+      if (e.refcount == 0 && e.pending_delete) DeleteEntryLocked(hdr, &e);
+      pthread_mutex_unlock(&hdr->lock);
+      return 0;
+    }
+  }
+  pthread_mutex_unlock(&hdr->lock);
+  return -ENOENT;
 }
 
 int rts_contains(int h, const uint8_t* id, uint32_t id_len) {
@@ -375,8 +433,11 @@ int rts_delete(int h, const uint8_t* id, uint32_t id_len) {
     return -ENOENT;
   }
   if (e->refcount > 0) {
+    // Pinned by a zero-copy reader: logically deleted now (invisible to
+    // get/contains), pages reclaimed when the last pin releases.
+    e->pending_delete = 1;
     pthread_mutex_unlock(&hdr->lock);
-    return -EBUSY;
+    return 0;
   }
   DeleteEntryLocked(hdr, e);
   pthread_mutex_unlock(&hdr->lock);

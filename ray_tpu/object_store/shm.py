@@ -53,6 +53,9 @@ def _load():
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
         fn.restype = ctypes.c_int
+    lib.rts_release_addr.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_uint32, ctypes.c_void_p]
+    lib.rts_release_addr.restype = ctypes.c_int
     lib.rts_stats.argtypes = [ctypes.c_int] + \
         [ctypes.POINTER(ctypes.c_uint64)] * 3
     lib.rts_stats.restype = ctypes.c_int
@@ -76,6 +79,10 @@ class ShmObjectStore:
         if h < 0:
             raise OSError(-h, f"shm store {name!r}: {os.strerror(-h)}")
         self._h = h
+        # pins taken via get(): id -> mapped addresses, so release() can
+        # name the exact span even after a delete + re-put of the id
+        self._pins: dict = {}
+        self._pins_lock = threading.Lock()
 
     def put(self, object_id: bytes, data) -> bool:
         """False if it already exists; raises on out-of-space."""
@@ -96,11 +103,46 @@ class ShmObjectStore:
                                 ctypes.byref(size))
         if not ptr:
             return None
-        return memoryview((ctypes.c_ubyte * size.value).from_address(
-            ctypes.addressof(ptr.contents))).cast("B")
+        addr = ctypes.addressof(ptr.contents)
+        with self._pins_lock:
+            self._pins.setdefault(bytes(object_id), []).append(addr)
+        return memoryview(
+            (ctypes.c_ubyte * size.value).from_address(addr)).cast("B")
+
+    def get_pinned(self, object_id: bytes) -> Optional[memoryview]:
+        """Read-only zero-copy view whose pin releases ITSELF when the
+        last alias dies (numpy arrays deserialized over the view keep
+        the exporting ctypes object alive; a finalizer on it runs the
+        release). This is the plasma property: objects stay pinned
+        exactly while some Python buffer references them, and shared
+        pages are immutable to readers. The release is by ADDRESS, so it
+        stays correct even if the id is deleted and re-put while the
+        view is alive."""
+        import weakref
+
+        size = ctypes.c_uint64()
+        ptr = self._lib.rts_get(self._h, object_id, len(object_id),
+                                ctypes.byref(size))
+        if not ptr:
+            return None
+        addr = ctypes.addressof(ptr.contents)
+        owner = (ctypes.c_ubyte * size.value).from_address(addr)
+        weakref.finalize(owner, self._lib.rts_release_addr, self._h,
+                         bytes(object_id), len(object_id), addr)
+        return memoryview(owner).cast("B").toreadonly()
 
     def release(self, object_id: bytes) -> None:
-        self._lib.rts_release(self._h, object_id, len(object_id))
+        key = bytes(object_id)
+        with self._pins_lock:
+            addrs = self._pins.get(key)
+            addr = addrs.pop() if addrs else None
+            if addrs is not None and not addrs:
+                del self._pins[key]
+        if addr is not None:
+            self._lib.rts_release_addr(self._h, object_id, len(object_id),
+                                       addr)
+        else:  # pin not taken through this wrapper: id-based best effort
+            self._lib.rts_release(self._h, object_id, len(object_id))
 
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.rts_contains(self._h, object_id,

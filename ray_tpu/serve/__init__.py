@@ -21,4 +21,8 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 from ray_tpu.serve.proxy import Request, Response  # noqa: F401

@@ -37,15 +37,22 @@ class Replica:
     def ping(self) -> bool:
         return True
 
-    def get_metrics(self) -> Dict[str, float]:
+    def get_metrics(self) -> Dict[str, Any]:
+        from ray_tpu.serve import multiplex
+
         with self._lock:
             return {"ongoing": float(self._ongoing),
-                    "total": float(self._total)}
+                    "total": float(self._total),
+                    "model_ids": multiplex.loaded_model_ids(self._user)}
 
     def handle_request(self, method: str, args, kwargs):
+        from ray_tpu.serve import multiplex
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = multiplex.set_request_model_id(
+            kwargs.pop("_multiplexed_model_id", ""))
         try:
             target = (self._user if method == "__call__"
                       else getattr(self._user, method))
@@ -53,6 +60,7 @@ class Replica:
                 raise TypeError("deployment class is not callable")
             return target(*args, **kwargs)
         finally:
+            multiplex.reset_request_model_id(token)
             with self._lock:
                 self._ongoing -= 1
 

@@ -39,6 +39,10 @@ class _RouterState:
         # cumulative-prefix hash -> replica index that last served it
         self._prefix_owner: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # multiplexed model id -> replica index that last loaded it
+        # (reference: multiplexed model routing in request_router/)
+        self._model_owner: "collections.OrderedDict" = \
+            collections.OrderedDict()
 
     REFRESH_INTERVAL_S = 1.0
 
@@ -59,6 +63,7 @@ class _RouterState:
                 self.replicas = replicas
                 self.outstanding = {i: 0 for i in range(len(replicas))}
                 self._prefix_owner.clear()  # indices changed meaning
+                self._model_owner.clear()
             self.max_ongoing = max_ongoing
             self.router = router
             self.last_refresh = now
@@ -98,7 +103,9 @@ class _RouterState:
         return a if self.outstanding.get(a, 0) <= \
             self.outstanding.get(b, 0) else b
 
-    def acquire_replica(self, routing_key=None):
+    MODEL_TABLE_CAP = 1024
+
+    def acquire_replica(self, routing_key=None, model_id=None):
         """Pick + increment under ONE lock hold; returns
         (replica, index) or None if no replicas.
 
@@ -106,14 +113,23 @@ class _RouterState:
         (reference: serve request_router/ prefix-aware over vLLM prefix
         caching): the replica that last served the longest matching
         request prefix, so its engine prefix cache hits — unless it is
-        saturated, then fall back to pow2 and adopt the new owner."""
+        saturated, then fall back to pow2 and adopt the new owner.
+        A multiplexed ``model_id`` (any router mode) takes precedence:
+        route to the replica that last loaded the model so its LRU cache
+        hits — loading is the expensive HBM-staging step."""
         with self.lock:
             n = len(self.replicas)
             if n == 0:
                 return None
             idx = None
             hashes = []
-            if self.router == "prefix_aware" and routing_key is not None:
+            if model_id is not None:
+                owner = self._model_owner.get(model_id)
+                if owner is not None and owner < n and \
+                        self.outstanding.get(owner, 0) < self.max_ongoing:
+                    idx = owner
+            if idx is None and self.router == "prefix_aware" \
+                    and routing_key is not None:
                 hashes = self._prefix_hashes(routing_key)
                 for h in hashes:  # longest cumulative prefix first
                     owner = self._prefix_owner.get(h)
@@ -128,6 +144,11 @@ class _RouterState:
                 self._prefix_owner.move_to_end(h)
             while len(self._prefix_owner) > self.PREFIX_TABLE_CAP:
                 self._prefix_owner.popitem(last=False)
+            if model_id is not None:
+                self._model_owner[model_id] = idx
+                self._model_owner.move_to_end(model_id)
+                while len(self._model_owner) > self.MODEL_TABLE_CAP:
+                    self._model_owner.popitem(last=False)
             self.outstanding[idx] = self.outstanding.get(idx, 0) + 1
             return self.replicas[idx], idx
 
@@ -136,30 +157,41 @@ class _RouterState:
             self.outstanding[idx] = max(0, self.outstanding.get(idx, 1) - 1)
 
 
-def _rebuild_handle(name, controller, method):
-    return DeploymentHandle(name, controller, _method=method)
+def _rebuild_handle(name, controller, method, model_id=None):
+    return DeploymentHandle(name, controller, _method=method,
+                            _model_id=model_id)
 
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 _state: _RouterState = None, _method: str = "__call__"):
+                 _state: _RouterState = None, _method: str = "__call__",
+                 _model_id: str = None):
         self._state = _state or _RouterState(deployment_name, controller)
         self._method = _method
+        self._model_id = _model_id
 
     def __reduce__(self):
         # handles cross process boundaries (e.g. composed deployments
         # receive downstream handles as init args — reference pattern);
         # the router state rebuilds fresh on the receiving side
         return (_rebuild_handle,
-                (self._state.name, self._state.controller, self._method))
+                (self._state.name, self._state.controller, self._method,
+                 self._model_id))
 
     @property
     def _name(self):
         return self._state.name
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
-        return DeploymentHandle(self._state.name, self._state.controller,
-                                _state=self._state, _method=method_name)
+    def options(self, method_name: str = None,
+                multiplexed_model_id: str = None) -> "DeploymentHandle":
+        """Clone sharing router state. ``multiplexed_model_id`` tags
+        requests for a ``@serve.multiplexed`` deployment (reference:
+        ``handle.options(multiplexed_model_id=...)``)."""
+        return DeploymentHandle(
+            self._state.name, self._state.controller, _state=self._state,
+            _method=method_name if method_name is not None else self._method,
+            _model_id=(multiplexed_model_id if multiplexed_model_id
+                       is not None else self._model_id))
 
     def remote(self, *args, **kwargs):
         deadline = time.monotonic() + 30.0
@@ -171,10 +203,14 @@ class DeploymentHandle:
         if self._method in ("__call__", "generate", "submit") and args \
                 and isinstance(args[0], (str, bytes, list, tuple)):
             routing_key = args[0]
+        if self._model_id is not None:
+            kwargs = dict(kwargs)
+            kwargs["_multiplexed_model_id"] = self._model_id
         acquired = None
         while acquired is None:
             self._state.refresh()
-            acquired = self._state.acquire_replica(routing_key)
+            acquired = self._state.acquire_replica(routing_key,
+                                                   self._model_id)
             if acquired is None:
                 if time.monotonic() > deadline:
                     raise RuntimeError(

@@ -30,12 +30,17 @@ logger = logging.getLogger(__name__)
 class ScalingConfig:
     """Reference: ``ray.train.ScalingConfig`` (air/config.py). TPU twist:
     ``use_tpu`` + per-worker chip counts; SLICE_PACK keeps the gang on one
-    ICI slice."""
+    ICI slice. Setting ``min_workers``/``max_workers`` turns on elastic
+    scaling (reference: train/v2 scaling_policy/): the gang starts at the
+    largest feasible size, shrinks on failure instead of wedging, and
+    restarts bigger from the latest checkpoint when capacity appears."""
 
     num_workers: int = 1
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
 
     def bundle(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -79,13 +84,15 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 poll_interval_s: float = 0.2):
+                 poll_interval_s: float = 0.2,
+                 scaling_policy=None):
         self.train_fn = train_loop_per_worker
         self.config = train_loop_config
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.resume_from = resume_from_checkpoint
         self.poll_interval_s = poll_interval_s
+        self._policy_override = scaling_policy
 
     # ------------------------------------------------------------------ fit
     def fit(self, timeout_s: float = 3600.0) -> Result:
@@ -102,13 +109,36 @@ class JaxTrainer:
                 logger.info("auto-resuming from %s", found.path)
                 self.resume_from = found
 
+        from ray_tpu.train.scaling_policy import (
+            ElasticScalingPolicy, FixedScalingPolicy, ResizeDecision)
+
+        sc = self.scaling
+        if self._policy_override is not None:
+            policy = self._policy_override
+        elif sc.min_workers is not None or sc.max_workers is not None:
+            lo = sc.min_workers or 1
+            policy = ElasticScalingPolicy(
+                lo, max(lo, sc.max_workers or sc.num_workers))
+        else:
+            policy = FixedScalingPolicy(sc.num_workers)
+        self._policy = policy
+
         failures = 0
         last_metrics: Dict[str, Any] = {}
         deadline = time.monotonic() + timeout_s
+        next_size: Optional[int] = None  # explicit size from a resize
+        started_once = False
         while True:
-            group = WorkerGroup(self.scaling.num_workers,
-                                self.scaling.bundle(),
-                                self.scaling.placement_strategy)
+            bundle = sc.bundle()
+            if next_size is not None:
+                size = next_size
+            elif not started_once:
+                size = policy.initial_size(bundle, self._available())
+            else:
+                size = policy.size_after_failure(bundle, self._available())
+            next_size = None
+            started_once = True
+            group = WorkerGroup(size, bundle, sc.placement_strategy)
             resume = manager.latest or self.resume_from
             error = None
             try:
@@ -119,11 +149,27 @@ class JaxTrainer:
                             resume_from_path=resume.path if resume else None)
                 error, last_metrics = self._poll_until_done(
                     group, manager, last_metrics, deadline)
+            except (TimeoutError, TrainingFailedError):
+                raise
+            except Exception as e:  # noqa: BLE001 — scheduling failure.
+                # Elastic policies retry at whatever size is feasible NOW;
+                # for a fixed size the failure is permanent config/capacity
+                # mismatch — propagate it immediately with its real type.
+                if not getattr(policy, "WATCHES_CAPACITY", False):
+                    raise
+                error = f"worker group start failed: {type(e).__name__}: {e}"
             finally:
                 group.shutdown()
             if error is None:
                 return Result(metrics=last_metrics,
                               checkpoint=manager.latest, path=storage)
+            if isinstance(error, ResizeDecision):
+                # elastic upscale: restart from the latest checkpoint at
+                # the new size — not a failure
+                logger.info("elastic resize %d -> %d (%s)", size,
+                            error.num_workers, error.reason)
+                next_size = error.num_workers
+                continue
             failures += 1
             max_failures = self.run_config.failure_config.max_failures
             if failures > max_failures:
@@ -135,10 +181,23 @@ class JaxTrainer:
                            self.run_config.failure_config.max_failures,
                            error)
 
+    @staticmethod
+    def _available() -> Dict[str, float]:
+        import ray_tpu
+
+        try:
+            return ray_tpu.available_resources()
+        except Exception:  # noqa: BLE001 — no cluster yet / local mode
+            return {}
+
     def _poll_until_done(self, group: WorkerGroup,
                          manager: CheckpointManager,
                          last_metrics: Dict[str, Any],
                          deadline: float):
+        # Only elastic policies watch cluster capacity; don't pay an
+        # available_resources() RPC per poll tick on the fixed path.
+        watches = getattr(self._policy, "WATCHES_CAPACITY", False)
+        bundle = self.scaling.bundle()
         while True:
             if time.monotonic() > deadline:
                 raise TimeoutError("JaxTrainer.fit timeout exceeded")
@@ -161,6 +220,14 @@ class JaxTrainer:
                 return errs[0], last_metrics
             if all(st["status"] == "finished" for st in statuses):
                 return None, last_metrics
+            # Resize only AFTER this interval's reports/checkpoints are
+            # harvested and completion is ruled out — a restart must
+            # resume from the newest checkpoint, not preempt a finish.
+            if watches:
+                decision = self._policy.decide(group.num_workers, bundle,
+                                               self._available())
+                if decision is not None:
+                    return decision, last_metrics
             time.sleep(self.poll_interval_s)
 
 

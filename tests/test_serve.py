@@ -143,3 +143,48 @@ class TestAutoscaling:
         assert scaled, serve.status()
         rt.get(refs)
         serve.delete("slow")
+
+
+class TestMultiplexing:
+    def test_multiplexed_lru_and_affinity(self, rt):
+        @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+        class Host:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                return {"id": model_id, "weights": model_id.upper()}
+
+            def __call__(self, x):
+                model = self.get_model(serve.get_multiplexed_model_id())
+                return (model["id"], x)
+
+            def loaded(self):
+                from ray_tpu.serve import multiplex
+
+                return multiplex.loaded_model_ids()
+
+        handle = serve.run(Host.bind(), name="mux")
+        # requests tagged with a model id reach a replica that loads it
+        out = rt.get(handle.options(multiplexed_model_id="m1").remote(7))
+        assert out == ("m1", 7)
+        out = rt.get(handle.options(multiplexed_model_id="m2").remote(8))
+        assert out == ("m2", 8)
+        # affinity: repeated m1 requests land where m1 is already loaded;
+        # with 2 replicas x 2 slots, 3 models exercise LRU eviction too
+        for i in range(6):
+            mid = f"m{(i % 3) + 1}"
+            assert rt.get(
+                handle.options(multiplexed_model_id=mid).remote(i)) == (mid, i)
+        # per-replica caches never exceed the cap
+        h_loaded = handle.options(method_name="loaded")
+        loaded_sets = [rt.get(h_loaded.remote()) for _ in range(4)]
+        assert all(len(s) <= 2 for s in loaded_sets)
+        # untagged requests inside the replica see an empty model id
+        @serve.deployment
+        class Plain:
+            def __call__(self):
+                return serve.get_multiplexed_model_id()
+
+        h2 = serve.run(Plain.bind(), name="plain-mux")
+        assert rt.get(h2.remote()) == ""
+        serve.delete("plain-mux")
+        serve.delete("mux")

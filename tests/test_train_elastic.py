@@ -1,0 +1,111 @@
+"""Elastic scaling policy (reference: train/v2 scaling_policy/ —
+fixed + pluggable elastic): feasibility-sized gangs, shrink-on-failure,
+upscale-restart from checkpoint when capacity appears."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.scaling_policy import (
+    ElasticScalingPolicy,
+    FixedScalingPolicy,
+    ResizeDecision,
+    _feasible_workers,
+)
+
+
+class TestPolicyUnit:
+    def test_feasible_workers(self):
+        assert _feasible_workers({"CPU": 1.0}, {"CPU": 3.0}) == 3
+        assert _feasible_workers({"CPU": 2.0}, {"CPU": 3.0}) == 1
+        assert _feasible_workers({"CPU": 1.0, "TPU": 4.0},
+                                 {"CPU": 8.0, "TPU": 8.0}) == 2
+        assert _feasible_workers({"TPU": 1.0}, {"CPU": 8.0}) == 0
+
+    def test_fixed_policy(self):
+        p = FixedScalingPolicy(3)
+        assert p.initial_size({"CPU": 1.0}, {"CPU": 1.0}) == 3
+        assert p.decide(3, {"CPU": 1.0}, {"CPU": 99.0}) is None
+
+    def test_elastic_sizes(self):
+        p = ElasticScalingPolicy(1, 4)
+        assert p.initial_size({"CPU": 1.0}, {"CPU": 2.0}) == 2   # feasible
+        assert p.initial_size({"CPU": 1.0}, {"CPU": 9.0}) == 4   # capped
+        assert p.initial_size({"CPU": 1.0}, {"CPU": 0.0}) == 1   # floor
+        assert p.size_after_failure({"CPU": 1.0}, {"CPU": 3.0}) == 3
+
+    def test_elastic_upscale_needs_patience(self):
+        p = ElasticScalingPolicy(1, 4, upscale_patience_s=0.2)
+        bundle, avail = {"CPU": 1.0}, {"CPU": 2.0}
+        assert p.decide(2, bundle, avail) is None       # starts the clock
+        assert p.decide(2, bundle, avail) is None       # not yet
+        time.sleep(0.25)
+        d = p.decide(2, bundle, avail)
+        assert isinstance(d, ResizeDecision) and d.num_workers == 4
+
+    def test_elastic_no_upscale_at_max_or_without_headroom(self):
+        p = ElasticScalingPolicy(1, 2, upscale_patience_s=0.0)
+        assert p.decide(2, {"CPU": 1.0}, {"CPU": 9.0}) is None  # at max
+        p2 = ElasticScalingPolicy(1, 4, upscale_patience_s=0.0)
+        assert p2.decide(2, {"CPU": 1.0}, {"CPU": 0.5}) is None  # no room
+
+
+class TestElasticIntegration:
+    def test_upscale_restart_reaches_bigger_world(self, tmp_path):
+        """Gang starts at the feasible size 1, then a capacity increase
+        (simulated by a policy whose availability view grows) restarts
+        it at 2 from the latest checkpoint."""
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        try:
+            def train_fn(config):
+                from ray_tpu import train
+
+                ctx = train.get_context()
+                ws = ctx.get_world_size()
+                if ws < 2:
+                    # small world: report + checkpoint, then idle so the
+                    # elastic decision fires mid-run
+                    for step in range(100):
+                        train.report({"step": step, "world": ws})
+                        time.sleep(0.1)
+                else:
+                    train.report({"step": 999, "world": ws})
+
+            policy = ElasticScalingPolicy(1, 2, upscale_patience_s=0.3)
+            # force the initial size down to 1 regardless of real capacity
+            orig_initial = policy.initial_size
+            policy.initial_size = lambda b, a: 1
+            del orig_initial
+            trainer = JaxTrainer(
+                train_fn,
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(name="elastic",
+                                     storage_path=str(tmp_path)),
+                scaling_policy=policy)
+            result = trainer.fit(timeout_s=180)
+            assert result.metrics["world"] == 2
+        finally:
+            ray_tpu.shutdown()
+
+    def test_sizes_from_real_cluster_resources(self, tmp_path):
+        """min/max in ScalingConfig builds the elastic policy and sizes
+        the gang from the cluster's ACTUAL free resources."""
+        ray_tpu.init(num_cpus=3, num_tpus=0)
+        try:
+            def train_fn(config):
+                from ray_tpu import train
+
+                ctx = train.get_context()
+                train.report({"world": ctx.get_world_size()})
+
+            trainer = JaxTrainer(
+                train_fn,
+                scaling_config=ScalingConfig(min_workers=1, max_workers=2),
+                run_config=RunConfig(name="sized",
+                                     storage_path=str(tmp_path)))
+            result = trainer.fit(timeout_s=120)
+            assert result.metrics["world"] == 2  # capped by max, not 3
+        finally:
+            ray_tpu.shutdown()
