@@ -336,14 +336,22 @@ def method(**opts):
 
 # -------------------------------------------------------------------- core ops
 
-def put(value: Any):
+def put(value: Any, *, _tensor_transport: Optional[str] = None):
+    """Store an object. ``_tensor_transport="device"`` keeps jax.Array
+    leaves resident in this process's device HBM and ships only a
+    marker; consumers on other workers pull the tensors out-of-band
+    (reference: experimental/gpu_object_manager 'RDT')."""
     if _client is not None:
+        if _tensor_transport is not None:
+            raise NotImplementedError(
+                "_tensor_transport is not supported in ray:// client mode "
+                "(the client process has no cluster-visible device store)")
         return _client.put(value)
-    return _put_local(value)
+    return _put_local(value, _tensor_transport)
 
 
-def _put_local(value: Any) -> ObjectRef:
-    return _core_worker().put(value)
+def _put_local(value: Any, tensor_transport: Optional[str] = None) -> ObjectRef:
+    return _core_worker().put(value, tensor_transport=tensor_transport)
 
 
 def get(refs, *, timeout: Optional[float] = None):

@@ -140,6 +140,8 @@ _D("maximum_startup_concurrency", int, 4, "concurrent worker forks")
 _D("object_store_memory_bytes", int, 256 * 1024 * 1024, "default shm arena size")
 _D("object_store_chunk_size_bytes", int, 5 * 1024 * 1024, "transfer chunk size")
 _D("object_pull_max_inflight", int, 8, "concurrent chunks pulled per object")
+_D("device_object_cache_entries", int, 32,
+   "consumer-side LRU size for resolved remote device objects")
 _D("object_spilling_threshold", float, 0.8, "fullness ratio that triggers spill")
 _D("object_spilling_dir", str, "", "external storage dir ('' = session dir)")
 _D("max_direct_call_object_size", int, 100 * 1024, "inline-in-RPC threshold bytes")

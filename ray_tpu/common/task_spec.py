@@ -131,6 +131,11 @@ class TaskSpec:
     # walks working_dir trees — far too hot for shape_key, which runs on
     # the IO loop for every task)
     runtime_env_hash: Optional[str] = None
+    # tracing context of the submitting span ({trace_id, span_id}), so
+    # the executing worker's span parents across the process boundary
+    # (reference: ray.util.tracing injects the OTel context into task
+    # metadata). None when tracing is off — the common case.
+    tracing: Optional[dict] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.from_index(self.task_id, i + 1) for i in range(self.num_returns)]

@@ -118,13 +118,24 @@ class CoreWorker:
             "reconstruct_object", "set_visible_devices", "ping", "exit_worker",
             "actor_method_metadata", "object_info", "get_object_chunk",
             "incref_inflight", "borrow_ack", "borrow_release", "drop_copy",
-            "handoff_done",
+            "handoff_done", "device_object_get",
         ):
             self.server.register(name, getattr(self, f"h_{name}"))
         self.server.start()
 
         self.gcs = GcsClient(self.gcs_address, client_id=f"worker-{self.worker_id.hex()[:8]}")
         self.memory_store = MemoryStore()
+        from ray_tpu.object_store.device import DeviceObjectStore
+
+        # device-resident objects (jax.Arrays kept in HBM; see
+        # ray_tpu/object_store/device.py for the transfer tiers)
+        self.device_store = DeviceObjectStore()
+        import collections as _collections
+
+        # consumer-side LRU of resolved remote device objects
+        self._device_obj_cache: "_collections.OrderedDict" = \
+            _collections.OrderedDict()
+        self._device_cache_lock = threading.Lock()
         self.submitter = NormalTaskSubmitter(self)
         self._actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
         self._actor_sub_lock = threading.Lock()
@@ -288,11 +299,80 @@ class CoreWorker:
         return serialization.loads(blob)
 
     # ----------------------------------------------------------------- put/get
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, tensor_transport: Optional[str] = None) -> ObjectRef:
+        if tensor_transport not in (None, "device"):
+            raise ValueError(
+                f"unknown tensor_transport {tensor_transport!r}; "
+                "expected 'device'")
         oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
-        blob = self.serialize(value)
-        self.memory_store.put(oid, value=blob)
+        if tensor_transport == "device":
+            self._put_device(oid, value)
+        else:
+            blob = self.serialize(value)
+            self.memory_store.put(oid, value=blob)
         return ObjectRef(oid, self.worker_id, self.server.address)
+
+    def _put_device(self, oid: ObjectID, value: Any) -> None:
+        """Keep the value's jax.Array leaves in this process's HBM; the
+        object plane stores/ships only a marker (reference:
+        gpu_object_manager.py 'tensor transport' for put)."""
+        from ray_tpu.object_store import device as devmod
+
+        if not devmod.is_device_value(value):
+            raise TypeError(
+                "tensor_transport='device' requires at least one jax.Array "
+                "leaf in the value")
+        self.device_store.put(oid.binary(), value)
+        marker = devmod.DeviceObjectMarker(
+            oid.binary(), self.server.address, tuple(devmod.spec_of(value)))
+        self.memory_store.put(oid, value=self.serialize(marker))
+
+    def _maybe_device_resolve(self, value: Any) -> Any:
+        """If `value` is a device-object marker, resolve it: same process
+        -> the original device array(s), zero copies; other process ->
+        one host hop (owner DMAs to host, we device_put here), cached in
+        a bounded consumer-side LRU so N tasks sharing the same weights
+        pay ONE transfer (reference: gpu_object_store caches received
+        tensors)."""
+        from ray_tpu.object_store import device as devmod
+
+        if not isinstance(value, devmod.DeviceObjectMarker):
+            return value
+        local = self.device_store.get(value.object_id)
+        if local is not None:
+            return local
+        with self._device_cache_lock:
+            cached = self._device_obj_cache.get(value.object_id)
+            if cached is not None:
+                self._device_obj_cache.move_to_end(value.object_id)
+                return cached
+        holder = RetryableRpcClient(tuple(value.holder), deadline_s=30.0)
+        try:
+            reply = holder.call("device_object_get",
+                                object_id=value.object_id, timeout=120.0)
+            if reply.get("error") is not None:
+                raise self.deserialize(reply["error"])
+            if reply.get("value") is not None:
+                blob = reply["value"]
+            else:  # large: chunked pull of the staged transfer blob
+                sid = ObjectID(reply["staged_id"])
+                blob = self._io.run(self._pull_chunks(
+                    tuple(value.holder), sid, reply["size"]))
+                try:  # release the holder's staging copy promptly
+                    holder.call("drop_copy", object_id=sid.binary(),
+                                timeout=10.0)
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+        finally:
+            holder.close()
+        restored = devmod.restore_on_device(self.deserialize(blob))
+        with self._device_cache_lock:
+            self._device_obj_cache[value.object_id] = restored
+            self._device_obj_cache.move_to_end(value.object_id)
+            cap = GLOBAL_CONFIG.get("device_object_cache_entries")
+            while len(self._device_obj_cache) > cap:
+                self._device_obj_cache.popitem(last=False)
+        return restored
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         for ref in refs:
@@ -303,11 +383,12 @@ class CoreWorker:
             if entry.error is not None:
                 raise self.deserialize(entry.error)
             if entry.value is not None:
-                out.append(self.deserialize(entry.value))
+                out.append(self._maybe_device_resolve(
+                    self.deserialize(entry.value)))
             elif entry.location is not None:
                 # large object held remotely: fetch (blocking, off-loop)
                 blob = self._fetch_from_location(ref, entry.location, timeout)
-                out.append(self.deserialize(blob))
+                out.append(self._maybe_device_resolve(self.deserialize(blob)))
             else:
                 raise ObjectLostError(ref.object_id, "entry has no value")
         return out
@@ -481,6 +562,16 @@ class CoreWorker:
         return self._register_and_submit(spec)
 
     def _register_and_submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.enabled():
+            ctx = _tracing.current_context()
+            if ctx is not None:
+                spec.tracing = ctx
+                # the native fastspec buffer doesn't carry tracing; fall
+                # back to the pickled spec for traced submissions
+                if hasattr(spec, "_fast_payload"):
+                    del spec._fast_payload
         refs = []
         with self._lineage_lock:
             for oid in spec.return_ids():
@@ -852,6 +943,7 @@ class CoreWorker:
             self.lineage.pop(oid, None)
         location = self.memory_store.peek_location(oid)
         self.memory_store.free([oid])
+        self.device_store.free(oid.binary())
         if self._shm not in (False, None):
             self._shm.delete(oid.binary())
         if location is not None and tuple(location) != self.server.address:
@@ -967,6 +1059,31 @@ class CoreWorker:
         return await self._object_reply(object_id, timeout,
                                         advertise_self=False)
 
+    async def h_device_object_get(self, object_id: bytes):
+        """Out-of-band device-object transfer, holder side: DMA the
+        arrays to host and reply through the zero-copy object plane
+        (reference: gpu_object_manager trigger_out_of_band_tensor_
+        transfer — ours is pull- rather than owner-push-based). Small
+        blobs reply inline; large ones are staged under a transfer id
+        and pulled through the ordinary chunk path, never as one giant
+        RPC frame."""
+        import os as _os
+
+        loop = asyncio.get_running_loop()
+        staged = await loop.run_in_executor(
+            self._executor, self.device_store.stage_to_host, object_id)
+        if staged is None:
+            return {"error": pickle.dumps(ObjectLostError(
+                ObjectID(object_id), "device object not held here"))}
+        blob = await loop.run_in_executor(
+            self._executor, self.serialize, staged)
+        if len(blob) <= GLOBAL_CONFIG.get("object_store_chunk_size_bytes"):
+            return {"value": blob}
+        sid = ObjectID(_os.urandom(ObjectID.SIZE))
+        self.memory_store.put(sid, value=blob)
+        # consumer pulls chunks of sid then drop_copy's it
+        return {"staged_id": sid.binary(), "size": len(blob)}
+
     async def h_get_object_chunk(self, object_id: bytes, offset: int,
                                  length: int):
         oid = ObjectID(object_id)
@@ -1073,6 +1190,9 @@ class CoreWorker:
         """Owner freed the object: drop our cached/held copy."""
         oid = ObjectID(object_id)
         self.memory_store.free([oid])
+        self.device_store.free(object_id)
+        with self._device_cache_lock:
+            self._device_obj_cache.pop(object_id, None)
         if self._shm not in (False, None):
             self._shm.delete(object_id)
         return True
@@ -1155,14 +1275,26 @@ class CoreWorker:
                 async def run_with_ctx():
                     # Runs as its own asyncio task on the actor loop: the
                     # contextvar set is isolated to this call.
+                    from ray_tpu.util import tracing as _tracing
+
                     self._ctx.task_id = task.task_id
-                    return await method(*args, **kwargs)
+                    with _tracing.span(
+                            f"task::{task.actor_method_name}",
+                            parent_context=getattr(task, "tracing", None),
+                            attributes={"task_id": task.task_id.hex()[:16],
+                                        "worker_id":
+                                            self.worker_id.hex()[:8]}):
+                        return await method(*args, **kwargs)
 
                 result = await asyncio.wrap_future(
                     asyncio.run_coroutine_threadsafe(
                         run_with_ctx(), self._actor_async_loop()))
+                tt = getattr(method, "__rt_method_opts__",
+                             {}).get("tensor_transport")
                 reply = await loop.run_in_executor(
-                    self._executor, lambda: self._result_reply(task, result))
+                    self._executor,
+                    lambda: self._result_reply(task, result,
+                                               tensor_transport=tt))
             except Exception as e:  # noqa: BLE001 - user method error
                 reply = self._error_reply(task, e)
         self._seq_finish(caller, seq, reply)
@@ -1208,11 +1340,19 @@ class CoreWorker:
 
     def _execute_task(self, task: TaskSpec) -> dict:
         """Runs on an executor thread."""
+        from ray_tpu.util import tracing as _tracing
+
         start = time.time()
-        if task.is_actor_task():
-            reply = self._execute_actor_task(task)
-        else:
-            reply = self._execute_fn_task(task)
+        ctx = getattr(task, "tracing", None)
+        with _tracing.span(
+                f"task::{task.actor_method_name or task.name or 'task'}",
+                parent_context=ctx,
+                attributes={"task_id": task.task_id.hex()[:16],
+                            "worker_id": self.worker_id.hex()[:8]}):
+            if task.is_actor_task():
+                reply = self._execute_actor_task(task)
+            else:
+                reply = self._execute_fn_task(task)
         self._record_task_event(task, start, time.time(), reply)
         return reply
 
@@ -1354,7 +1494,11 @@ class CoreWorker:
                             run_with_ctx(), self._actor_async_loop()).result()
                     else:
                         result = method(*args, **kwargs)
-                    reply = self._result_reply(task, result)
+                    reply = self._result_reply(
+                        task, result,
+                        tensor_transport=getattr(
+                            method, "__rt_method_opts__",
+                            {}).get("tensor_transport"))
                 except Exception as e:  # noqa: BLE001 - user method error
                     reply = self._error_reply(task, e)
             return reply
@@ -1391,14 +1535,15 @@ class CoreWorker:
         if entry.error is not None:
             raise self.deserialize(entry.error)
         if entry.value is not None:
-            return self.deserialize(entry.value)
+            return self._maybe_device_resolve(self.deserialize(entry.value))
         if entry.location is not None:
             ref = ObjectRef(oid, arg.owner, getattr(arg, "owner_address", None))
             blob = self._fetch_from_location(ref, entry.location, 120.0)
-            return self.deserialize(blob)
+            return self._maybe_device_resolve(self.deserialize(blob))
         raise ObjectLostError(oid, "dependency unavailable")
 
-    def _result_reply(self, task: TaskSpec, result: Any) -> dict:
+    def _result_reply(self, task: TaskSpec, result: Any,
+                      tensor_transport: Optional[str] = None) -> dict:
         values = (
             [result] if task.num_returns == 1
             else (list(result) if task.num_returns > 1 else [])
@@ -1407,9 +1552,30 @@ class CoreWorker:
             return self._error_reply(task, ValueError(
                 f"task declared num_returns={task.num_returns} but returned "
                 f"{len(values)} values"))
+        if tensor_transport is not None and tensor_transport != "device":
+            return self._error_reply(task, ValueError(
+                f"unknown tensor_transport {tensor_transport!r}; "
+                "expected 'device'"))
         results = {}
+        stored_device: List[ObjectID] = []
         threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
         for oid, value in zip(task.return_ids(), values):
+            if tensor_transport == "device":
+                # keep the tensors in THIS process's HBM; ship a marker.
+                # The caller frees via drop_copy to our address (the
+                # location), which also clears the device store.
+                try:
+                    self._put_device(oid, value)
+                except TypeError as e:
+                    # the whole task errors: free returns already staged
+                    # or their HBM leaks with no caller ref to GC them
+                    for done in stored_device:
+                        self.device_store.free(done.binary())
+                        self.memory_store.free([done])
+                    return self._error_reply(task, e)
+                stored_device.append(oid)
+                results[oid.binary()] = {"location": self.server.address}
+                continue
             blob = self.serialize(value)
             if len(blob) <= threshold:
                 results[oid.binary()] = {"value": blob}

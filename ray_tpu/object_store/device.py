@@ -1,0 +1,138 @@
+"""Device-resident objects: ``put``/``get`` for ``jax.Array``s that stay
+in TPU HBM instead of round-tripping through host serialization.
+
+Reference: ``python/ray/experimental/gpu_object_manager/``
+(``gpu_object_manager.py:50`` GPUObjectManager, ``gpu_object_store.py``):
+"tensor transport" for regular ``ray.put``/task args — tensors stay on
+the producing worker's device, the owner triggers an out-of-band
+transfer when a consumer on another worker needs them
+(``trigger_out_of_band_tensor_transfer:183``).
+
+TPU framing: there is no NCCL-style out-of-program p2p between separate
+TPU processes — chip-to-chip ICI traffic exists only INSIDE compiled XLA
+programs (collectives, compiled-graph channels). So the tiers are:
+
+- same process: ``get`` returns the *same* ``jax.Array`` — zero copies,
+  zero host traffic. This is the hot path for weight handoff between
+  serve replicas'/trainers' components sharing a process and for
+  driver-side reuse.
+- cross process: the owner stages the value to host (``device_get``,
+  DMA) and ships it through the ordinary zero-copy object plane (framed
+  pickle-5 over shm/RPC); the consumer ``device_put``s onto its own
+  chips. One host hop — the minimum physics allows between distinct
+  TPU processes.
+- in-program: for repeated tensor flow between pinned actors use
+  compiled-graph :class:`~ray_tpu.graph.channels.DeviceBufferChannel` /
+  XLA collectives; this module is the ad-hoc object path, not the
+  pipeline path.
+
+Values may be arbitrary pytrees; every ``jax.Array`` leaf stays on
+device, other leaves ride along untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceObjectMarker:
+    """What the object plane stores/ships INSTEAD of the tensor bytes: a
+    pointer to the process holding the device value plus shape/dtype
+    metadata (reference: the GPU-object metadata travelling in place of
+    the tensor, gpu_object_manager.py). Resolving a marker is the
+    out-of-band transfer trigger."""
+
+    object_id: bytes
+    holder: Tuple[str, int]  # RPC address of the process with the value
+    spec: Tuple  # ((shape, dtype), ...) of the array leaves
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def is_device_value(value: Any) -> bool:
+    """True if the value contains at least one jax.Array leaf (worth
+    keeping on device)."""
+    try:
+        jax = _jax()
+        leaves = jax.tree_util.tree_leaves(value)
+    except Exception:  # noqa: BLE001 — jax unavailable/untreelike
+        return False
+    return any(isinstance(x, jax.Array) for x in leaves)
+
+
+def spec_of(value: Any) -> List[Tuple[Tuple[int, ...], str]]:
+    """(shape, dtype) of each array leaf — shipped in the marker so
+    consumers can plan placement without fetching."""
+    jax = _jax()
+    return [(tuple(x.shape), str(x.dtype))
+            for x in jax.tree_util.tree_leaves(value)
+            if isinstance(x, jax.Array)]
+
+
+class DeviceObjectStore:
+    """Per-process map: object id -> device-resident pytree."""
+
+    def __init__(self) -> None:
+        self._objects: Dict[bytes, Any] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: bytes, value: Any) -> None:
+        with self._lock:
+            self._objects[object_id] = value
+
+    def get(self, object_id: bytes) -> Optional[Any]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def free(self, object_id: bytes) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def stage_to_host(self, object_id: bytes) -> Optional[Any]:
+        """Owner-side out-of-band step: device arrays -> host numpy
+        (single DMA per leaf), leaving the device copy in place. The
+        result serializes through the zero-copy object plane."""
+        with self._lock:
+            value = self._objects.get(object_id)
+        if value is None:
+            return None
+        jax = _jax()
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x,
+            value)
+
+    def stats(self) -> Dict[str, int]:
+        jax = _jax()
+        with self._lock:
+            vals = list(self._objects.values())
+        nbytes = 0
+        for v in vals:
+            for leaf in jax.tree_util.tree_leaves(v):
+                if isinstance(leaf, jax.Array):
+                    nbytes += leaf.size * leaf.dtype.itemsize
+        return {"num_objects": len(vals), "device_bytes": nbytes}
+
+
+def restore_on_device(host_value: Any, device=None) -> Any:
+    """Consumer-side: place staged host arrays onto this process's
+    device(s). numpy leaves become jax.Arrays (matching what the
+    producer held); non-array leaves pass through."""
+    import numpy as np
+
+    jax = _jax()
+    kwargs = {"device": device} if device is not None else {}
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, **kwargs)
+        if isinstance(x, np.ndarray) else x,
+        host_value)

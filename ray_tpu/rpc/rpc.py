@@ -119,6 +119,11 @@ class IoContext:
         try:
             return cfut.result(timeout)
         except cf.TimeoutError:
+            if cfut.done():
+                # TimeoutError raised BY the coroutine (cf.TimeoutError is
+                # builtins.TimeoutError since 3.8): propagate it untouched
+                # instead of mislabeling it as run()'s own wait expiring.
+                raise
             # don't leave the coroutine running (and its side effects live)
             # after the caller has taken the timeout path
             self.loop.call_soon_threadsafe(

@@ -1,0 +1,90 @@
+"""Kernel-level profiling hooks (reference: Ray exposes torch/nsight
+profilers via runtime hooks; the TPU-native equivalent is the XLA/jax
+profiler, whose traces open in TensorBoard/Perfetto and show per-kernel
+MXU/HBM utilization).
+
+Two entry points:
+
+- :func:`profile` — context manager around a training/serving region;
+  writes an XLA profiler trace directory (the evidence artifact for
+  perf work, e.g. the MFU investigations in PERF_PLAN.md).
+- :func:`annotate` — named sub-region inside a profile (TraceAnnotation)
+  so framework phases (data load, step, collective) are visible between
+  kernels.
+
+Both degrade to no-ops when jax's profiler is unavailable (e.g. a
+worker without jax initialized), so library code can call them
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def profile(logdir: str, *, host_tracer_level: int = 2) -> Iterator[str]:
+    """Capture an XLA profiler trace of the enclosed region into
+    ``logdir`` (one subdirectory per capture). Returns the logdir so
+    callers can print/record the artifact path."""
+    os.makedirs(logdir, exist_ok=True)
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir,
+                                 create_perfetto_trace=False)
+        started = True
+    except Exception as e:  # noqa: BLE001 — no device/profiler: no-op
+        logger.debug("profiler unavailable: %s", e)
+        started = False
+    t0 = time.monotonic()
+    try:
+        yield logdir
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                logger.info("profile trace (%.1fs) written to %s",
+                            time.monotonic() - t0, logdir)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("stop_trace failed: %s", e)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a capture (shows as a host-side bar above the
+    device kernels it launched)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Live HBM stats of the first addressable device (bytes in use /
+    limit), or None off-device. Cheap enough to poll from monitors."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        return {"bytes_in_use": stats.get("bytes_in_use", 0),
+                "bytes_limit": stats.get("bytes_limit", 0),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+                "platform": dev.platform}
+    except Exception:  # noqa: BLE001
+        return None

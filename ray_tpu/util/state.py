@@ -70,8 +70,13 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
 
 
 def chrome_tracing_dump(path: Optional[str] = None) -> List[dict]:
-    """Task events → chrome://tracing 'X' (complete) events."""
-    events = []
+    """Task events → chrome://tracing 'X' (complete) events. Tracing
+    spans recorded in THIS process (util/tracing.py) render in the same
+    file, under their own 'trace' process lane."""
+    from ray_tpu.util import tracing as _tracing
+
+    events = _tracing.spans_to_chrome_events(
+        _tracing.recorder().snapshot())
     for ev in list_tasks():
         events.append({
             "name": ev.get("name", "task"),

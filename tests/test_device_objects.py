@@ -1,0 +1,163 @@
+"""Device-resident objects (reference: experimental/gpu_object_manager/
+— RDT "tensor transport" for put/task args, kept on-device, out-of-band
+transfer when crossing workers)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestUnit:
+    def test_is_device_value_and_spec(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.object_store import device
+
+        assert device.is_device_value(jnp.ones((2, 3)))
+        assert device.is_device_value({"w": jnp.ones(4), "meta": "x"})
+        assert not device.is_device_value(np.ones(3))
+        assert not device.is_device_value([1, "a"])
+        spec = device.spec_of({"w": jnp.ones((2, 3)), "b": jnp.zeros(5)})
+        assert sorted(spec) == [((2, 3), "float32"), ((5,), "float32")]
+
+    def test_store_roundtrip_and_staging(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.object_store import device
+
+        store = device.DeviceObjectStore()
+        val = {"w": jnp.arange(6.0).reshape(2, 3), "tag": "weights"}
+        store.put(b"id1", val)
+        # same-process get: the SAME device array, no copy
+        assert store.get(b"id1")["w"] is val["w"]
+        staged = store.stage_to_host(b"id1")
+        assert isinstance(staged["w"], np.ndarray)
+        assert staged["tag"] == "weights"
+        back = device.restore_on_device(staged)
+        assert isinstance(back["w"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(val["w"]))
+        st = store.stats()
+        assert st["num_objects"] == 1 and st["device_bytes"] == 6 * 4
+        store.free(b"id1")
+        assert not store.contains(b"id1")
+
+
+class TestIntegration:
+    def test_put_get_same_process_identity(self, rt):
+        import jax.numpy as jnp
+
+        arr = jnp.arange(16.0)
+        ref = rt.put(arr, _tensor_transport="device")
+        out = rt.get(ref)
+        assert out is arr  # zero-copy: literally the same device array
+
+    def test_device_arg_crosses_workers(self, rt):
+        import jax.numpy as jnp
+
+        @rt.remote
+        def total(x):
+            # consumer worker receives a device-restored array
+            import jax
+
+            assert isinstance(x, jax.Array)
+            return float(x.sum())
+
+        arr = jnp.arange(1000.0)
+        ref = rt.put(arr, _tensor_transport="device")
+        assert rt.get(total.remote(ref), timeout=60) == float(arr.sum())
+
+    def test_pytree_value_and_gc(self, rt):
+        import gc
+
+        import jax.numpy as jnp
+
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        cw = CoreWorker.current_or_raise()
+        before = cw.device_store.stats()["num_objects"]
+        val = {"w": jnp.ones((8, 8)), "step": 3}
+        ref = rt.put(val, _tensor_transport="device")
+        assert cw.device_store.stats()["num_objects"] == before + 1
+        out = rt.get(ref)
+        assert out["step"] == 3 and out["w"] is val["w"]
+        del ref, out
+        gc.collect()
+        import time
+
+        time.sleep(0.3)
+        assert cw.device_store.stats()["num_objects"] == before
+
+    def test_actor_method_device_return(self, rt):
+        import jax
+        import jax.numpy as jnp
+
+        @rt.remote
+        class WeightServer:
+            def __init__(self):
+                self._w = jnp.full((4, 4), 2.0)
+
+            @rt.method(tensor_transport="device")
+            def weights(self):
+                return self._w
+
+            def use_locally(self, w):
+                # a by-ref arg resolving in the HOLDER process must be
+                # the very same device array — no host round-trip
+                return w is self._w
+
+        srv = WeightServer.remote()
+        ref = srv.weights.remote()
+        w = rt.get(ref, timeout=60)
+        assert isinstance(w, jax.Array)
+        np.testing.assert_array_equal(np.asarray(w), np.full((4, 4), 2.0))
+        assert rt.get(srv.use_locally.remote(ref), timeout=60)
+
+    def test_large_device_object_chunked_pull(self, rt):
+        """> chunk-size tensors cross workers via the chunked pull path,
+        never as one giant RPC frame."""
+        import jax.numpy as jnp
+
+        @rt.remote
+        def l2(x):
+            return float((x * x).sum())
+
+        # 8 MiB of float32 > the 5 MiB default chunk size
+        arr = jnp.ones((2048, 1024), dtype=jnp.float32)
+        ref = rt.put(arr, _tensor_transport="device")
+        assert rt.get(l2.remote(ref), timeout=120) == float(2048 * 1024)
+
+    def test_unknown_transport_rejected(self, rt):
+        import jax.numpy as jnp
+
+        with pytest.raises(ValueError, match="tensor_transport"):
+            rt.put(jnp.ones(4), _tensor_transport="Device")
+
+    def test_consumer_cache_reuses_transfer(self, rt):
+        """N tasks consuming the same device ref in one worker pay one
+        transfer (consumer-side LRU)."""
+        import jax.numpy as jnp
+
+        @rt.remote
+        class Consumer:
+            def probe(self, w):
+                # identity across calls proves the cache hit (a fresh
+                # transfer would device_put a NEW array each time)
+                prev = getattr(self, "_prev", None)
+                self._prev = w
+                return prev is w
+
+        arr = jnp.arange(64.0)
+        ref = rt.put(arr, _tensor_transport="device")
+        c = Consumer.remote()
+        assert rt.get(c.probe.remote(ref), timeout=60) is False
+        assert rt.get(c.probe.remote(ref), timeout=60) is True
