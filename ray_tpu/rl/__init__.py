@@ -16,6 +16,12 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
     PPO,
     PPOConfig,
 )
+from ray_tpu.rl.dqn import (  # noqa: F401
+    DQN,
+    DQNConfig,
+    DQNLearner,
+    ReplayBuffer,
+)
 from ray_tpu.rl.envs import CartPoleEnv, make_env  # noqa: F401
 from ray_tpu.rl.impala import (  # noqa: F401,E402
     IMPALA,

@@ -1,0 +1,201 @@
+"""DQN: off-policy Q-learning with replay and a target network.
+
+Reference: ``rllib/algorithms/dqn/`` (replay-buffer driven
+``training_step``, double-Q target, periodic target-net sync). TPU
+framing: the update is one jitted double-DQN step over a replayed
+minibatch — Q-network matmuls land on the MXU, the argmax/gather are
+cheap vector ops; replay sampling stays in numpy on host.
+
+Exploration: env runners sample categorically from softmax(outputs)
+(see module.np_sample_action), so running them on Q-values gives
+Boltzmann (soft-Q) exploration — one of the reference's stock DQN
+exploration strategies — with no runner-side special casing. Early
+near-uniform Q-values explore broadly; as Q-gaps grow the policy
+sharpens toward greedy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.module import init_policy_params, jax_forward
+
+
+class ReplayBuffer:
+    """Uniform ring replay of transitions (numpy, host-side).
+    Reference: ``rllib/utils/replay_buffers/``."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._rng = np.random.default_rng(seed)
+        self._storage: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_fragment(self, frag: Dict[str, np.ndarray]) -> None:
+        """Append a rollout fragment of transitions (obs, actions,
+        rewards, next_obs, dones)."""
+        n = len(frag["obs"])
+        if not self._storage:
+            for k in ("obs", "actions", "rewards", "next_obs", "dones"):
+                v = np.asarray(frag[k])
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            dtype=v.dtype)
+        for k, buf in self._storage.items():
+            v = np.asarray(frag[k])
+            idx = (self._next + np.arange(n)) % self.capacity
+            buf[idx] = v
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self.capacity, self._size + n)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: buf[idx] for k, buf in self._storage.items()}
+
+
+class DQNLearner:
+    """Double-DQN update with Huber loss + periodic target sync."""
+
+    def __init__(self, params: Dict[str, np.ndarray], *, lr: float,
+                 gamma: float, target_update_freq: int):
+        import jax
+        import optax
+
+        self._params = jax.device_put(params)
+        self._target = jax.device_put(params)
+        self._gamma = gamma
+        self._freq = max(1, target_update_freq)
+        self._updates = 0
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self._params)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma = self._gamma
+
+        def loss_fn(params, target, batch):
+            q, _ = jax_forward(params, batch["obs"])
+            q_next_online, _ = jax_forward(params, batch["next_obs"])
+            q_next_target, _ = jax_forward(target, batch["next_obs"])
+            # double-DQN: online net picks the action, target net rates it
+            next_a = jnp.argmax(q_next_online, axis=-1)
+            next_q = jnp.take_along_axis(
+                q_next_target, next_a[:, None], axis=-1)[:, 0]
+            td_target = batch["rewards"] + gamma * next_q * \
+                (1.0 - batch["dones"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            err = q_taken - jax.lax.stop_gradient(td_target)
+            huber = jnp.where(jnp.abs(err) <= 1.0, 0.5 * err * err,
+                              jnp.abs(err) - 0.5)
+            return huber.mean(), {"td_error_mean": jnp.abs(err).mean(),
+                                  "q_mean": q_taken.mean()}
+
+        @jax.jit
+        def step(params, target, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = __import__("optax").apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        return step
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        jb["rewards"] = jb["rewards"].astype(jnp.float32)
+        jb["dones"] = jb["dones"].astype(jnp.float32)
+        self._params, self._opt_state, loss, aux = self._step(
+            self._params, self._target, self._opt_state, jb)
+        self._updates += 1
+        if self._updates % self._freq == 0:
+            self._target = self._params
+        return {"loss": float(loss),
+                **{k: float(v) for k, v in aux.items()}}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self._params.items()}
+
+
+class DQN(Algorithm):
+    """Sample → replay → N minibatch updates per iteration."""
+
+    def __init__(self, config: "DQNConfig"):
+        super().__init__(config)
+        params = init_policy_params(
+            self._env_probe["obs_size"], self._env_probe["num_actions"],
+            hidden=tuple(config.hidden), seed=config.seed)
+        self.learner = DQNLearner(
+            params, lr=config.lr, gamma=config.gamma,
+            target_update_freq=config.target_update_freq)
+        self.replay = ReplayBuffer(config.replay_capacity,
+                                   seed=config.seed)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    @staticmethod
+    def _with_next_obs(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Fragments carry obs/rewards/dones; rebuild next_obs by shift,
+        dropping the fragment's final (next-obs-less) transition. At
+        episode boundaries the shifted obs is the reset state, which the
+        done-mask removes from the TD target."""
+        obs = np.asarray(frag["obs"])
+        return {"obs": obs[:-1],
+                "actions": np.asarray(frag["actions"])[:-1],
+                "rewards": np.asarray(frag["rewards"],
+                                      dtype=np.float32)[:-1],
+                "next_obs": obs[1:],
+                "dones": np.asarray(frag["dones"], dtype=np.float32)[:-1]}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DQNConfig = self.config  # type: ignore[assignment]
+        fragments = self._sample_fragments()
+        if not fragments:
+            raise RuntimeError("no healthy env runners produced samples")
+        returns: List[float] = []
+        for f in fragments:
+            self.replay.add_fragment(self._with_next_obs(f))
+            returns.extend(f["episode_returns"])
+        metrics: Dict[str, float] = {}
+        if len(self.replay) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                metrics = self.learner.update(
+                    self.replay.sample(cfg.train_batch_size))
+        self._weights_version += 1
+        self._return_window = (self._return_window + returns)[-100:]
+        return {
+            "env_runners": {
+                "episode_return_mean": self.episode_return_mean(),
+                "num_episodes": len(returns),
+                "num_env_steps_sampled": sum(
+                    len(f["obs"]) for f in fragments),
+                "num_healthy_workers":
+                    self.env_runner_group.num_healthy_actors(),
+            },
+            "learners": {"default_policy": metrics},
+            "replay_buffer_size": len(self.replay),
+        }
+
+
+@dataclasses.dataclass
+class DQNConfig(AlgorithmConfig):
+    lr: float = 1e-3
+    replay_capacity: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 16
+    target_update_freq: int = 32
+    algo_class = DQN

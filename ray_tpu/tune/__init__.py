@@ -12,8 +12,15 @@ from ray_tpu.tune.search import (  # noqa: F401
     randint,
     uniform,
 )
+from ray_tpu.tune.search_algo import (  # noqa: F401
+    HaltonSearch,
+    OptunaSearch,
+    Searcher,
+)
 from ray_tpu.tune.tuner import (  # noqa: F401
     ASHAScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     Result,
     ResultGrid,

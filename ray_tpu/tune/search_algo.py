@@ -1,0 +1,165 @@
+"""Pluggable search algorithms (reference: ``python/ray/tune/search/``
+— Searcher base class + adapters for optuna/hyperopt/etc.).
+
+A Searcher proposes configs one trial at a time and receives completion
+feedback, which is what lets model-based methods (TPE, GP) adapt. The
+Tuner consults ``TuneConfig.search_alg`` lazily at launch time, so a
+suggestion made after N completions has seen all N results.
+
+Shipped searchers:
+
+- :class:`HaltonSearch` — native, dependency-free quasi-random search.
+  Scrambled Halton points cover the space far more evenly than iid
+  sampling at small budgets (the common tune regime), and need no
+  fitting step.
+- :class:`OptunaSearch` — adapter to the optuna TPE sampler, gated on
+  the optional dependency (raises a clear ImportError when absent,
+  matching the reference's optional-integration pattern).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search import Domain, GridSearch
+
+
+class Searcher:
+    """Interface consumed by the Tuner."""
+
+    def setup(self, space: Dict[str, Any], metric: str, mode: str) -> None:
+        self._space = space
+        self._metric = metric
+        self._mode = mode
+
+    def suggest(self, trial_id: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: int,
+                          metrics: Optional[Dict[str, Any]],
+                          error: Optional[str] = None) -> None:
+        """Feedback hook; default no-op for non-adaptive searchers."""
+
+
+def _primes(n: int):
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % p for p in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+def _halton(index: int, base: int) -> float:
+    f, r = 1.0, 0.0
+    i = index
+    while i > 0:
+        f /= base
+        r += f * (i % base)
+        i //= base
+    return r
+
+
+class HaltonSearch(Searcher):
+    """Quasi-random (low-discrepancy) search over the Domain-typed
+    dimensions of the space; non-Domain values pass through fixed,
+    GridSearch dimensions cycle."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+
+    def setup(self, space, metric, mode):
+        super().setup(space, metric, mode)
+        self._dims = [k for k, v in space.items() if isinstance(v, Domain)]
+        self._bases = _primes(max(1, len(self._dims)))
+
+    def _unit_to_domain(self, u: float, d: Domain):
+        if d.kind == "uniform":
+            lo, hi = d.args
+            return lo + u * (hi - lo)
+        if d.kind == "loguniform":
+            lo, hi = d.args
+            return math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+        if d.kind == "randint":
+            lo, hi = d.args
+            # floor, not int(): int() truncates toward zero, which for
+            # lo < 0 double-weights 0 and can never emit lo
+            return min(hi - 1, math.floor(lo + u * (hi - lo)))
+        if d.kind == "choice":
+            opts = d.args[0]
+            return opts[min(len(opts) - 1, int(u * len(opts)))]
+        raise ValueError(f"unknown domain kind {d.kind!r}")
+
+    def suggest(self, trial_id: int) -> Dict[str, Any]:
+        # index offset by seed: different seeds give shifted sequences
+        idx = trial_id + 1 + self._seed * 7919
+        config = {}
+        for k, v in self._space.items():
+            if isinstance(v, Domain):
+                base = self._bases[self._dims.index(k)]
+                config[k] = self._unit_to_domain(_halton(idx, base), v)
+            elif isinstance(v, GridSearch):
+                config[k] = v.values[trial_id % len(v.values)]
+            else:
+                config[k] = v
+        return config
+
+
+class OptunaSearch(Searcher):
+    """Adapter to optuna's TPE (reference: ``tune/search/optuna``).
+    Optional dependency: constructing this without optuna installed
+    raises ImportError immediately, not at first suggest."""
+
+    def __init__(self, seed: int = 0):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package, which is not "
+                "installed in this environment; use HaltonSearch or the "
+                "built-in random/grid search instead") from e
+        self._seed = seed
+        self._trials: Dict[int, Any] = {}
+
+    def setup(self, space, metric, mode):
+        import optuna
+
+        super().setup(space, metric, mode)
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=self._seed))
+
+    def _ask(self, trial) -> Dict[str, Any]:
+        config = {}
+        for k, v in self._space.items():
+            if isinstance(v, Domain):
+                if v.kind == "uniform":
+                    config[k] = trial.suggest_float(k, *v.args)
+                elif v.kind == "loguniform":
+                    config[k] = trial.suggest_float(k, *v.args, log=True)
+                elif v.kind == "randint":
+                    config[k] = trial.suggest_int(k, v.args[0], v.args[1] - 1)
+                elif v.kind == "choice":
+                    config[k] = trial.suggest_categorical(k, v.args[0])
+            elif isinstance(v, GridSearch):
+                config[k] = trial.suggest_categorical(k, v.values)
+            else:
+                config[k] = v
+        return config
+
+    def suggest(self, trial_id: int) -> Dict[str, Any]:
+        trial = self._study.ask()
+        self._trials[trial_id] = trial
+        return self._ask(trial)
+
+    def on_trial_complete(self, trial_id, metrics, error=None):
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        if error is not None or not metrics or self._metric not in metrics:
+            self._study.tell(trial, state=__import__(
+                "optuna").trial.TrialState.FAIL)
+            return
+        self._study.tell(trial, metrics[self._metric])

@@ -119,24 +119,34 @@ class ASHAScheduler:
         score looked fine against no competition) still gets cut once
         better trials fill the rung in.
         """
-        # milestone CROSSING (step >= rung), not equality: trainables may
-        # report non-consecutive training_iterations
-        for rung in self._rungs:
-            if step >= rung and trial_id not in self._scores[rung]:
-                self._scores[rung][trial_id] = score
-        # A trial must clear the bar at EVERY rung it has passed (checking
-        # only the newest rung would shield it while that rung is empty).
-        for rung in self._rungs:
-            if rung > step or trial_id not in self._scores[rung]:
-                continue
-            population = self._scores[rung]
-            k = max(1, math.ceil(len(population) / self.reduction_factor))
-            cutoff = sorted(population.values(), reverse=True)[:k][-1]
-            if population[trial_id] < cutoff:
-                return "stop"
-        if step >= self.max_t:
+        decision = _rung_decision(self._rungs, self._scores, trial_id,
+                                  step, score, self.reduction_factor)
+        if decision == "stop":
             return "stop"
-        return "continue"
+        return "stop" if step >= self.max_t else "continue"
+
+
+def _rung_decision(rungs: List[int], scores: Dict[int, Dict[int, float]],
+                   trial_id: int, step: int, score: float,
+                   factor: int) -> str:
+    """Successive-halving core shared by ASHA and HyperBand brackets:
+    record the score at each rung crossed (milestone CROSSING, step >=
+    rung, not equality — trainables may report non-consecutive
+    iterations), then require the trial to sit in the top 1/factor of
+    EVERY rung it has passed (checking only the newest rung would
+    shield it while that rung is empty)."""
+    for rung in rungs:
+        if step >= rung and trial_id not in scores[rung]:
+            scores[rung][trial_id] = score
+    for rung in rungs:
+        if rung > step or trial_id not in scores[rung]:
+            continue
+        population = scores[rung]
+        k = max(1, math.ceil(len(population) / factor))
+        cutoff = sorted(population.values(), reverse=True)[:k][-1]
+        if population[trial_id] < cutoff:
+            return "stop"
+    return "continue"
 
 
 @dataclasses.dataclass
@@ -208,6 +218,93 @@ class PopulationBasedTraining:
         return config
 
 
+@dataclasses.dataclass
+class HyperBandScheduler:
+    """HyperBand (reference ``tune/schedulers/hyperband.py``): several
+    successive-halving brackets run side by side, each trading off
+    "many trials, small budget" against "few trials, large budget" —
+    the hedge ASHA gives up by fixing one grace period. Trials are
+    assigned to brackets round-robin on first report; within a bracket
+    a trial must place in the top 1/eta of its rung to continue."""
+
+    time_attr: str = "training_iteration"
+    max_t: int = 81
+    eta: int = 3
+
+    def __post_init__(self):
+        # integer loop, not float log: int(math.log(243, 3)) == 4, which
+        # would silently drop the most-exploratory bracket
+        s_max = 0
+        while self.eta ** (s_max + 1) <= self.max_t:
+            s_max += 1
+        # bracket s: first rung at max_t * eta^-s, halving every eta
+        self._brackets: List[List[int]] = []
+        for s in range(s_max, -1, -1):
+            first = max(1, int(round(self.max_t * self.eta ** (-s))))
+            rungs = []
+            t = first
+            while t < self.max_t:
+                rungs.append(t)
+                t *= self.eta
+            self._brackets.append(rungs)
+        # bracket -> rung -> {trial_id: score}
+        self._scores: List[Dict[int, Dict[int, float]]] = [
+            {r: {} for r in rungs} for rungs in self._brackets]
+        self._assignment: Dict[int, int] = {}
+        self._next_bracket = 0
+
+    def _bracket_of(self, trial_id: int) -> int:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._next_bracket
+            self._assignment[trial_id] = b
+            self._next_bracket = (self._next_bracket + 1) % \
+                len(self._brackets)
+        return b
+
+    def on_result(self, trial_id: int, step: int, score: float) -> str:
+        b = self._bracket_of(trial_id)
+        if _rung_decision(self._brackets[b], self._scores[b], trial_id,
+                          step, score, self.eta) == "stop":
+            return "stop"
+        return "stop" if step >= self.max_t else "continue"
+
+
+@dataclasses.dataclass
+class MedianStoppingRule:
+    """Median stopping (reference ``tune/schedulers/median_stopping_
+    rule.py``, after Vizier): stop a trial whose best score so far is
+    below the median of the other trials' running-average scores.
+    Robust default when the rung geometry of ASHA/HyperBand doesn't fit
+    the workload."""
+
+    time_attr: str = "training_iteration"
+    grace_period: int = 1
+    min_samples_required: int = 3
+    hard_stop: bool = True
+
+    def __post_init__(self):
+        # trial -> list of scores (running mean), trial -> best score
+        self._history: Dict[int, List[float]] = {}
+        self._best: Dict[int, float] = {}
+
+    def on_result(self, trial_id: int, step: int, score: float) -> str:
+        self._history.setdefault(trial_id, []).append(score)
+        self._best[trial_id] = max(
+            self._best.get(trial_id, float("-inf")), score)
+        if step < self.grace_period:
+            return "continue"
+        means = [sum(h) / len(h) for tid, h in self._history.items()
+                 if tid != trial_id and h]
+        if len(means) < self.min_samples_required:
+            return "continue"
+        import statistics
+
+        if self._best[trial_id] < statistics.median(means):
+            return "stop" if self.hard_stop else "continue"
+        return "continue"
+
+
 # ---------------------------------------------------------------- tuner
 
 
@@ -217,7 +314,8 @@ class TuneConfig:
     mode: str = "max"                  # "max" | "min"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Optional[Any] = None     # ASHAScheduler | PopulationBasedTraining
+    scheduler: Optional[Any] = None     # ASHAScheduler | HyperBand | PBT | ...
+    search_alg: Optional[Any] = None    # search_algo.Searcher (None = random)
     seed: int = 0
 
 
@@ -331,14 +429,43 @@ class Tuner:
             last_metrics.update(st["last_metrics"])
             pending = [(tid, configs[tid]) for tid in sorted(configs)
                        if tid not in results]
+            if cfg.search_alg is not None:
+                # re-arm the searcher: replay completed-trial feedback
+                # (model-based searchers refit from it) and leave budget
+                # for the suggestions the interrupted run never made
+                cfg.search_alg.setup(self._space, cfg.metric, cfg.mode)
+                for tid, res in results.items():
+                    try:
+                        cfg.search_alg.on_trial_complete(
+                            tid, res.metrics, res.error)
+                    except Exception:  # noqa: BLE001
+                        pass
+        elif cfg.search_alg is not None:
+            # searcher-driven: configs are suggested LAZILY at launch so
+            # adaptive algorithms see completed-trial feedback first
+            cfg.search_alg.setup(self._space, cfg.metric, cfg.mode)
+            configs = {}
+            pending = []
         else:
             configs = dict(enumerate(
                 expand_param_space(self._space, cfg.num_samples, cfg.seed)))
             pending = sorted(configs.items())
         running: Dict[int, dict] = {}   # trial_id -> {actor, config}
+        if cfg.search_alg is None:
+            suggest_budget = 0
+        else:  # fresh run: all of num_samples; restore: the unsuggested rest
+            suggest_budget = max(0, cfg.num_samples - len(configs))
         deadline = time.monotonic() + timeout_s
 
         def launch() -> int:
+            nonlocal suggest_budget
+            while suggest_budget > 0 and \
+                    len(pending) + len(running) < cfg.max_concurrent_trials:
+                tid = len(configs)
+                config = cfg.search_alg.suggest(tid)
+                configs[tid] = config
+                pending.append((tid, config))
+                suggest_budget -= 1
             # start the whole wave in parallel: sequential worker spawn
             # (~0.5s each) would stagger trials against the poll loop
             started = []
@@ -357,6 +484,12 @@ class Tuner:
             tr = running.pop(tid)
             results[tid] = Result(tr["config"], last_metrics.get(tid, {}),
                                   error=error)
+            if cfg.search_alg is not None:
+                try:
+                    cfg.search_alg.on_trial_complete(
+                        tid, last_metrics.get(tid), error)
+                except Exception:  # noqa: BLE001 — searcher bug must not
+                    pass           # kill the experiment loop
             try:
                 ray_tpu.kill(tr["actor"])
             except Exception:  # noqa: BLE001

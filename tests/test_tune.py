@@ -177,3 +177,94 @@ class TestRestore:
         # the slow trial resumed from its checkpoint, not from zero
         slow = [r for r in grid2 if r.config["slow"]][0]
         assert slow.metrics["resumed_from"] > 0
+
+
+class TestSchedulers:
+    def test_hyperband_brackets_and_stops(self):
+        from ray_tpu.tune import HyperBandScheduler
+
+        sched = HyperBandScheduler(max_t=9, eta=3)
+        # brackets: s=2 -> rungs [1,3]; s=1 -> [3]; s=0 -> []
+        assert sched._brackets == [[1, 3], [3], []]
+        # exact powers must not lose a bracket to float-log imprecision
+        assert len(HyperBandScheduler(max_t=243, eta=3)._brackets) == 6
+        # two trials land in bracket 0; the worse one dies at rung 1
+        # once the better one fills the rung in (retroactive cut)
+        assert sched.on_result(0, 1, score=0.1) == "continue"
+        assert sched.on_result(1, 3, score=0.9) == "continue"  # bracket 1
+        assert sched.on_result(2, 1, score=0.5) == "continue"  # bracket 2->0? no: bracket 2 has no rungs
+        # trial 3 joins bracket 0 with a better score; trial 0's rung-1
+        # record is now below the top-1/3 cutoff
+        assert sched.on_result(3, 1, score=0.8) == "continue"
+        assert sched.on_result(0, 2, score=0.2) == "stop"
+        # max_t reached -> stop regardless
+        assert sched.on_result(3, 9, score=0.9) == "stop"
+
+    def test_median_stopping(self):
+        from ray_tpu.tune import MedianStoppingRule
+
+        rule = MedianStoppingRule(grace_period=2, min_samples_required=2)
+        assert rule.on_result(0, 1, 1.0) == "continue"   # grace period
+        assert rule.on_result(1, 3, 0.9) == "continue"   # 1 other sample
+        # median of others' means [1.0, 0.9] = 0.95: at the bar -> keep
+        assert rule.on_result(2, 3, 0.95) == "continue"
+        # trial 3's best (0.1) far below the median -> stop
+        assert rule.on_result(3, 3, 0.1) == "stop"
+        # a good trial keeps going
+        assert rule.on_result(0, 3, 1.0) == "continue"
+
+
+class TestSearchAlgorithms:
+    def test_halton_covers_domains(self):
+        from ray_tpu.tune import HaltonSearch
+        from ray_tpu.tune.search import choice, loguniform, randint, uniform
+
+        s = HaltonSearch()
+        s.setup({"lr": loguniform(1e-5, 1e-1), "bs": randint(1, 9),
+                 "act": choice(["relu", "gelu"]), "x": uniform(0, 1),
+                 "fixed": 7}, "score", "max")
+        seen_acts = set()
+        for tid in range(16):
+            c = s.suggest(tid)
+            assert 1e-5 <= c["lr"] <= 1e-1
+            assert 1 <= c["bs"] <= 8
+            assert 0.0 <= c["x"] <= 1.0
+            assert c["fixed"] == 7
+            seen_acts.add(c["act"])
+        assert seen_acts == {"relu", "gelu"}
+        # determinism: same trial id -> same point
+        assert s.suggest(3) == s.suggest(3)
+
+    def test_optuna_gated(self):
+        from ray_tpu.tune import OptunaSearch
+
+        try:
+            import optuna  # noqa: F401
+
+            has_optuna = True
+        except ImportError:
+            has_optuna = False
+        if has_optuna:
+            OptunaSearch()
+        else:
+            with pytest.raises(ImportError, match="optuna"):
+                OptunaSearch()
+
+    def test_tuner_with_searcher_finds_best(self, rt):
+        from ray_tpu import tune
+        from ray_tpu.tune import HaltonSearch
+
+        def trainable(config):
+            tune.report({"score": -(config["x"] - 0.7) ** 2})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.uniform(0.0, 1.0)},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=8,
+                max_concurrent_trials=4, search_alg=HaltonSearch()),
+        )
+        grid = tuner.fit(timeout_s=120)
+        assert len(grid) == 8
+        best = grid.get_best_result()
+        assert abs(best.config["x"] - 0.7) < 0.25  # quasi-random coverage
