@@ -53,9 +53,15 @@ def _enable_compile_cache():
 
 
 def run(config_name: str, batch: int, seq: int, steps: int = 10):
+    import os
+
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env var alone is too late when a sitecustomize imported jax
+        # first; force the live config too (same dance as conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     _enable_compile_cache()
 
     from ray_tpu.models import llama
@@ -112,7 +118,33 @@ def run(config_name: str, batch: int, seq: int, steps: int = 10):
     }
 
 
+def _tpu_responsive(timeout_s: float = 240.0) -> bool:
+    """Probe TPU backend init in a SUBPROCESS with a timeout: a wedged
+    device tunnel hangs ``jax.devices()`` indefinitely, and a bench that
+    never prints its JSON line is worse than an honest CPU fallback.
+    Healthy init takes ~20-40s."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    import os
+
+    if not _tpu_responsive():
+        print("TPU backend unresponsive; falling back to CPU debug "
+              "config", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     # A 1B-param model fits one v5e chip with Adam state; fall back to
     # smaller shapes on memory pressure.
     attempts = [("1b_bench", 8, 2048), ("1b_bench", 4, 2048),

@@ -11,10 +11,18 @@ from ray_tpu.data.execution import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
     from_items,
     from_numpy,
+    from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
+    read_numpy,
     read_parquet,
+    read_text,
+    read_tfrecords,
     write_csv,
+    write_json,
     write_parquet,
+    write_tfrecords,
 )

@@ -17,7 +17,28 @@ import pyarrow as pa
 def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
     if not rows:
         return pa.table({})
-    return pa.Table.from_pylist(rows)
+    # Multi-dim ndarray values (images, feature maps) become Arrow
+    # fixed-shape tensor columns (reference: Ray's ArrowTensorArray
+    # extension) when every row agrees on shape; block_to_rows restores
+    # them as ndarrays. Keys are the UNION across rows (missing -> null),
+    # matching pa.Table.from_pylist semantics.
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    cols: Dict[str, list] = {k: [r.get(k) for r in rows] for k in keys}
+    arrays, names = [], []
+    for k, vals in cols.items():
+        if (isinstance(vals[0], np.ndarray) and vals[0].ndim >= 2
+                and all(isinstance(v, np.ndarray)
+                        and v.shape == vals[0].shape for v in vals)):
+            arrays.append(pa.FixedShapeTensorArray.from_numpy_ndarray(
+                np.stack(vals)))
+        else:
+            arrays.append(pa.array(vals))
+        names.append(k)
+    return pa.Table.from_arrays(arrays, names=names)
 
 
 def block_from_batch(batch: Dict[str, np.ndarray]) -> pa.Table:
@@ -34,7 +55,22 @@ def block_from_batch(batch: Dict[str, np.ndarray]) -> pa.Table:
 
 
 def block_to_rows(block: pa.Table) -> List[Dict[str, Any]]:
-    return block.to_pylist()
+    tensor_cols = {}
+    for name in block.column_names:
+        col = block.column(name)
+        if isinstance(col.type, pa.FixedShapeTensorType):
+            tensor_cols[name] = col.combine_chunks().to_numpy_ndarray()
+            block = block.drop_columns([name])
+    if block.num_columns:
+        rows = block.to_pylist()
+    elif tensor_cols:
+        rows = [{} for _ in range(len(next(iter(tensor_cols.values()))))]
+    else:  # fully empty block (e.g. a filter dropped every row)
+        return []
+    for name, arr in tensor_cols.items():
+        for i, row in enumerate(rows):
+            row[name] = arr[i]
+    return rows
 
 
 def block_to_batch(block: pa.Table) -> Dict[str, np.ndarray]:

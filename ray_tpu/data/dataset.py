@@ -69,6 +69,28 @@ class _AllToAll(_Op):
         self.name = name
 
 
+def _fuse_maps(ops: List[_Op]) -> List[_Op]:
+    """Plan optimization (reference: logical OperatorFusionRule —
+    Map->Map fuses into one physical operator): runs of plain map ops
+    compose into ONE task per block, so a map().filter().map() chain
+    costs one scheduling round-trip instead of three. Actor-pool stages
+    never fuse (they run on dedicated actors with their own
+    constructor state)."""
+    out: List[_Op] = []
+    for op in ops:
+        prev = out[-1] if out else None
+        if (isinstance(op, _MapBlock) and op.actor_pool is None
+                and isinstance(prev, _MapBlock) and prev.actor_pool is None):
+            def fused(block, _f=prev.fn, _g=op.fn):
+                return _g(_f(block))
+
+            merged = _MapBlock(fused, f"{prev.name}->{op.name}")
+            out[-1] = merged
+        else:
+            out.append(op)
+    return out
+
+
 class Dataset:
     """Lazy, immutable; every transform returns a new Dataset
     (reference ``Dataset`` semantics)."""
@@ -235,7 +257,7 @@ class Dataset:
         def _run_all(fn, *blocks):
             return fn(list(blocks))
 
-        ops = self._ops
+        ops = _fuse_maps(self._ops)
         assert isinstance(ops[0], (_Read, _FromRefs))
         if isinstance(ops[0], _FromRefs):
             sources, is_read = list(ops[0].refs), False

@@ -105,6 +105,216 @@ def read_json(paths) -> Dataset:
     return Dataset([_Read([make(f) for f in files])])
 
 
+def read_text(paths, *, drop_empty_lines: bool = True) -> Dataset:
+    """One row per line, column ``text`` (reference:
+    ``data/read_api.py read_text``)."""
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            with open(task_path, "r", encoding="utf-8") as f:
+                lines = f.read().splitlines()
+            if drop_empty_lines:
+                lines = [ln for ln in lines if ln]
+            return B.block_from_rows([{"text": ln} for ln in lines])
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file, column ``bytes`` (+ ``path``) (reference:
+    ``read_binary_files``). The block layer holds the payloads as
+    object-dtype values, so arbitrary blobs ride the normal pipeline."""
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            with open(task_path, "rb") as f:
+                data = f.read()
+            row = {"bytes": data}
+            if include_paths:
+                row["path"] = task_path
+            return B.block_from_rows([row])
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def read_numpy(paths, *, column: str = "data") -> Dataset:
+    """.npy files, one block per file (reference: ``read_numpy``)."""
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            arr = np.load(task_path, allow_pickle=False)
+            return B.block_from_batch({column: arr})
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+# ---- TFRecord framing (no tensorflow dependency) -----------------------
+# Each record: u64 length | u32 masked-crc32c(length) | payload |
+# u32 masked-crc32c(payload). CRC32C: the crc32c package when present,
+# else a plain-int table loop (numpy scalar ops are several times
+# slower per byte than Python ints, so the table stays a list).
+
+_CRC32C_TABLE: Optional[list] = None
+
+
+def _crc32c(data: bytes) -> int:
+    try:
+        import crc32c as _c  # type: ignore
+
+        return _c.crc32c(data)
+    except ImportError:
+        pass
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in builtins.range(256):
+            c = i
+            for _ in builtins.range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def read_tfrecords(paths, *, column: str = "data",
+                   verify_crc: bool = True) -> Dataset:
+    """TFRecord files -> one row per record with the raw payload bytes
+    in ``column`` (reference: ``datasource/tfrecords_datasource.py``;
+    the framing is read natively, no tensorflow import)."""
+    import struct
+
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            rows = []
+            with open(task_path, "rb") as f:
+                while True:
+                    head = f.read(12)
+                    if len(head) < 12:
+                        break
+                    (length,), (lcrc,) = (struct.unpack("<Q", head[:8]),
+                                          struct.unpack("<I", head[8:]))
+                    payload = f.read(length)
+                    crc_buf = f.read(4)
+                    if len(payload) != length or len(crc_buf) != 4:
+                        raise ValueError(
+                            f"truncated TFRecord in {task_path}")
+                    (pcrc,) = struct.unpack("<I", crc_buf)
+                    if verify_crc and (
+                            _masked_crc(head[:8]) != lcrc
+                            or _masked_crc(payload) != pcrc):
+                        raise ValueError(
+                            f"corrupt TFRecord in {task_path}")
+                    rows.append({column: payload})
+            return B.block_from_rows(rows)
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def write_tfrecords(ds: Dataset, path: str, *,
+                    column: str = "data") -> List[str]:
+    """Write ``column`` (bytes per row) as TFRecord files, one per
+    block, with valid masked CRCs."""
+    import struct
+
+    def write_fn(block, fname):
+        rows = B.block_to_rows(block)
+        with open(fname, "wb") as f:
+            for row in rows:
+                payload = row[column]
+                if not isinstance(payload, (bytes, bytearray)):
+                    payload = bytes(payload)
+                head = struct.pack("<Q", len(payload))
+                f.write(head)
+                f.write(struct.pack("<I", _masked_crc(head)))
+                f.write(payload)
+                f.write(struct.pack("<I", _masked_crc(payload)))
+
+    return _write(ds, path, "tfrecord", write_fn)
+
+
+def read_images(paths, *, include_paths: bool = False,
+                size: Optional[tuple] = None) -> Dataset:
+    """Image files -> rows with an ``image`` HWC uint8 array
+    (reference: ``datasource/image_datasource.py``). Gated on PIL;
+    raises a clear ImportError when Pillow is unavailable."""
+    try:
+        from PIL import Image  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_images requires Pillow, which is not installed; use "
+            "read_binary_files and decode in map()") from e
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            from PIL import Image
+
+            img = Image.open(task_path).convert("RGB")
+            if size is not None:
+                img = img.resize(size)
+            row = {"image": np.asarray(img, dtype=np.uint8)}
+            if include_paths:
+                row["path"] = task_path
+            return B.block_from_rows([row])
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
+def from_pandas(df) -> Dataset:
+    """pandas DataFrame -> single-block dataset (gated on pandas)."""
+    import pyarrow as pa
+
+    return Dataset([_Read([lambda: pa.Table.from_pandas(df)])])
+
+
+def write_json(ds: Dataset, path: str) -> List[str]:
+    """JSON-lines writer. ndarrays become lists; bytes become base64
+    strings (JSON has no binary type)."""
+    import base64
+    import json as _json
+
+    def enc(v):
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, (bytes, bytearray)):
+            return base64.b64encode(bytes(v)).decode("ascii")
+        return v
+
+    def write_fn(block, fname):
+        rows = B.block_to_rows(block)
+        with open(fname, "w", encoding="utf-8") as f:
+            for row in rows:
+                f.write(_json.dumps(
+                    {k: enc(v) for k, v in row.items()}) + "\n")
+
+    return _write(ds, path, "json", write_fn)
+
+
 def _write(ds: Dataset, path: str, ext: str, write_fn) -> List[str]:
     import ray_tpu
 

@@ -275,7 +275,7 @@ class GcsServer:
             "wait_placement_group_ready", "list_placement_groups",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
             "add_task_events", "get_task_events",
-            "get_system_config", "health_check",
+            "get_system_config", "health_check", "debug_state",
         ):
             s.register(name, getattr(self, f"h_{name}"))
 
@@ -845,6 +845,19 @@ class GcsServer:
 
     async def h_kv_exists(self, namespace: str, key):
         return self.kv.exists(namespace, key)
+
+    async def h_debug_state(self):
+        """Control-plane introspection (reference: GCS debug_state dump +
+        instrumented_io_context event stats): table sizes plus per-RPC-
+        handler loop time, the `ray stack`-style view of where the GCS
+        event loop goes."""
+        return {
+            "num_nodes": sum(1 for _ in self.view.all_nodes()),
+            "num_actors": len(self._actors),
+            "num_placement_groups": len(self._pgs),
+            "num_jobs": len(self._jobs),
+            "io_stats": dict(self._io.stats),
+        }
 
     # ----------------------------------------------------------- task events
     async def h_add_task_events(self, events: List[dict]):

@@ -154,6 +154,47 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """Cluster-wide debug dump (reference: `ray stack` + the
+    instrumented_io_context event stats): GCS table sizes and, per
+    daemon, where its event loop spends time by handler."""
+    from ray_tpu.gcs.client import GcsClient
+    from ray_tpu.rpc.rpc import RpcClient
+
+    def print_io(title: str, io_stats: dict, top: int = 12):
+        rows = sorted(io_stats.items(), key=lambda kv: -kv[1][1])[:top]
+        print(f"  {title}: handler calls / total-s (top {len(rows)})")
+        for name, (count, total) in rows:
+            print(f"    {name:<36} {count:>8}  {total:9.3f}s")
+
+    host, _, port = args.address.partition(":")
+    c = GcsClient((host, int(port)))
+    try:
+        gcs_state = c.call("debug_state")
+        print("GCS:", {k: v for k, v in gcs_state.items()
+                       if k != "io_stats"})
+        print_io("gcs", gcs_state.get("io_stats", {}))
+        for n in c.get_all_nodes():
+            if not n["alive"]:
+                continue
+            try:
+                rc = RpcClient(tuple(n["address"]))
+                st = rc.call("debug_state", timeout=10.0)
+                rc.close()
+            except Exception as e:  # noqa: BLE001 — skip unreachable
+                print(f"raylet {n['node_id'].hex()[:8]}: unreachable ({e})")
+                continue
+            print(f"raylet {n['node_id'].hex()[:8]}: "
+                  f"{len(st.get('workers', {}))} workers, "
+                  f"{st.get('pending_leases', 0)} pending leases, "
+                  f"{st.get('oom_kills', 0)} oom kills")
+            print_io(f"raylet {n['node_id'].hex()[:8]}",
+                     st.get("io_stats", {}))
+    finally:
+        c.close()
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job import JobSubmissionClient
 
@@ -217,6 +258,11 @@ def main(argv=None) -> int:
     pstat = sub.add_parser("status", help="cluster resource summary")
     pstat.add_argument("--address", required=True)
     pstat.set_defaults(fn=cmd_status)
+
+    pdbg = sub.add_parser(
+        "debug", help="event-loop / handler timing dump per daemon")
+    pdbg.add_argument("--address", required=True, help="GCS host:port")
+    pdbg.set_defaults(fn=cmd_debug)
 
     pj = sub.add_parser("job", help="job submission commands")
     pj.add_argument("job_cmd",

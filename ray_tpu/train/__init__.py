@@ -13,11 +13,19 @@ from ray_tpu.train.checkpoint import (  # noqa: F401
     CheckpointConfig,
     CheckpointManager,
 )
+from ray_tpu.train.collectives import (  # noqa: F401
+    barrier,
+    broadcast_from_rank_zero,
+)
 from ray_tpu.train.context import (  # noqa: F401
     TrainContext,
     checkpoint_dir,
     get_context,
     report,
+)
+from ray_tpu.train.scaling_policy import (  # noqa: F401
+    ElasticScalingPolicy,
+    FixedScalingPolicy,
 )
 from ray_tpu.train.trainer import (  # noqa: F401
     FailureConfig,

@@ -1,0 +1,79 @@
+"""Train-worker collective sugar (reference:
+``python/ray/train/collective/collectives.py`` —
+``broadcast_from_rank_zero:20``, ``barrier:82``).
+
+Control-plane-sized values only (configs, seeds, small metadata): these
+ride the GCS KV rendezvous namespace, like the reference routes them
+through the driver/actors rather than the tensor fabric. Tensor-sized
+data belongs INSIDE the jitted program as XLA collectives
+(ray_tpu.collective) — broadcasting gigabytes through the KV store is
+the anti-pattern this docstring exists to warn about.
+
+Each call auto-synchronizes on a per-experiment epoch counter, so
+repeated broadcasts/barriers in a training loop need no explicit keys.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Optional
+
+from ray_tpu.train.context import get_context
+
+
+def _kv():
+    from ray_tpu.core_worker.worker import CoreWorker
+
+    return CoreWorker.current_or_raise().gcs
+
+
+_epochs = {"broadcast": 0, "barrier": 0}
+
+
+def broadcast_from_rank_zero(data: Any = None, *,
+                             timeout_s: float = 120.0) -> Any:
+    """Rank 0 passes ``data``; every rank returns rank 0's value."""
+    ctx = get_context()
+    _epochs["broadcast"] += 1
+    # run_id keys the namespace per gang INSTANCE: a restart or a rerun
+    # of the same experiment name must never read a previous attempt's
+    # rendezvous keys (they are left behind — control-plane sized)
+    ns = f"rt_train_bcast:{ctx.get_experiment_name()}:{ctx.get_run_id()}"
+    key = f"epoch:{_epochs['broadcast']}".encode()
+    kv = _kv()
+    if ctx.get_world_rank() == 0:
+        kv.kv_put(ns, key, pickle.dumps(data))
+        return data
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        blob = kv.kv_get(ns, key)
+        if blob is not None:
+            return pickle.loads(blob)
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"broadcast_from_rank_zero: rank 0 never published epoch "
+        f"{_epochs['broadcast']}")
+
+
+def barrier(*, timeout_s: float = 120.0,
+            tag: Optional[str] = None) -> None:
+    """Block until every worker in the gang has arrived. ``tag`` only
+    labels the barrier for debugging; every call advances the epoch
+    counter, so the same tag in a loop still synchronizes each pass."""
+    ctx = get_context()
+    _epochs["barrier"] += 1
+    epoch = f"{tag or 'b'}:{_epochs['barrier']}"
+    ns = (f"rt_train_barrier:{ctx.get_experiment_name()}:"
+          f"{ctx.get_run_id()}:{epoch}")
+    kv = _kv()
+    kv.kv_put(ns, f"arrived:{ctx.get_world_rank()}".encode(), b"1")
+    world = ctx.get_world_size()
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(kv.kv_keys(ns, prefix=b"arrived:")) >= world:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"barrier {epoch!r}: not all {world} workers arrived in "
+        f"{timeout_s}s")

@@ -32,6 +32,9 @@ class TrainContext:
     # JAX mesh bootstrap (multi-host SPMD): rank 0's RPC coordinator.
     coordinator: Optional[str] = None
     resume_from: Optional[Checkpoint] = None
+    # unique per gang INSTANCE (fresh on every restart/resize): keys
+    # collective rendezvous namespaces so attempts never see stale state
+    run_id: str = ""
 
     # populated by the worker harness
     _reports: List[dict] = dataclasses.field(default_factory=list)
@@ -42,6 +45,9 @@ class TrainContext:
 
     def get_experiment_name(self) -> str:
         return self.experiment_name
+
+    def get_run_id(self) -> str:
+        return self.run_id
 
     def get_world_rank(self) -> int:
         return self.world_rank

@@ -148,9 +148,13 @@ class WorkerGroup:
             coordinator = ray_tpu.get(
                 self.workers[0].get_coordinator_address.remote())
 
+        import uuid
+
+        run_id = uuid.uuid4().hex[:12]  # fresh per gang instance
         setups = []
         for rank, w in enumerate(self.workers):
             setups.append(w.setup.remote({
+                "run_id": run_id,
                 "experiment_name": experiment_name,
                 "world_rank": rank,
                 "world_size": self.num_workers,

@@ -100,3 +100,17 @@ def test_job_cli_roundtrip(head):
          "--address", head["dash"]],
         capture_output=True, text=True, timeout=60, env=ENV)
     assert sid in out.stdout
+
+
+def test_debug_dump(head):
+    """`rt debug` prints GCS table sizes and per-daemon event-loop
+    handler timings (the `ray stack` / event-stats equivalent)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "debug",
+         "--address", head["addr"]],
+        capture_output=True, env=ENV, timeout=60)
+    text = out.stdout.decode()
+    assert out.returncode == 0, out.stderr.decode()
+    assert "GCS:" in text and "num_nodes" in text
+    assert "gcs: handler calls" in text
+    assert "raylet " in text and "workers" in text

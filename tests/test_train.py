@@ -173,3 +173,28 @@ def test_checkpoint_pytree_roundtrip(tmp_path):
     back = ck.to_pytree()
     np.testing.assert_allclose(back["a"], tree["a"])
     assert float(back["b"]["c"]) == 2.5
+
+
+class TestTrainCollectives:
+    def test_broadcast_and_barrier_across_gang(self, rt, tmp_path):
+        def train_fn(config):
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            # rank 0 decides a value; everyone must see it
+            token = train.broadcast_from_rank_zero(
+                {"seed": 1234} if ctx.get_world_rank() == 0 else None)
+            train.barrier()
+            # a second epoch must not collide with the first
+            token2 = train.broadcast_from_rank_zero(
+                "round2" if ctx.get_world_rank() == 0 else None)
+            train.report({"seed": token["seed"], "second": token2,
+                          "rank": ctx.get_world_rank()})
+
+        result = JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="coll", storage_path=str(tmp_path)),
+        ).fit(timeout_s=120)
+        assert result.metrics["seed"] == 1234
+        assert result.metrics["second"] == "round2"
