@@ -189,6 +189,12 @@ def shutdown() -> None:
             return
         cw = CoreWorker._current
         if cw is not None:
+            if _head is not None:
+                # before cw.shutdown(): the report snapshots cluster
+                # shape through the still-live core worker
+                from ray_tpu.util import usage
+
+                usage.write_report(_head["raylet"].session_dir)
             try:
                 cw.gcs.finish_job(cw.job_id)
             except Exception:  # noqa: BLE001

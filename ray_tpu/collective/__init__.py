@@ -34,3 +34,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     send,
 )
 from ray_tpu.collective.types import Backend, ReduceOp  # noqa: F401
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("collective")
+del _record_usage

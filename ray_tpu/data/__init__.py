@@ -26,3 +26,7 @@ from ray_tpu.data.datasource import (  # noqa: F401
     write_parquet,
     write_tfrecords,
 )
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("data")
+del _record_usage

@@ -9,3 +9,7 @@ offline batch inference pipelines over ``ray_tpu.data`` datasets.
 from .batch import (ByteTokenizer, ProcessorConfig, build_llm_processor)
 
 __all__ = ["ByteTokenizer", "ProcessorConfig", "build_llm_processor"]
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("llm")
+del _record_usage

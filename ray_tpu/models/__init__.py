@@ -7,7 +7,7 @@ compute path (sharding annotations, scan-over-layers, Pallas attention,
 remat policy) IS the framework's value on TPU.
 """
 
-from ray_tpu.models import llama  # noqa: F401
+from ray_tpu.models import llama, vit  # noqa: F401
 from ray_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     init_params,
@@ -15,3 +15,4 @@ from ray_tpu.models.llama import (  # noqa: F401
     loss_fn,
     param_logical_axes,
 )
+from ray_tpu.models.vit import ViTConfig  # noqa: F401

@@ -1,0 +1,226 @@
+"""Vision Transformer (ViT) — the second in-tree model family,
+exercising the NON-causal attention path and the image data pipeline.
+
+Reference framing: the reference framework ships no in-tree vision
+model either (its AIR examples import torchvision models); this is the
+TPU-first equivalent demonstrating that the same compute-path building
+blocks (flash attention, scanned+rematerialized blocks, logical-axis
+GSPMD sharding from ``ray_tpu.parallel.sharding``) serve encoders as
+well as decoders:
+
+- **Patchify as one matmul**: the conv-stem is a reshape +
+  ``(patches, P²·C) @ (P²·C, hidden)`` einsum — MXU-native, no conv
+  lowering needed.
+- **Scan over layers** with ``jax.checkpoint``, like llama.py: O(1)
+  compile time in depth.
+- **Non-causal flash attention** (``causal=False``): the same Pallas
+  kernel, unmasked.
+- **Same logical axis names** as the Llama family, so one ShardingRules
+  table shards either model (dp/fsdp/tp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.norms import layernorm
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_block: int = 512
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + 1  # + [CLS]
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    def num_params(self) -> int:
+        per_layer = (4 * self.hidden * self.hidden        # qkv + proj
+                     + 2 * self.hidden * self.mlp_dim     # mlp in/out
+                     + self.mlp_dim + self.hidden         # mlp biases
+                     + 4 * self.hidden)                   # 2 LN (w, b)
+        return (self.patch_dim * self.hidden                     # patch embed
+                + self.seq_len * self.hidden + self.hidden       # pos + cls
+                + self.n_layers * per_layer
+                + 2 * self.hidden                                # final LN
+                + self.hidden * self.num_classes + self.num_classes)
+
+    def flops_per_image(self) -> float:
+        """Training FLOPs per IMAGE: every one of the seq_len tokens
+        passes through all N params (6·N·s) plus non-causal attention
+        (12·L·s²·h fwd+bwd). Divide by seq_len for the per-token form
+        llama.flops_per_token uses."""
+        s = self.seq_len
+        return s * (6.0 * self.num_params()
+                    + 12.0 * self.n_layers * s * self.hidden)
+
+
+CONFIGS: Dict[str, ViTConfig] = {
+    "debug": ViTConfig(image_size=32, patch_size=8, hidden=64, n_layers=2,
+                       n_heads=4, mlp_dim=128, num_classes=10,
+                       dtype=jnp.float32, remat=False),
+    "S16": ViTConfig(hidden=384, n_layers=12, n_heads=6, mlp_dim=1536),
+    "B16": ViTConfig(),  # ViT-Base/16
+    "L16": ViTConfig(hidden=1024, n_layers=24, n_heads=16, mlp_dim=4096),
+}
+
+
+def param_logical_axes(config: ViTConfig) -> Params:
+    """Same logical-axis vocabulary as models/llama.py, so the one
+    ShardingRules table lays out both families."""
+    del config
+    return {
+        "patch_embed": ("embed_vocab", "embed_fsdp"),
+        "cls_token": ("embed",),
+        "pos_embed": (None, "embed"),  # position axis never sharded
+        "layers": {
+            "ln1_w": ("layers", "embed"), "ln1_b": ("layers", "embed"),
+            "wq": ("layers", "embed_fsdp", "heads", "head_dim"),
+            "wk": ("layers", "embed_fsdp", "heads", "head_dim"),
+            "wv": ("layers", "embed_fsdp", "heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed_fsdp"),
+            "ln2_w": ("layers", "embed"), "ln2_b": ("layers", "embed"),
+            "w_in": ("layers", "embed_fsdp", "mlp"),
+            "b_in": ("layers", "mlp"),
+            "w_out": ("layers", "mlp", "embed_fsdp"),
+            "b_out": ("layers", "embed"),
+        },
+        "final_ln_w": ("embed",), "final_ln_b": ("embed",),
+        "head_w": ("embed_fsdp", "vocab"), "head_b": ("vocab",),
+    }
+
+
+def init_params(config: ViTConfig, key: jax.Array) -> Params:
+    c = config
+    k = iter(jax.random.split(key, 16))
+    dt = c.dtype
+
+    def tn(key, shape, std):
+        return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32)
+                * std).astype(dt)
+
+    std = c.hidden ** -0.5
+    out_std = std / (2 * c.n_layers) ** 0.5
+    L, H, D = c.n_layers, c.n_heads, c.head_dim
+    return {
+        "patch_embed": tn(next(k), (c.patch_dim, c.hidden),
+                          c.patch_dim ** -0.5),
+        "cls_token": jnp.zeros((c.hidden,), dt),
+        "pos_embed": tn(next(k), (c.seq_len, c.hidden), 0.02),
+        "layers": {
+            "ln1_w": jnp.ones((L, c.hidden), dt),
+            "ln1_b": jnp.zeros((L, c.hidden), dt),
+            "wq": tn(next(k), (L, c.hidden, H, D), std),
+            "wk": tn(next(k), (L, c.hidden, H, D), std),
+            "wv": tn(next(k), (L, c.hidden, H, D), std),
+            "wo": tn(next(k), (L, H, D, c.hidden), out_std),
+            "ln2_w": jnp.ones((L, c.hidden), dt),
+            "ln2_b": jnp.zeros((L, c.hidden), dt),
+            "w_in": tn(next(k), (L, c.hidden, c.mlp_dim), std),
+            "b_in": jnp.zeros((L, c.mlp_dim), dt),
+            "w_out": tn(next(k), (L, c.mlp_dim, c.hidden), out_std),
+            "b_out": jnp.zeros((L, c.hidden), dt),
+        },
+        "final_ln_w": jnp.ones((c.hidden,), dt),
+        "final_ln_b": jnp.zeros((c.hidden,), dt),
+        "head_w": jnp.zeros((c.hidden, c.num_classes), dt),
+        "head_b": jnp.zeros((c.num_classes,), dt),
+    }
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, C) -> (B, num_patches, P²·C) by pure reshapes."""
+    b, h, w, ch = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * ch)
+
+
+def forward(params: Params, images: jax.Array, config: ViTConfig,
+            rules: Optional[ShardingRules] = None) -> jax.Array:
+    """images (B, H, W, C) float in [0, 1] -> logits (B, num_classes)."""
+    c = config
+    rules = rules or ShardingRules()
+    x = patchify(images.astype(c.dtype), c.patch_size)
+    x = jnp.einsum("bpd,de->bpe", x, params["patch_embed"].astype(c.dtype))
+    cls = jnp.broadcast_to(params["cls_token"].astype(c.dtype),
+                           (x.shape[0], 1, c.hidden))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(c.dtype)[None]
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+
+    def block(x, layer):
+        h = layernorm(x, layer["ln1_w"], layer["ln1_b"], c.norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(x.dtype))
+        kk = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(x.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(x.dtype))
+        a = flash_attention(q, kk, v, causal=False, block=c.attn_block)
+        x = x + jnp.einsum("bshd,hde->bse", a,
+                           layer["wo"].astype(x.dtype))
+        h = layernorm(x, layer["ln2_w"], layer["ln2_b"], c.norm_eps)
+        h = jnp.einsum("bse,em->bsm", h, layer["w_in"].astype(x.dtype)) \
+            + layer["b_in"].astype(x.dtype)
+        h = jax.nn.gelu(h)
+        x = x + (jnp.einsum("bsm,me->bse", h,
+                            layer["w_out"].astype(x.dtype))
+                 + layer["b_out"].astype(x.dtype))
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        return x, None
+
+    if c.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = layernorm(x, params["final_ln_w"], params["final_ln_b"], c.norm_eps)
+    cls_repr = x[:, 0]
+    # bf16 operands, f32 accumulation for the logits (MXU-native)
+    return jnp.einsum("be,ec->bc", cls_repr,
+                      params["head_w"].astype(cls_repr.dtype),
+                      preferred_element_type=jnp.float32) \
+        + params["head_b"].astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            config: ViTConfig,
+            rules: Optional[ShardingRules] = None):
+    """Cross-entropy over ``{"images": (B,H,W,C), "labels": (B,)}``."""
+    logits = forward(params, batch["images"], config, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["labels"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    loss = nll.mean()
+    acc = (logits.argmax(-1) == batch["labels"]).mean()
+    return loss, {"loss": loss, "accuracy": acc}

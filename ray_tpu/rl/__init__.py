@@ -48,3 +48,7 @@ from ray_tpu.rl.offline import (  # noqa: F401
     JsonWriter,
     collect,
 )
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("rl")
+del _record_usage

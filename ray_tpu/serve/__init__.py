@@ -26,3 +26,7 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
     multiplexed,
 )
 from ray_tpu.serve.proxy import Request, Response  # noqa: F401
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("serve")
+del _record_usage

@@ -27,6 +27,11 @@ from ray_tpu.train.scaling_policy import (  # noqa: F401
     ElasticScalingPolicy,
     FixedScalingPolicy,
 )
+from ray_tpu.train.torch_trainer import (  # noqa: F401
+    TorchTrainer,
+    prepare_data_loader,
+    prepare_model,
+)
 from ray_tpu.train.trainer import (  # noqa: F401
     FailureConfig,
     JaxTrainer,
@@ -35,3 +40,7 @@ from ray_tpu.train.trainer import (  # noqa: F401
     ScalingConfig,
     TrainingFailedError,
 )
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("train")
+del _record_usage

@@ -29,3 +29,7 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     get_checkpoint,
     report,
 )
+
+from ray_tpu.util.usage import record_library_usage as _record_usage
+_record_usage("tune")
+del _record_usage

@@ -139,3 +139,24 @@ class TestShmIntegration:
         gc.collect()
         time.sleep(0.2)
         assert not cw.shm.contains(oid.binary())
+
+
+class TestUsageStats:
+    def test_report_schema_and_optout(self, tmp_path, monkeypatch):
+        from ray_tpu.util import usage
+
+        usage.record_library_usage("data")
+        usage.record_feature_usage("device_objects")
+        rep = usage.build_report()
+        assert rep["schema_version"] == 1
+        assert "data" in rep["library_usages"]
+        assert "device_objects" in rep["feature_usages"]
+        assert rep["ray_tpu_version"]
+        path = usage.write_report(str(tmp_path))
+        import json
+
+        assert json.load(open(path))["python_version"]
+        # opt-out contract (reference: RAY_USAGE_STATS_ENABLED=0)
+        monkeypatch.setenv("RT_usage_stats_enabled", "0")
+        assert usage.write_report(str(tmp_path / "other")) == ""
+        assert not (tmp_path / "other").exists()

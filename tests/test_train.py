@@ -198,3 +198,92 @@ class TestTrainCollectives:
         ).fit(timeout_s=120)
         assert result.metrics["seed"] == 1234
         assert result.metrics["second"] == "round2"
+
+
+class TestTorchTrainer:
+    def test_ddp_gradient_sync_across_gang(self, rt, tmp_path):
+        """Reference-parity surface: a torch train_loop_per_worker with
+        prepare_model (DDP/gloo) — gradients must average across the
+        gang, so both workers end with IDENTICAL weights."""
+        from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+        def train_fn(config):
+            import numpy as np
+            import torch
+            from ray_tpu import train
+            from ray_tpu.train import prepare_model
+
+            ctx = train.get_context()
+            torch.manual_seed(0)  # same init on every rank
+            model = prepare_model(torch.nn.Linear(4, 1))
+            opt = torch.optim.SGD(model.parameters(), lr=0.1)
+            # DIFFERENT data per rank: without DDP allreduce the
+            # weights would diverge immediately
+            g = torch.Generator().manual_seed(ctx.get_world_rank())
+            x = torch.randn(64, 4, generator=g)
+            y = x @ torch.arange(4.0)[:, None] + 1.0
+            for _ in range(10):
+                opt.zero_grad()
+                loss = ((model(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+            # the actual sync proof: despite different data, DDP's
+            # gradient allreduce must leave every rank with IDENTICAL
+            # weights — checked in-gang via all_gather
+            import torch.distributed as dist
+
+            flat = torch.cat([p.detach().ravel()
+                              for p in model.parameters()])
+            gathered = [torch.zeros_like(flat)
+                        for _ in range(dist.get_world_size())]
+            dist.all_gather(gathered, flat)
+            assert torch.allclose(gathered[0], gathered[1]), \
+                "DDP ranks diverged"
+            # loader sharding: half the dataset per rank, re-shuffled
+            # each epoch via the set_epoch contract
+            from torch.utils.data import DataLoader, TensorDataset
+
+            from ray_tpu.train import prepare_data_loader
+
+            dl = prepare_data_loader(DataLoader(
+                TensorDataset(torch.arange(16.0)[:, None]),
+                batch_size=2, shuffle=True))
+            e1 = [v.item() for b in dl for v in b[0].ravel()]
+            e2 = [v.item() for b in dl for v in b[0].ravel()]
+            assert len(e1) == 8, len(e1)
+            assert e1 != e2, "epochs must re-shuffle"
+            w = [p.detach().numpy().copy() for p in model.parameters()]
+            train.report({"rank": ctx.get_world_rank(),
+                          "loss": float(loss),
+                          "w0": float(np.asarray(w[0]).ravel()[0])})
+
+        trainer = TorchTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="torch-ddp",
+                                 storage_path=str(tmp_path)))
+        result = trainer.fit(timeout_s=180)
+        # the sync proof is the in-gang all_gather assert above; driver
+        # side just checks the run finished with a finite loss
+        assert np.isfinite(result.metrics["loss"])
+
+    def test_single_worker_no_pg(self, rt, tmp_path):
+        from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+        def train_fn(config):
+            import torch
+            import torch.distributed as dist
+            from ray_tpu import train
+            from ray_tpu.train import prepare_model
+
+            model = prepare_model(torch.nn.Linear(2, 1))
+            assert not (dist.is_available() and dist.is_initialized())
+            train.report({"ok": isinstance(model, torch.nn.Linear)})
+
+        result = TorchTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="torch-solo",
+                                 storage_path=str(tmp_path))
+        ).fit(timeout_s=120)
+        assert result.metrics["ok"] is True
