@@ -1,4 +1,6 @@
-"""Utilities: state API, metrics, misc helpers."""
+"""Utilities: state API, metrics, queue/actor-pool helpers, tracing."""
 
 from ray_tpu.util import state  # noqa: F401
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 from ray_tpu.util.metrics import Counter, Gauge, Histogram  # noqa: F401
+from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401

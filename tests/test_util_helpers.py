@@ -1,0 +1,82 @@
+"""Public utility surface: util.Queue and util.ActorPool (reference:
+python/ray/util/queue.py, actor_pool.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestQueue:
+    def test_fifo_roundtrip_and_batches(self, rt):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert q.qsize() == 5 and not q.empty()
+        assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.empty()
+        assert q.put_nowait_batch([10, 11, 12]) == 3
+        assert q.get_nowait_batch(10) == [10, 11, 12]
+        q.shutdown()
+
+    def test_blocking_timeout_and_full(self, rt):
+        q = Queue(maxsize=1)
+        q.put("x")
+        assert q.full()
+        with pytest.raises(Full):
+            q.put("y", timeout=0.3)
+        assert q.get() == "x"
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        q.shutdown()
+
+    def test_cross_worker_producer_consumer(self, rt):
+        q = Queue()
+
+        @rt.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i * i)
+            return True
+
+        ref = producer.remote(q, 4)
+        got = sorted(q.get(timeout=30) for _ in range(4))
+        assert got == [0, 1, 4, 9]
+        assert rt.get(ref, timeout=30)
+        q.shutdown()
+
+
+class TestActorPool:
+    def test_map_ordered_and_unordered(self, rt):
+        @rt.remote
+        class Worker:
+            def double(self, x):
+                return 2 * x
+
+        pool = ActorPool([Worker.remote() for _ in range(2)])
+        assert list(pool.map(lambda a, v: a.double.remote(v),
+                             range(8))) == [2 * i for i in range(8)]
+        out = sorted(pool.map_unordered(
+            lambda a, v: a.double.remote(v), range(8)))
+        assert out == [2 * i for i in range(8)]
+
+    def test_submit_queues_beyond_pool_size(self, rt):
+        @rt.remote
+        class Worker:
+            def echo(self, x):
+                return x
+
+        pool = ActorPool([Worker.remote()])
+        for i in range(5):  # 5 tasks, 1 actor: 4 queue client-side
+            pool.submit(lambda a, v: a.echo.remote(v), i)
+        assert [pool.get_next(timeout=30) for _ in range(5)] == list(range(5))
+        assert not pool.has_next()
+        with pytest.raises(StopIteration):
+            pool.get_next()
