@@ -118,32 +118,48 @@ def run(config_name: str, batch: int, seq: int, steps: int = 10):
     }
 
 
-def _tpu_responsive(timeout_s: float = 240.0) -> bool:
+def _tpu_responsive(timeout_s: float = 240.0, retries: int = 3):
     """Probe TPU backend init in a SUBPROCESS with a timeout: a wedged
     device tunnel hangs ``jax.devices()`` indefinitely, and a bench that
-    never prints its JSON line is worse than an honest CPU fallback.
-    Healthy init takes ~20-40s."""
+    never prints its JSON line is worse than a loud CPU fallback.
+    Healthy init takes ~20-40s. Retries the probe (a tunnel can be
+    transiently down) and returns (ok, reason) so the caller can record
+    WHY the TPU was unavailable instead of silently impersonating a
+    result (round-2 lesson: BENCH_r02.json recorded a CPU number)."""
     import os
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return p.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        return False, "JAX_PLATFORMS=cpu set in environment"
+    reason = "unknown"
+    for attempt in range(retries):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()"],
+                timeout=timeout_s, capture_output=True)
+            if p.returncode == 0:
+                return True, ""
+            reason = (f"probe attempt {attempt + 1}/{retries} exited "
+                      f"{p.returncode}: "
+                      + p.stderr.decode(errors="replace")[-500:])
+        except subprocess.TimeoutExpired:
+            reason = (f"probe attempt {attempt + 1}/{retries} timed out "
+                      f"after {timeout_s:.0f}s (device tunnel wedged?)")
+        print(reason, file=sys.stderr)
+        if attempt < retries - 1:  # no pointless backoff after the last try
+            time.sleep(min(10.0 * (attempt + 1), 30.0))
+    return False, reason
 
 
 def main():
     import os
 
-    if not _tpu_responsive():
-        print("TPU backend unresponsive; falling back to CPU debug "
-              "config", file=sys.stderr)
+    tpu_ok, tpu_fail_reason = _tpu_responsive()
+    if not tpu_ok:
+        print("TPU backend unresponsive after retries; running CPU debug "
+              "config and exiting non-zero so the driver records the "
+              "failure instead of a fake number", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
     # A 1B-param model fits one v5e chip with Adam state; fall back to
     # smaller shapes on memory pressure.
@@ -161,6 +177,13 @@ def main():
     for name, batch, seq in attempts:
         try:
             result = run(name, batch, seq)
+            if not tpu_ok:
+                # Loud fallback: the number below is a CPU smoke value, not
+                # the headline metric. Say so in the artifact and fail.
+                result["tpu_unavailable"] = tpu_fail_reason
+                result["vs_baseline"] = 0.0
+                print(json.dumps(result))
+                return 1
             print(json.dumps(result))
             return 0
         except Exception as e:  # noqa: BLE001 — OOM/compile fallback ladder
@@ -168,6 +191,7 @@ def main():
             continue
     print(json.dumps({"metric": "llama_train_mfu_1chip", "value": 0.0,
                       "unit": "percent_mfu", "vs_baseline": 0.0,
+                      "tpu_unavailable": tpu_fail_reason or None,
                       "error": str(last_err)[:300]}))
     return 1
 

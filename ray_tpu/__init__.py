@@ -17,6 +17,7 @@ from ray_tpu._version import __version__  # noqa: F401
 
 _API_SYMBOLS = {
     "ObjectRef",
+    "ObjectRefGenerator",
     "available_resources",
     "cancel",
     "cluster_resources",

@@ -23,6 +23,7 @@ from ray_tpu.core_worker.actor import (
     _resources_from_options,
     _strategy_from_options,
 )
+from ray_tpu.core_worker.generator import ObjectRefGenerator
 from ray_tpu.core_worker.reference import ObjectRef
 
 logger = logging.getLogger(__name__)
@@ -260,11 +261,13 @@ class RemoteFunction:
         if self._serialized is None:
             self._serialized = cloudpickle.dumps(self._fn)
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         refs = cw.submit_task(
             self._fn,
             args,
             kwargs,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             resources=_resources_from_options(opts),
             label_selector=opts.get("label_selector"),
             scheduling_strategy=_strategy_from_options(opts),
@@ -273,6 +276,8 @@ class RemoteFunction:
             serialized_func=self._serialized,
             runtime_env=opts.get("runtime_env"),
         )
+        if streaming:
+            return refs  # an ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

@@ -145,6 +145,9 @@ _D("device_object_cache_entries", int, 32,
 _D("object_spilling_threshold", float, 0.8, "fullness ratio that triggers spill")
 _D("object_spilling_dir", str, "", "external storage dir ('' = session dir)")
 _D("max_direct_call_object_size", int, 100 * 1024, "inline-in-RPC threshold bytes")
+_D("streaming_generator_backpressure", int, 16,
+   "max unconsumed streamed items before the owner delays report replies"
+   " (0 = unlimited)")
 _D("memory_store_max_bytes", int, 512 * 1024 * 1024, "in-process store cap")
 
 # --- memory / isolation ------------------------------------------------------

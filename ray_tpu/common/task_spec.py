@@ -124,6 +124,10 @@ class TaskSpec:
     sequence_number: int = 0
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Streaming generator returns (reference: core_worker.proto:430
+    # ReportGeneratorItemReturns): yielded items are reported to the owner
+    # one by one under ObjectID.from_index(task_id, i+1); num_returns is 0.
+    streaming: bool = False
     # runtime env / misc
     runtime_env: Optional[dict] = None
     name: str = ""

@@ -14,7 +14,7 @@ from ray_tpu.common.ids import ActorID
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
@@ -25,12 +25,16 @@ class ActorMethod:
         cw = CoreWorker._current
         if cw is None:
             raise RuntimeError("ray_tpu.init() must be called first")
+        if self._num_returns == "streaming":
+            return cw.submit_actor_task(
+                self._handle._actor_id, self._method_name, args, kwargs,
+                streaming=True)
         refs = cw.submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs,
             num_returns=self._num_returns)
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, num_returns: int = 1):
+    def options(self, num_returns=1):
         return ActorMethod(self._handle, self._method_name, num_returns)
 
 

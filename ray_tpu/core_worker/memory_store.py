@@ -161,6 +161,13 @@ class MemoryStore:
         with self._cv:
             self._entries.setdefault(object_id, Entry())
 
+    def is_pending(self, object_id: ObjectID) -> bool:
+        """True when the entry exists but has no value yet (someone is
+        waiting on it, e.g. a reconstruct in flight)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and not e.is_ready
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._cv:
             e = self._entries.get(object_id)

@@ -177,6 +177,8 @@ class NormalTaskSubmitter:
         blob = pickle.dumps(error)
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error=blob)
+        if spec.streaming:
+            self._cw.generator_task_failed(spec.task_id, blob)
         # Terminal failure still completes the task: release the handoff
         # guards on its by-ref args or their owners leak them forever.
         self._cw.ack_args_handoffs(spec)
@@ -349,6 +351,8 @@ class ActorTaskSubmitter:
         blob = pickle.dumps(error)
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error=blob)
+        if spec.streaming:
+            self._cw.generator_task_failed(spec.task_id, blob)
         self._cw.ack_args_handoffs(spec)
 
     def notify_actor_state(self, view: dict):

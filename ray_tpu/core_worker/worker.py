@@ -118,7 +118,7 @@ class CoreWorker:
             "reconstruct_object", "set_visible_devices", "ping", "exit_worker",
             "actor_method_metadata", "object_info", "get_object_chunk",
             "incref_inflight", "borrow_ack", "borrow_release", "drop_copy",
-            "handoff_done", "device_object_get",
+            "handoff_done", "device_object_get", "report_generator_item",
         ):
             self.server.register(name, getattr(self, f"h_{name}"))
         self.server.start()
@@ -152,6 +152,12 @@ class CoreWorker:
         self._actor_counter = _Counter()
         self._index_counters: Dict[Any, _Counter] = {}
         self._index_lock = threading.Lock()
+
+        # streaming generator returns (owner side): task_id -> _StreamState;
+        # _stream_heal: in-flight lineage reconstructs of streamed items
+        # whose generator was already dropped (task_id -> {object_ids})
+        self._generators: Dict[TaskID, Any] = {}
+        self._stream_heal: Dict[TaskID, set] = {}
 
         # ownership state (owner side)
         self.lineage: Dict[ObjectID, TaskSpec] = {}
@@ -529,7 +535,8 @@ class CoreWorker:
         name: str = "",
         serialized_func: Optional[bytes] = None,
         runtime_env: Optional[dict] = None,
-    ) -> List[ObjectRef]:
+        streaming: bool = False,
+    ):
         from ray_tpu.common.resources import ResourceRequest
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
@@ -543,7 +550,8 @@ class CoreWorker:
                 getattr(func, "__module__", "?"), getattr(func, "__qualname__", str(func))),
             serialized_func=serialized_func or cloudpickle.dumps(func),
             args=self._serialize_args(args, kwargs),
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             required_resources=ResourceRequest(
                 {"CPU": 1} if resources is None else resources, label_selector),
             scheduling_strategy=scheduling_strategy or DefaultStrategy(),
@@ -579,10 +587,16 @@ class CoreWorker:
                 if GLOBAL_CONFIG.get("lineage_pinning_enabled"):
                     self.lineage[oid] = spec
                 refs.append(ObjectRef(oid, self.worker_id, self.server.address))
+        if spec.streaming:
+            from .generator import ObjectRefGenerator, _StreamState
+
+            self._generators[spec.task_id] = _StreamState(spec)
         if spec.is_actor_task():
             self._actor_submitter(spec.actor_id).submit(spec)
         else:
             self.submitter.submit(spec)
+        if spec.streaming:
+            return ObjectRefGenerator(self, spec.task_id)
         return refs
 
     def _serialize_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
@@ -645,7 +659,8 @@ class CoreWorker:
         return actor_id
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
-                          *, num_returns: int = 1, name: str = "") -> List[ObjectRef]:
+                          *, num_returns: int = 1, name: str = "",
+                          streaming: bool = False):
         from ray_tpu.common.resources import ResourceRequest
 
         sub = self._actor_submitter(actor_id)
@@ -653,9 +668,10 @@ class CoreWorker:
         task_id = TaskID.for_actor_task(actor_id, self.current_task_id(), self.next_task_index())
         # Fast path (native submit record): plain-value calls serialize
         # (args, kwargs) as ONE payload; by-ref args need the TaskArg
-        # handoff protocol and take the general path.
+        # handoff protocol and take the general path. Streaming tasks take
+        # the general path (the fastspec buffer has no streaming field).
         fast_payload = None
-        if not any(isinstance(v, ObjectRef) for v in args) and \
+        if not streaming and not any(isinstance(v, ObjectRef) for v in args) and \
                 not any(isinstance(v, ObjectRef) for v in kwargs.values()):
             fast_payload = self.serialize(_FastArgs(tuple(args), dict(kwargs)))
             task_args = [TaskArg.inline(fast_payload)]
@@ -668,7 +684,8 @@ class CoreWorker:
             function=FunctionDescriptor("", method_name),
             serialized_func=None,
             args=task_args,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming,
             required_resources=ResourceRequest({}),
             actor_id=actor_id,
             actor_method_name=method_name,
@@ -704,6 +721,22 @@ class CoreWorker:
     def store_task_reply(self, spec: TaskSpec, reply: dict, executor_addr):
         """Owner side: record results (values inline, or locations for large)."""
         self.ack_args_handoffs(spec)
+        if spec.streaming:
+            # authoritative completion backup: item reports normally finish
+            # the stream first, but a lost done-report must not hang readers
+            st = self._generators.get(spec.task_id)
+            if st is not None:
+                if reply.get("stream_error") is not None:
+                    st.fail(reply["stream_error"])
+                elif "streamed" in reply:
+                    st.finish(reply["streamed"])
+                elif reply.get("results"):
+                    # the executee rejected the task wholesale (e.g. not a
+                    # generator): surface the error to stream readers
+                    for payload in reply["results"].values():
+                        if "error" in payload:
+                            st.fail(payload["error"])
+                            break
         results = reply.get("results", {})
         for oid_bytes, payload in results.items():
             oid = ObjectID(oid_bytes)
@@ -729,8 +762,18 @@ class CoreWorker:
         respec = pickle.loads(pickle.dumps(spec))  # fresh copy
         # (ack_args_handoffs will fire again at re-completion; token-keyed
         # consumes are idempotent so no re-guard is needed.)
-        self.memory_store.free(respec.return_ids())
-        for oid in respec.return_ids():
+        to_reset = respec.return_ids()
+        if respec.streaming:
+            # streamed items aren't in return_ids; reset just the lost one —
+            # the replayed generator re-reports it (dedup skips the rest).
+            # Record a heal marker so the replay is allowed to run to this
+            # index even when the ObjectRefGenerator itself is long dropped.
+            to_reset = [object_id]
+            if respec.task_id not in self._generators:
+                self._stream_heal.setdefault(
+                    respec.task_id, set()).add(object_id)
+        self.memory_store.free(to_reset)
+        for oid in to_reset:
             self.memory_store.mark_pending(oid)
         if respec.is_actor_task():
             self._actor_submitter(respec.actor_id).submit(respec)
@@ -1542,8 +1585,171 @@ class CoreWorker:
             return self._maybe_device_resolve(self.deserialize(blob))
         raise ObjectLostError(oid, "dependency unavailable")
 
+    # ------------------------------------------------- streaming generators
+    def _as_sync_iter(self, result):
+        """Uniform sync iteration over sync/async generators. Async gens are
+        stepped on the actor's event loop (they may await actor state)."""
+        if hasattr(result, "__anext__"):
+            loop = self._actor_async_loop()
+
+            def gen():
+                while True:
+                    try:
+                        yield asyncio.run_coroutine_threadsafe(
+                            result.__anext__(), loop).result()
+                    except StopAsyncIteration:
+                        return
+
+            return gen()
+        return iter(result)
+
+    def _stream_results(self, task: TaskSpec, result) -> dict:
+        """Executor side of ``num_returns="streaming"``: iterate the user
+        generator, reporting each item to the owner as it is produced
+        (reference contract: core_worker.proto:430 ReportGeneratorItemReturns).
+
+        Reports are sequential sync RPCs from this executor thread; the
+        owner delays its reply while too many items sit unconsumed, which
+        backpressures this loop — and therefore the user generator —
+        with no extra protocol."""
+        client = RpcClient(tuple(task.caller_address))
+        threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
+        index = 0
+        try:
+            try:
+                for item in self._as_sync_iter(result):
+                    blob = self.serialize(item)
+                    if len(blob) <= threshold:
+                        payload = {"value": blob}
+                    else:
+                        oid = ObjectID.from_index(task.task_id, index + 1)
+                        self.memory_store.put(oid, value=blob)
+                        if self.shm is not None:
+                            try:
+                                self.shm.put(oid.binary(), blob)
+                            except OSError:
+                                pass  # store full → RPC pull still works
+                        payload = {"location": self.server.address}
+                    reply = client.call(
+                        "report_generator_item", timeout=None,
+                        task_id=task.task_id.binary(), index=index,
+                        done=False, **payload)
+                    if reply.get("cancel"):
+                        logger.debug("stream %s cancelled by owner",
+                                     task.task_id.hex()[:8])
+                        break
+                    index += 1
+            except Exception as e:  # noqa: BLE001 — user generator raised
+                err = (e if isinstance(e, RtError)
+                       else TaskError(task.task_id, e, traceback.format_exc()))
+                eblob = pickle.dumps(err)
+                try:
+                    client.call("report_generator_item", timeout=None,
+                                task_id=task.task_id.binary(), index=index,
+                                done=True, error=eblob, total=index)
+                except Exception:  # noqa: BLE001 — reply is the backup path
+                    pass
+                return {"results": {}, "streamed": index,
+                        "stream_error": eblob}
+            try:
+                client.call("report_generator_item", timeout=None,
+                            task_id=task.task_id.binary(), index=index,
+                            done=True, total=index)
+            except Exception:  # noqa: BLE001 — reply is the backup path
+                pass
+        finally:
+            client.close()
+        return {"results": {}, "streamed": index}
+
+    async def h_report_generator_item(self, task_id: bytes, index: int = 0,
+                                      done: bool = False, total=None,
+                                      value=None, error=None, location=None):
+        """Owner side: store one streamed item (or finish/fail the stream)
+        and apply consumer backpressure by delaying the reply."""
+        tid = TaskID(task_id)
+        st = self._generators.get(tid)
+        if st is None:
+            # Stream consumed+dropped, but a lineage reconstruct may be
+            # replaying to heal lost items the user still references: let
+            # the replay run (storing what it re-reports into pending
+            # entries) until every heal target is filled, then cancel.
+            heal = self._stream_heal.get(tid)
+            if heal is None:
+                return {"cancel": True}  # generator dropped: stop producing
+            if done:
+                self._stream_heal.pop(tid, None)
+                return {"ok": True}
+            oid = ObjectID.from_index(tid, index + 1)
+            if self.memory_store.is_pending(oid):
+                self.memory_store.put(
+                    oid, value=value, error=error,
+                    location=tuple(location) if location else None)
+            heal.discard(oid)
+            if not heal:
+                self._stream_heal.pop(tid, None)
+                return {"cancel": True}  # all healed: stop the replay
+            return {"ok": True}
+        if done:
+            if error is not None:
+                st.fail(error)
+            else:
+                st.finish(total)
+            return {"ok": True}
+        oid = ObjectID.from_index(tid, index + 1)
+        ref = ObjectRef(oid, self.worker_id, self.server.address)
+        first = st.add(index, ref)
+        entry = self.memory_store.get_if_ready(oid)
+        stale = (entry is not None and entry.location is not None
+                 and location is not None
+                 and tuple(location) != tuple(entry.location))
+        if stale:
+            # replayed item after worker death: the new report's location is
+            # the live copy; the stored one points at a dead process
+            self.memory_store.free([oid])
+        if first or stale or entry is None:
+            self.memory_store.put(
+                oid, value=value, error=error,
+                location=tuple(location) if location else None)
+        if location is not None and GLOBAL_CONFIG.get("lineage_pinning_enabled") \
+                and st.spec is not None:
+            # remotely-held items are recoverable by re-running the
+            # generator task (dedup makes the replay converge on this index)
+            with self._lineage_lock:
+                self.lineage[oid] = st.spec
+        limit = GLOBAL_CONFIG.get("streaming_generator_backpressure")
+        while limit > 0:
+            if self._generators.get(tid) is not st:
+                return {"cancel": True}  # dropped while we were parked
+            if st.done_or_failed():
+                break
+            with st.lock:
+                if (index + 1) - st.consumed <= limit:
+                    break
+                loop = asyncio.get_running_loop()
+                fut = loop.create_future()
+                st.space_waiters.append((loop, fut))
+            try:
+                await asyncio.wait_for(fut, timeout=1.0)
+            except asyncio.TimeoutError:
+                pass  # re-check cancellation/termination each second
+        return {"ok": True}
+
+    def generator_task_failed(self, task_id: TaskID, error_blob: bytes):
+        """Terminal submit-side failure (retries exhausted, actor dead):
+        fail the stream so consumers unblock."""
+        st = self._generators.get(task_id)
+        if st is not None:
+            st.fail(error_blob)
+
     def _result_reply(self, task: TaskSpec, result: Any,
                       tensor_transport: Optional[str] = None) -> dict:
+        if task.streaming:
+            if result is None or not (hasattr(result, "__iter__")
+                                      or hasattr(result, "__anext__")):
+                return self._error_reply(task, TypeError(
+                    "num_returns='streaming' requires the task to return a "
+                    f"generator or iterable, got {type(result).__name__}"))
+            return self._stream_results(task, result)
         values = (
             [result] if task.num_returns == 1
             else (list(result) if task.num_returns > 1 else [])
@@ -1593,7 +1799,12 @@ class CoreWorker:
         tb = traceback.format_exc()
         err = TaskError(task.task_id, exc, tb) if not isinstance(exc, RtError) else exc
         blob = pickle.dumps(err)
-        return {"results": {oid.binary(): {"error": blob} for oid in task.return_ids()}}
+        reply = {"results": {oid.binary(): {"error": blob} for oid in task.return_ids()}}
+        if task.streaming:
+            # streaming tasks have no return ids; the error reaches readers
+            # through the stream itself
+            reply["stream_error"] = blob
+        return reply
 
     # ---------------------------------------------------------------- misc
     def cluster_resources(self) -> dict:
