@@ -138,7 +138,12 @@ def _moe_mlp(x: jax.Array, layer: Params, config: MoEConfig,
     T = B * S
     E, K = config.n_experts, config.top_k
     C = config.capacity(T)
-    xt = x.reshape(T, H)
+    # Pin the flattened token layout (the merge of batch and seq shardings):
+    # without it the partitioner lets the expert-sharded layout of the
+    # dispatch einsum's OUTPUT propagate backward into the per-token routing
+    # tensors, then reshards their degenerate broadcast operands with
+    # "involuntary full rematerialization" (seen in the 8-device dryrun).
+    xt = with_logical_constraint(x.reshape(T, H), ("tokens", "embed"), rules)
 
     logits = jnp.einsum("th,he->te", xt.astype(jnp.float32), layer["router"])
     probs = jax.nn.softmax(logits, axis=-1)                      # (T, E)
@@ -160,12 +165,20 @@ def _moe_mlp(x: jax.Array, layer: Params, config: MoEConfig,
             * kept[:, None].astype(jnp.float32)                   # (T, C)
         dispatch = dispatch + mask.astype(jnp.float32)[:, :, None] \
             * slot[:, None, :]
-        combine = combine + top_w[:, k, None, None] \
-            * mask.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        # Fold the gate weight into the rank-2 slot tensor instead of
+        # multiplying a (T,1,1) operand into the rank-3 product: the SPMD
+        # partitioner assigns the degenerate singleton dims conflicting
+        # shardings across the unrolled k-steps and falls back to
+        # "involuntary full rematerialization" (seen in the 8-device dryrun).
+        w_slot = slot * top_w[:, k, None]                         # (T, C)
+        combine = combine + mask.astype(jnp.float32)[:, :, None] \
+            * w_slot[:, None, :]
         frac_dispatched = frac_dispatched + mask.sum(0) / T
 
     # dispatch: token-major → expert-major; the constraint pins the expert
     # layout so XLA materializes the resharding as all-to-all over ep axes
+    dispatch = with_logical_constraint(dispatch, ("tokens", None, None), rules)
+    combine = with_logical_constraint(combine, ("tokens", None, None), rules)
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
     expert_in = with_logical_constraint(expert_in, ("expert", None, "embed"),
                                         rules)

@@ -25,6 +25,7 @@ LOGICAL_AXES = (
     "embed_vocab",  # embedding-table vocab dim (gather axis) → replicated
     "layers",     # scan-over-layers leading axis (never sharded)
     "expert",     # MoE experts             → ep (fsdp, sp)
+    "tokens",     # flattened batch·seq (MoE routing) → dp + fsdp + sp
     "kv_seq",     # kv-cache sequence dim
     None,
 )
@@ -48,6 +49,9 @@ class ShardingRules:
     embed_vocab: Axis = None
     layers: Axis = None
     expert: Axis = ("fsdp", "sp")
+    # flattened (batch·seq) token dim: the merge of the batch and seq
+    # layouts, so reshape (B,S,…)→(T,…) preserves the sharding exactly
+    tokens: Axis = ("dp", "fsdp", "sp")
     kv_seq: Axis = None
 
     def mesh_axes(self, logical_axes: Sequence[Optional[str]]):
