@@ -6,7 +6,11 @@ ops (shuffle/sort/repartition) materialize. ``iter_batches``/``split``
 are the training-ingest path feeding JaxTrainer workers.
 """
 
-from ray_tpu.data.dataset import Dataset, GroupedDataset  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    GroupedDataset,
+)
 from ray_tpu.data.execution import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
     from_items,

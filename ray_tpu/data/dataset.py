@@ -70,25 +70,11 @@ class _AllToAll(_Op):
 
 
 def _fuse_maps(ops: List[_Op]) -> List[_Op]:
-    """Plan optimization (reference: logical OperatorFusionRule —
-    Map->Map fuses into one physical operator): runs of plain map ops
-    compose into ONE task per block, so a map().filter().map() chain
-    costs one scheduling round-trip instead of three. Actor-pool stages
-    never fuse (they run on dedicated actors with their own
-    constructor state)."""
-    out: List[_Op] = []
-    for op in ops:
-        prev = out[-1] if out else None
-        if (isinstance(op, _MapBlock) and op.actor_pool is None
-                and isinstance(prev, _MapBlock) and prev.actor_pool is None):
-            def fused(block, _f=prev.fn, _g=op.fn):
-                return _g(_f(block))
+    """Back-compat alias: the rule-based optimizer supersedes this
+    (``ray_tpu/data/optimizer.py``, reference logical/optimizers.py)."""
+    from ray_tpu.data.optimizer import optimize
 
-            merged = _MapBlock(fused, f"{prev.name}->{op.name}")
-            out[-1] = merged
-        else:
-            out.append(op)
-    return out
+    return optimize(ops)
 
 
 class Dataset:
@@ -420,6 +406,153 @@ class Dataset:
         refs = self._execute()
         return [Dataset([_FromRefs(refs[i::n])], self._max_inflight)
                 for i in range(n)]
+
+    def streaming_split(self, n: int, *,
+                        queue_depth: int = 4) -> List["DataIterator"]:
+        """n per-consumer iterators fed by ONE streaming execution of the
+        plan (reference ``dataset.py:1771 streaming_split`` +
+        output_splitter.py): blocks are round-robined to consumers as they
+        are produced — nothing materializes, and a slow consumer
+        backpressures the pipeline through its bounded queue. Each
+        ``iter_batches()`` call on the iterators is one epoch; consumers
+        must iterate epochs in lockstep (the trainer-ingest contract)."""
+        import cloudpickle
+
+        import ray_tpu
+        from ray_tpu.data.execution import _SplitCoordinator
+
+        coord = ray_tpu.remote(_SplitCoordinator).options(
+            max_concurrency=max(2, 2 * n)).remote(
+            cloudpickle.dumps(self), n, queue_depth)
+        return [DataIterator(coord, i) for i in range(n)]
+
+    # --------------------------------------------------------------- joins
+    def join(self, other: "Dataset", on: str, how: str = "inner", *,
+             right_on: Optional[str] = None,
+             num_partitions: Optional[int] = None,
+             suffix: str = "_right") -> "Dataset":
+        """Distributed hash join (reference
+        ``data/_internal/execution/operators/join.py``): both sides are
+        hash-partitioned on the key, one join task per partition builds a
+        hash table on the right side. ``how`` ∈ {"inner", "left_outer",
+        "right_outer", "full_outer"}. Overlapping non-key columns from the
+        right side get ``suffix``."""
+        from ray_tpu.data.execution import hash_join
+
+        if how not in ("inner", "left_outer", "right_outer", "full_outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        left_refs = self._execute()
+        right_refs = other._execute()
+        nparts = num_partitions or builtins.max(
+            1, builtins.min(len(left_refs), 16))
+        refs = hash_join(left_refs, right_refs, on, right_on or on, how,
+                         nparts, suffix)
+        return Dataset([_FromRefs(refs)], self._max_inflight)
+
+    # --------------------------------------------------------------- writes
+    def _write_blocks(self, path: str, ext: str, writer,
+                      filesystem=None) -> List[str]:
+        """Block-parallel write: one task per block writes one file
+        (reference: Datasink write tasks). Returns the written paths."""
+        import os as _os
+
+        import ray_tpu
+
+        _os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_one(block, fname, _writer):
+            _writer(block, fname)
+            return fname
+
+        out_refs = []
+        for idx, ref in enumerate(self._stream_refs()):
+            fname = _os.path.join(path, f"part-{idx:05d}.{ext}")
+            out_refs.append(_write_one.remote(ref, fname, writer))
+        return ray_tpu.get(out_refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import _parquet_writer
+
+        return self._write_blocks(path, "parquet", _parquet_writer)
+
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import _csv_writer
+
+        return self._write_blocks(path, "csv", _csv_writer)
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import _json_writer
+
+        return self._write_blocks(path, "json", _json_writer)
+
+    def write_numpy(self, path: str, column: str) -> List[str]:
+        import functools as _ft
+
+        from ray_tpu.data.datasource import _numpy_writer
+
+        return self._write_blocks(
+            path, "npy", _ft.partial(_numpy_writer, column=column))
+
+    def write_tfrecords(self, path: str, *, column: str = "data") -> List[str]:
+        import functools as _ft
+
+        from ray_tpu.data.datasource import _tfrecord_writer
+
+        return self._write_blocks(
+            path, "tfrecord", _ft.partial(_tfrecord_writer, column=column))
+
+
+class DataIterator:
+    """Per-consumer handle from :meth:`Dataset.streaming_split` (reference
+    ``DataIterator``): re-iterable; each pass pulls a fresh epoch from the
+    split coordinator via a streaming-generator actor call."""
+
+    def __init__(self, coordinator, index: int):
+        self._coord = coordinator
+        self._index = index
+        self._epoch = 0
+
+    def _iter_block_refs(self) -> Iterator:
+        import ray_tpu
+
+        epoch = self._epoch
+        self._epoch += 1
+        gen = self._coord.stream.options(num_returns="streaming").remote(
+            self._index, epoch)
+        for item_ref in gen:
+            yield ray_tpu.get(item_ref)  # a borrowed block ref
+
+    def iter_blocks(self) -> Iterator:
+        import ray_tpu
+
+        for block_ref in self._iter_block_refs():
+            yield ray_tpu.get([block_ref])[0]
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for block in self.iter_blocks():
+            yield from B.block_to_rows(block)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        carry: Optional[Dict[str, np.ndarray]] = None
+        for block in self.iter_blocks():
+            batch = B.block_to_batch(block)
+            if not batch:
+                continue
+            if carry:
+                batch = {k: np.concatenate([carry[k], batch[k]])
+                         for k in batch}
+            n = len(next(iter(batch.values())))
+            lo = 0
+            while n - lo >= batch_size:
+                yield {k: v[lo:lo + batch_size] for k, v in batch.items()}
+                lo += batch_size
+            carry = ({k: v[lo:] for k, v in batch.items()}
+                     if lo < n else None)
+        if carry and not drop_last:
+            yield carry
 
 
 class GroupedDataset:

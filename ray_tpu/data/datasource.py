@@ -233,26 +233,27 @@ def read_tfrecords(paths, *, column: str = "data",
     return Dataset([_Read([make(f) for f in files])])
 
 
+def _tfrecord_writer(block, fname, column: str = "data"):
+    import struct
+
+    rows = B.block_to_rows(block)
+    with open(fname, "wb") as f:
+        for row in rows:
+            payload = row[column]
+            if not isinstance(payload, (bytes, bytearray)):
+                payload = bytes(payload)
+            head = struct.pack("<Q", len(payload))
+            f.write(head)
+            f.write(struct.pack("<I", _masked_crc(head)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc(payload)))
+
+
 def write_tfrecords(ds: Dataset, path: str, *,
                     column: str = "data") -> List[str]:
     """Write ``column`` (bytes per row) as TFRecord files, one per
-    block, with valid masked CRCs."""
-    import struct
-
-    def write_fn(block, fname):
-        rows = B.block_to_rows(block)
-        with open(fname, "wb") as f:
-            for row in rows:
-                payload = row[column]
-                if not isinstance(payload, (bytes, bytearray)):
-                    payload = bytes(payload)
-                head = struct.pack("<Q", len(payload))
-                f.write(head)
-                f.write(struct.pack("<I", _masked_crc(head)))
-                f.write(payload)
-                f.write(struct.pack("<I", _masked_crc(payload)))
-
-    return _write(ds, path, "tfrecord", write_fn)
+    block, with valid masked CRCs. Block-parallel."""
+    return ds.write_tfrecords(path, column=column)
 
 
 def read_images(paths, *, include_paths: bool = False,
@@ -292,7 +293,7 @@ def from_pandas(df) -> Dataset:
     return Dataset([_Read([lambda: pa.Table.from_pandas(df)])])
 
 
-def write_json(ds: Dataset, path: str) -> List[str]:
+def _json_writer(block, fname):
     """JSON-lines writer. ndarrays become lists; bytes become base64
     strings (JSON has no binary type)."""
     import base64
@@ -305,36 +306,40 @@ def write_json(ds: Dataset, path: str) -> List[str]:
             return base64.b64encode(bytes(v)).decode("ascii")
         return v
 
-    def write_fn(block, fname):
-        rows = B.block_to_rows(block)
-        with open(fname, "w", encoding="utf-8") as f:
-            for row in rows:
-                f.write(_json.dumps(
-                    {k: enc(v) for k, v in row.items()}) + "\n")
-
-    return _write(ds, path, "json", write_fn)
+    rows = B.block_to_rows(block)
+    with open(fname, "w", encoding="utf-8") as f:
+        for row in rows:
+            f.write(_json.dumps(
+                {k: enc(v) for k, v in row.items()}) + "\n")
 
 
-def _write(ds: Dataset, path: str, ext: str, write_fn) -> List[str]:
-    import ray_tpu
+def _parquet_writer(block, fname):
+    import pyarrow.parquet as pq
 
-    os.makedirs(path, exist_ok=True)
-    out = []
-    for idx, ref in enumerate(ds._execute()):
-        block = ray_tpu.get([ref])[0]
-        fname = os.path.join(path, f"part-{idx:05d}.{ext}")
-        write_fn(block, fname)
-        out.append(fname)
-    return out
+    pq.write_table(block, fname)
+
+
+def _csv_writer(block, fname):
+    import pyarrow.csv as pcsv
+
+    pcsv.write_csv(block, fname)
+
+
+def _numpy_writer(block, fname, column: str):
+    batch = B.block_to_batch(block)
+    np.save(fname, batch[column])
+
+
+# Module-level write entry points delegate to the block-parallel Dataset
+# methods (one write task per block; reference: Datasink write tasks).
+
+def write_json(ds: Dataset, path: str) -> List[str]:
+    return ds.write_json(path)
 
 
 def write_parquet(ds: Dataset, path: str) -> List[str]:
-    import pyarrow.parquet as pq
-
-    return _write(ds, path, "parquet", pq.write_table)
+    return ds.write_parquet(path)
 
 
 def write_csv(ds: Dataset, path: str) -> List[str]:
-    import pyarrow.csv as pcsv
-
-    return _write(ds, path, "csv", pcsv.write_csv)
+    return ds.write_csv(path)

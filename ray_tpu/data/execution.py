@@ -276,3 +276,155 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
         _reduce.remote(seed * 1000 + p if seed is not None else None,
                        *[parts[p] for parts in part_lists])
         for p in range(n)]
+
+
+# ------------------------------------------------------------------ join
+
+def _stable_hash(x) -> int:
+    """Content hash stable across processes (Python's str/bytes hash is
+    per-process salted, which would scatter equal keys across reducers)."""
+    import zlib
+
+    if hasattr(x, "item"):
+        x = x.item()
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    b = x if isinstance(x, bytes) else str(x).encode()
+    return zlib.crc32(b)
+
+
+def hash_join(left_refs: List[Any], right_refs: List[Any], on: str,
+              right_on: str, how: str, n: int, suffix: str) -> List[Any]:
+    """Distributed hash join (reference
+    ``_internal/execution/operators/join.py``): hash-partition both sides
+    on the key (one task per block), then one join task per partition
+    builds a dict index on its right partition and probes with the left.
+    Returns joined block refs; nothing materializes centrally."""
+    import ray_tpu
+
+    n = max(1, n)
+
+    @ray_tpu.remote
+    def _partition(block, key_col):
+        batch = B.block_to_batch(block)
+        if key_col not in batch:
+            empty = B.block_from_batch({c: np.asarray(v)[:0]
+                                        for c, v in batch.items()})
+            return empty if n == 1 else tuple(empty for _ in range(n))
+        assign = np.array([_stable_hash(x) % n for x in batch[key_col]],
+                          np.int64)
+        parts = [B.block_from_batch(
+            {c: np.asarray(v)[assign == p] for c, v in batch.items()})
+            for p in range(n)]
+        return parts[0] if n == 1 else tuple(parts)
+
+    @ray_tpu.remote
+    def _join(n_left, *parts):
+        left_rows = []
+        for b in parts[:n_left]:
+            left_rows.extend(B.block_to_rows(b))
+        right_rows = []
+        for b in parts[n_left:]:
+            right_rows.extend(B.block_to_rows(b))
+        left_cols = list(left_rows[0].keys()) if left_rows else []
+        right_cols = list(right_rows[0].keys()) if right_rows else []
+
+        def out_row(lr, rr):
+            row = (dict(lr) if lr is not None
+                   else {c: None for c in left_cols})
+            rsrc = rr if rr is not None else {c: None for c in right_cols}
+            for c, v in rsrc.items():
+                if c == right_on and (rr is None or c == on):
+                    continue  # the key survives via the left side
+                row[c + suffix if c in row else c] = v
+            if lr is None and rr is not None:
+                row[on] = rr[right_on]  # key from the right side
+            return row
+
+        index: Dict[Any, list] = {}
+        for r in right_rows:
+            index.setdefault(r[right_on], []).append(r)
+        out, matched = [], set()
+        for lr in left_rows:
+            ms = index.get(lr[on])
+            if ms:
+                for m in ms:
+                    out.append(out_row(lr, m))
+                    matched.add(id(m))
+            elif how in ("left_outer", "full_outer"):
+                out.append(out_row(lr, None))
+        if how in ("right_outer", "full_outer"):
+            for r in right_rows:
+                if id(r) not in matched:
+                    out.append(out_row(None, r))
+        return B.block_from_rows(out)
+
+    def parts_of(refs, key_col):
+        lists = [_partition.options(num_returns=n).remote(r, key_col)
+                 for r in refs]
+        return [p if isinstance(p, list) else [p] for p in lists]
+
+    lparts = parts_of(left_refs, on)
+    rparts = parts_of(right_refs, right_on)
+    return [
+        _join.remote(len(lparts),
+                     *[parts[p] for parts in lparts],
+                     *[parts[p] for parts in rparts])
+        for p in range(n)]
+
+
+# ------------------------------------------------------------ split feed
+
+class _SplitCoordinator:
+    """Actor behind :meth:`Dataset.streaming_split`: executes the plan ONCE
+    per epoch and round-robins block refs into one bounded queue per
+    consumer; each consumer pulls its queue through a streaming-generator
+    method (``num_returns="streaming"``), so consumer backpressure reaches
+    the executor through the queue bound (reference output_splitter.py)."""
+
+    _DONE = "__rt_split_done__"
+
+    def __init__(self, ds_blob: bytes, n: int, queue_depth: int = 4):
+        import cloudpickle
+
+        self._ds = cloudpickle.loads(ds_blob)
+        self._n = n
+        self._depth = max(1, queue_depth)
+        self._epochs: Dict[int, list] = {}
+        import threading
+
+        self._lock = threading.Lock()
+
+    def _ensure_epoch(self, epoch: int):
+        import queue as _q
+        import threading
+
+        with self._lock:
+            if epoch in self._epochs:
+                return
+            queues = [_q.Queue(maxsize=self._depth) for _ in range(self._n)]
+            self._epochs[epoch] = queues
+            # drop finished epochs so their refs (and blocks) free up
+            for old in [e for e in self._epochs if e < epoch - 1]:
+                del self._epochs[old]
+
+        def pump():
+            try:
+                for j, ref in enumerate(self._ds._stream_refs()):
+                    queues[j % self._n].put(ref)
+            finally:
+                for q in queues:
+                    q.put(self._DONE)
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"split-pump-{epoch}").start()
+
+    def stream(self, index: int, epoch: int = 0):
+        """Streaming-generator method: yields block refs for one consumer."""
+        self._ensure_epoch(epoch)
+        q = self._epochs[epoch][index]
+        while True:
+            item = q.get()
+            if item == self._DONE:
+                return
+            yield item

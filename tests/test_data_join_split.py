@@ -1,0 +1,164 @@
+"""Data joins, block-parallel writes, streaming_split, and the logical
+optimizer (reference: operators/join.py, Datasink write tasks,
+dataset.py streaming_split, logical/optimizers.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _sorted_rows(rows, key):
+    return sorted(rows, key=lambda r: (str(r.get(key)),
+                                       str(sorted(r.items()))))
+
+
+class TestJoin:
+    def _sides(self):
+        left = rd.from_items(
+            [{"k": i % 4, "lv": i} for i in range(12)], num_blocks=3)
+        right = rd.from_items(
+            [{"k": k, "rv": k * 100} for k in (0, 1, 2, 5)], num_blocks=2)
+        return left, right
+
+    def _pandas_check(self, got_rows, how, on="k"):
+        import pandas as pd
+
+        left = pd.DataFrame([{"k": i % 4, "lv": i} for i in range(12)])
+        right = pd.DataFrame(
+            [{"k": k, "rv": k * 100} for k in (0, 1, 2, 5)])
+        pd_how = {"inner": "inner", "left_outer": "left",
+                  "right_outer": "right", "full_outer": "outer"}[how]
+        expect = left.merge(right, on=on, how=pd_how)
+        got = sorted((r["k"] if r["k"] is not None else -1,
+                      r.get("lv") if r.get("lv") is not None else -1,
+                      r.get("rv") if r.get("rv") is not None else -1)
+                     for r in got_rows)
+        want = sorted((int(k) if not np.isnan(k) else -1,
+                       int(lv) if not np.isnan(lv) else -1,
+                       int(rv) if not np.isnan(rv) else -1)
+                      for k, lv, rv in
+                      expect[["k", "lv", "rv"]].itertuples(index=False))
+        assert got == want, f"{how}: {got} != {want}"
+
+    @pytest.mark.parametrize(
+        "how", ["inner", "left_outer", "right_outer", "full_outer"])
+    def test_join_matches_pandas(self, rt, how):
+        left, right = self._sides()
+        rows = left.join(right, on="k", how=how, num_partitions=3).take_all()
+        self._pandas_check(rows, how)
+
+    def test_join_column_suffix(self, rt):
+        left = rd.from_items([{"k": 1, "v": "L"}])
+        right = rd.from_items([{"k": 1, "v": "R"}])
+        rows = left.join(right, on="k").take_all()
+        assert rows == [{"k": 1, "v": "L", "v_right": "R"}]
+
+    def test_join_bad_how(self, rt):
+        left, right = self._sides()
+        with pytest.raises(ValueError):
+            left.join(right, on="k", how="cross")
+
+
+class TestParallelWrites:
+    def test_parquet_write_read_roundtrip(self, rt, tmp_path):
+        ds = rd.range(100, num_blocks=4).map(
+            lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+        paths = ds.write_parquet(str(tmp_path / "pq"))
+        assert len(paths) == 4
+        back = rd.read_parquet(str(tmp_path / "pq"))
+        rows = back.take_all()
+        assert len(rows) == 100
+        assert {r["id"]: r["sq"] for r in rows}[7] == 49
+
+    def test_csv_and_json_write(self, rt, tmp_path):
+        ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(10)],
+                           num_blocks=2)
+        csvs = ds.write_csv(str(tmp_path / "csv"))
+        jsons = ds.write_json(str(tmp_path / "js"))
+        assert len(csvs) == 2 and len(jsons) == 2
+        assert rd.read_csv(str(tmp_path / "csv")).count() == 10
+        import json
+
+        rows = [json.loads(ln) for p in jsons
+                for ln in open(p).read().splitlines()]
+        assert {r["a"] for r in rows} == set(range(10))
+
+    def test_transform_write_transform(self, rt, tmp_path):
+        # round-trip read→transform→write→read→transform
+        ds = rd.range(20, num_blocks=2).filter(lambda r: r["id"] % 2 == 0)
+        ds.write_parquet(str(tmp_path / "even"))
+        total = rd.read_parquet(str(tmp_path / "even")).map(
+            lambda r: {"x": r["id"] * 10}).sum("x")
+        assert total == sum(i * 10 for i in range(0, 20, 2))
+
+
+class TestStreamingSplit:
+    def test_two_consumers_disjoint_complete(self, rt):
+        ds = rd.range(60, num_blocks=6)
+        it_a, it_b = ds.streaming_split(2)
+
+        import threading
+
+        got = {0: [], 1: []}
+
+        def consume(it, i):
+            for row in it.iter_rows():
+                got[i].append(row["id"])
+
+        ta = threading.Thread(target=consume, args=(it_a, 0))
+        tb = threading.Thread(target=consume, args=(it_b, 1))
+        ta.start(); tb.start()
+        ta.join(60); tb.join(60)
+        assert not ta.is_alive() and not tb.is_alive()
+        assert sorted(got[0] + got[1]) == list(range(60))
+        assert got[0] and got[1], "both consumers must receive blocks"
+        assert not (set(got[0]) & set(got[1])), "shards must be disjoint"
+
+    def test_second_epoch(self, rt):
+        ds = rd.range(20, num_blocks=2)
+        (it,) = ds.streaming_split(1)
+        first = [r["id"] for r in it.iter_rows()]
+        second = [r["id"] for r in it.iter_rows()]
+        assert sorted(first) == list(range(20))
+        assert sorted(second) == list(range(20))
+
+    def test_iter_batches_shapes(self, rt):
+        ds = rd.range(50, num_blocks=5)
+        (it,) = ds.streaming_split(1)
+        batches = list(it.iter_batches(batch_size=16))
+        assert [len(b["id"]) for b in batches] == [16, 16, 16, 2]
+
+
+class TestOptimizer:
+    def test_filter_pushed_before_shuffle(self, rt):
+        from ray_tpu.data.dataset import _MapBlock, _Shuffle
+        from ray_tpu.data.optimizer import optimize
+
+        ds = rd.range(10).random_shuffle().filter(lambda r: r["id"] < 5)
+        ops = optimize(ds._ops)
+        kinds = [type(o).__name__ for o in ops]
+        # filter (fused into the read) must precede the shuffle
+        shuffle_pos = kinds.index("_Shuffle")
+        assert not any(isinstance(o, _MapBlock) and "filter" in o.name
+                       for o in ops[shuffle_pos:]), kinds
+        # semantics preserved
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(5))
+
+    def test_read_map_fusion(self, rt):
+        from ray_tpu.data.dataset import _Read
+        from ray_tpu.data.optimizer import optimize
+
+        ds = rd.range(10, num_blocks=2).map(
+            lambda r: {"id": r["id"] + 1}).filter(lambda r: r["id"] > 3)
+        ops = optimize(ds._ops)
+        assert len(ops) == 1 and isinstance(ops[0], _Read)
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(4, 11))
