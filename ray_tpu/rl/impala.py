@@ -162,6 +162,20 @@ class IMPALALearner:
             aux["total_loss"] = total
             return params, opt_state, aux
 
+        # Split pair for LearnerGroup gradient sync (reference Learner API:
+        # compute_gradients:464 / apply_gradients:607).
+        def grad(params, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            aux["total_loss"] = total
+            return grads, aux
+
+        def apply(params, opt_state, grads):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grad_fn = jax.jit(grad)
+        self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
         return jax.jit(step, donate_argnums=(0, 1))
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
@@ -173,6 +187,23 @@ class IMPALALearner:
             self._params, self._opt_state, jb)
         self.updates += 1
         return {k: float(v) for k, v in aux.items()}
+
+    def compute_gradients(self, batch: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        return self._grad_fn(self._params, jb)
+
+    def apply_gradients(self, grads) -> None:
+        self._params, self._opt_state = self._apply_fn(
+            self._params, self._opt_state, grads)
+        self.updates += 1
+
+    def set_weights(self, params: Dict[str, np.ndarray]):
+        import jax
+
+        self._params = jax.tree.map(jax.numpy.asarray, dict(params))
 
     def get_weights(self) -> Dict[str, np.ndarray]:
         return {k: np.asarray(v) for k, v in self._params.items()}
@@ -188,6 +219,13 @@ class IMPALAConfig(AlgorithmConfig):
     # max learner updates pulled per training_step() call
     max_updates_per_step: int = 8
     broadcast_interval: int = 1  # weight push every N learner updates
+    # >1 → LearnerGroup: N learner actors, batch sharded across them,
+    # gradients mean-allreduced per update (reference learner_group.py:100).
+    # "kv" syncs over the GCS KV store (CPU hosts); "xla" over ICI meshes.
+    num_learners: int = 1
+    learner_backend: str = "kv"
+    # async update pipeline depth per LearnerGroup (IMPALA's update queue)
+    max_inflight_updates: int = 4
 
     @property
     def algo_class(self):
@@ -204,9 +242,27 @@ class IMPALA(Algorithm):
         params = init_policy_params(
             self._env_probe["obs_size"], self._env_probe["num_actions"],
             hidden=tuple(config.hidden), seed=config.seed)
-        self.learner = IMPALALearner(
-            params, lr=config.lr, gamma=config.gamma,
-            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff)
+        self.learner = None
+        self.learner_group = None
+        self._learner_updates = 0
+        if config.num_learners > 1:
+            from ray_tpu.rl.learner_group import LearnerGroup
+
+            lr, gamma = config.lr, config.gamma
+            vf_c, ent_c = config.vf_coeff, config.entropy_coeff
+
+            def factory(_p=params):
+                return IMPALALearner(_p, lr=lr, gamma=gamma,
+                                     vf_coeff=vf_c, entropy_coeff=ent_c)
+
+            self.learner_group = LearnerGroup(
+                factory, num_learners=config.num_learners,
+                backend=config.learner_backend,
+                max_inflight_updates=config.max_inflight_updates)
+        else:
+            self.learner = IMPALALearner(
+                params, lr=config.lr, gamma=config.gamma,
+                vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff)
         agg_cls = ray_tpu.remote(Aggregator)
         self._aggregators = [
             agg_cls.options(max_concurrency=4).remote(config.train_batch_size)
@@ -220,11 +276,19 @@ class IMPALA(Algorithm):
 
     # ------------------------------------------------------------ async loop
     def get_weights(self):
+        if self.learner_group is not None:
+            return self.learner_group.get_weights()
         return self.learner.get_weights()
+
+    @property
+    def _num_learner_updates(self) -> int:
+        if self.learner_group is not None:
+            return self._learner_updates
+        return self.learner.updates
 
     def _push_weights(self):
         self._weights_version += 1
-        weights = self.learner.get_weights()
+        weights = self.get_weights()
         self.env_runner_group.foreach_actor(
             lambda a: a.set_weights.remote(weights, self._weights_version))
 
@@ -276,12 +340,28 @@ class IMPALA(Algorithm):
                 if batch is None:
                     continue
                 got_batch = True
-                metrics = self.learner.update(batch)
-                self._steps_trained += len(batch["obs"])
-                returns.extend(batch["episode_returns"].tolist())
-                updates += 1
-                if self.learner.updates % self.config.broadcast_interval == 0:
-                    self._push_weights()
+                if self.learner_group is not None:
+                    # async update queue (reference impala.py:599): enqueue
+                    # without waiting; drain whatever finished. A full
+                    # pipeline drops the batch (classic IMPALA backpressure).
+                    if self.learner_group.async_update(batch):
+                        self._steps_trained += len(batch["obs"])
+                        returns.extend(batch["episode_returns"].tolist())
+                    for m in self.learner_group.poll_updates():
+                        metrics = m
+                        updates += 1
+                        self._learner_updates += 1
+                        if self._learner_updates \
+                                % self.config.broadcast_interval == 0:
+                            self._push_weights()
+                else:
+                    metrics = self.learner.update(batch)
+                    self._steps_trained += len(batch["obs"])
+                    returns.extend(batch["episode_returns"].tolist())
+                    updates += 1
+                    if self.learner.updates \
+                            % self.config.broadcast_interval == 0:
+                        self._push_weights()
             if not got_batch:
                 continue  # keep routing samples; learner stays decoupled
         self._return_window = (self._return_window
@@ -295,6 +375,6 @@ class IMPALA(Algorithm):
                     self.env_runner_group.num_healthy_actors(),
             },
             "learners": {"default_policy": dict(
-                metrics, num_updates=self.learner.updates,
+                metrics, num_updates=self._num_learner_updates,
                 num_env_steps_trained=self._steps_trained)},
         }

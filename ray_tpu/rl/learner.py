@@ -78,6 +78,24 @@ class PPOLearner:
             aux["total_loss"] = total
             return params, opt_state, aux
 
+        # Split grad/apply pair for multi-learner groups (reference Learner
+        # API: compute_gradients:464 / apply_gradients:607) — the allreduce
+        # slots between the two jitted calls.
+        def grad(params, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            aux["total_loss"] = total
+            return grads, aux
+
+        def apply(params, opt_state, grads):
+            import optax
+
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grad_fn = jax.jit(grad)
+        self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+
         return jax.jit(step, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------- update
@@ -97,6 +115,22 @@ class PPOLearner:
                     self._params, self._opt_state, mb)
             metrics = {k: float(v) for k, v in aux.items()}
         return metrics
+
+    # --------------------------------------------- multi-learner grad split
+    def compute_gradients(self, batch: Dict[str, np.ndarray]):
+        """Gradients on this shard WITHOUT applying them; pair with
+        :meth:`apply_gradients` around an allreduce (LearnerGroup)."""
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        grads, aux = self._grad_fn(self._params, jb)
+        return grads, aux
+
+    def apply_gradients(self, grads) -> None:
+        self._params, self._opt_state = self._apply_fn(
+            self._params, self._opt_state, grads)
+        self.updates = getattr(self, "updates", 0) + 1
 
     def get_weights(self) -> Dict[str, np.ndarray]:
         return {k: np.asarray(v) for k, v in self._params.items()}

@@ -1,0 +1,144 @@
+"""Multi-learner LearnerGroup (reference rllib/core/learner/
+learner_group.py:100): N learner actors, batch sharded across them,
+per-leaf mean-allreduce gradient sync, async update queue; IMPALA wiring.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.learner import PPOLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.module import init_policy_params
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _ppo_batch(n=64, obs_size=4, num_actions=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.normal(size=(n, obs_size)).astype(np.float32),
+        "actions": rng.integers(0, num_actions, size=n).astype(np.int32),
+        "logp_old": np.log(np.full(n, 1.0 / num_actions, np.float32)),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "value_targets": rng.normal(size=n).astype(np.float32),
+    }
+
+
+def _factory(lr=1e-3, seed=0):
+    # nested def (not module-level) so cloudpickle ships it by value —
+    # worker processes cannot import the test module
+    def make():
+        from ray_tpu.rl.learner import PPOLearner
+        from ray_tpu.rl.module import init_policy_params
+
+        return PPOLearner(init_policy_params(4, 2, hidden=(16, 16), seed=0),
+                          lr=lr, seed=seed)
+
+    return make
+
+
+class TestLearnerGroup:
+    def test_matches_single_learner_trajectory(self, rt):
+        """Sharded grads mean-allreduced across 2 learners must equal the
+        full-batch gradient step (mean of equal-shard means == full mean),
+        so the group's weights track a single learner's bit-for-bit up to
+        float reassociation."""
+        params = init_policy_params(4, 2, hidden=(16, 16), seed=0)
+        batch = _ppo_batch(64)
+
+        single = PPOLearner(params, lr=1e-3, seed=0)
+        group = LearnerGroup(_factory(), num_learners=2)
+        try:
+            for step in range(3):
+                grads, _ = single.compute_gradients(batch)
+                single.apply_gradients(grads)
+                group.update(batch)
+            w_single = single.get_weights()
+            w_group = group.get_weights()
+            for k in w_single:
+                np.testing.assert_allclose(
+                    w_group[k], w_single[k], rtol=2e-4, atol=2e-5,
+                    err_msg=f"diverged at {k}")
+        finally:
+            group.shutdown()
+
+    def test_all_learners_update(self, rt):
+        group = LearnerGroup(_factory(), num_learners=2)
+        try:
+            group.update(_ppo_batch(32))
+            group.update(_ppo_batch(32, seed=1))
+            counts = [ray_tpu.get(w.num_updates.remote(), timeout=30)
+                      for w in group._workers]
+            assert counts == [2, 2], counts
+        finally:
+            group.shutdown()
+
+    def test_async_update_queue_and_backpressure(self, rt):
+        group = LearnerGroup(_factory(), num_learners=2,
+                             max_inflight_updates=2)
+        try:
+            import time
+
+            accepted = [group.async_update(_ppo_batch(32, seed=s))
+                        for s in range(6)]
+            # pipeline depth 2: at most 2 accepted before a poll
+            assert accepted.count(True) <= 2
+            done = []
+            deadline = time.monotonic() + 60
+            while len(done) < accepted.count(True) \
+                    and time.monotonic() < deadline:
+                done.extend(group.poll_updates(timeout=0.5))
+            assert len(done) == accepted.count(True)
+            assert all("total_loss" in m for m in done)
+        finally:
+            group.shutdown()
+
+    def test_weights_roundtrip(self, rt):
+        group = LearnerGroup(_factory(), num_learners=2)
+        try:
+            w = group.get_weights()
+            zeroed = {k: np.zeros_like(v) for k, v in w.items()}
+            group.set_weights(zeroed)
+            back = group.get_weights()
+            for k in back:
+                assert not back[k].any(), k
+        finally:
+            group.shutdown()
+
+
+class TestIMPALAMultiLearner:
+    def test_impala_learner_group_smoke(self, rt):
+        """IMPALA with a 2-learner LearnerGroup (BASELINE target #3 shape:
+        CPU rollouts + learner group): must run async updates through the
+        group and produce finite losses with >1 learner updating."""
+        import time
+
+        from ray_tpu.rl import IMPALAConfig
+
+        algo = IMPALAConfig(seed=0, hidden=(32, 32),
+                            env="CartPole-v1", num_env_runners=2,
+                            rollout_fragment_length=64,
+                            train_batch_size=256, lr=1e-3,
+                            num_learners=2,
+                            max_updates_per_step=4).build()
+        try:
+            assert algo.learner_group is not None
+            result = {}
+            deadline = time.monotonic() + 120
+            while algo._num_learner_updates < 3 \
+                    and time.monotonic() < deadline:
+                result = algo.train()
+            assert algo._num_learner_updates >= 3
+            learners = result["learners"]["default_policy"]
+            assert np.isfinite(learners.get("total_loss", np.nan))
+            counts = [ray_tpu.get(w.num_updates.remote(), timeout=30)
+                      for w in algo.learner_group._workers]
+            assert min(counts) >= 3, counts  # every learner updated
+        finally:
+            algo.stop()
