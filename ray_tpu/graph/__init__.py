@@ -18,6 +18,10 @@ from ray_tpu.graph.dag import (  # noqa: F401
     MultiOutputNode,
 )
 from ray_tpu.graph.compiled import CompiledDAG  # noqa: F401
+from ray_tpu.graph.collective_node import (  # noqa: F401
+    CollectiveOutputNode,
+    allreduce,
+)
 
 from ray_tpu.util.usage import record_library_usage as _record_usage
 _record_usage("graph")

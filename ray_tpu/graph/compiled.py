@@ -44,32 +44,77 @@ class _PipelineStage:
         self._inner = cls(*init_args, **init_kwargs)
 
     def run_loop(self, method: str, in_ch, out_ch) -> bool:
+        """Linear-pipeline loop (single input channel)."""
+        return self.run_graph_loop(method, [("ch", in_ch)], out_ch, None)
+
+    def run_graph_loop(self, method: str, in_specs, out_ch,
+                       collective_spec) -> bool:
+        """General exec loop: reads one value per iteration from each
+        distinct input channel (fan-in), calls the method, optionally
+        allreduces the result across the DAG's collective group
+        (reference ``collective_node.py`` lowering), writes the output.
+
+        ``in_specs``: ordered arg slots — ("ch", channel) | ("const", v).
+        ``collective_spec``: None | (group_name, rank, world, op).
+        """
         from ray_tpu.graph.channels import ChannelClosed
 
         fn = getattr(self._inner, method)
+        if collective_spec is not None:
+            group_name, rank, world, coll_op = collective_spec
+            from ray_tpu import collective as _coll
+
+            # all stage loops start concurrently → rendezvous completes
+            _coll.init_collective_group(world, rank, backend="kv",
+                                        group_name=group_name)
+        # distinct channels: a channel feeding two arg slots is read ONCE
+        # per iteration (one version = one logical value)
+        distinct = []
+        for kind, v in in_specs:
+            if kind == "ch" and all(v is not c for c in distinct):
+                distinct.append(v)
         while True:
             try:
-                value = in_ch.read(timeout_s=3600.0)
+                by_ch = {id(ch): ch.read(timeout_s=3600.0)
+                         for ch in distinct}
             except (ChannelClosed, TimeoutError):
                 break
-            if isinstance(value, _StageError):
+            args = [by_ch[id(v)] if kind == "ch" else v
+                    for kind, v in in_specs]
+            err = next((a for a in args if isinstance(a, _StageError)), None)
+            if err is not None:
                 try:  # propagate an upstream failure to the driver
-                    out_ch.write(value)
+                    if out_ch is not None:
+                        out_ch.write(err)
                 except ChannelClosed:
                     pass
                 continue
             try:
-                result = fn(value)
+                result = fn(*args)
+                if collective_spec is not None:
+                    import numpy as _np
+
+                    reduced = _coll.allreduce(
+                        _np.asarray(result), group_name=group_name)
+                    if coll_op == "mean":
+                        reduced = reduced / world
+                    result = reduced
             except Exception as e:  # noqa: BLE001 — user stage error
                 import traceback as _tb
 
                 result = _StageError(repr(e), _tb.format_exc())
+            # out_ch is None for a collective rank whose reduced output has
+            # no consumer: it still computes + allreduces every item (the
+            # group needs all ranks), then discards the result.
+            if out_ch is None:
+                continue
             try:
                 out_ch.write(result)
             except ChannelClosed:
                 break
         try:
-            out_ch.close()
+            if out_ch is not None:
+                out_ch.close()
         except Exception:  # noqa: BLE001
             pass
         return True
@@ -128,65 +173,162 @@ class CompiledDAG:
             self._instantiate_actors()
 
     # --------------------------------------------------- channel pipeline
-    def _linear_stages(self):
-        """(class_node, method) per stage if the DAG is a linear actor
-        pipeline rooted at one InputNode, else None."""
-        out = self._root
-        if isinstance(out, MultiOutputNode):
-            if len(out._bound_args) != 1:
-                return None
-            out = out._bound_args[0]
-        stages = []
-        node = out
-        while isinstance(node, ClassMethodNode):
-            if not node._parent_is_node:
-                return None  # live-handle stages keep the RPC path
-            data_args = node._data_args()
-            deps = [a for a in data_args if isinstance(a, DAGNode)]
-            # exactly ONE arg and it is the upstream value: the resident
-            # loop calls fn(value), so bound constants would be silently
-            # dropped — reject at compile time instead
-            if len(deps) != 1 or len(data_args) != 1 or node._bound_kwargs:
-                return None
-            stages.append((node._parent, node._method))
-            node = deps[0]
-        if not isinstance(node, InputNode) or not stages:
-            return None
-        return list(reversed(stages))
-
     def _compile_channel_pipeline(self, capacity: int):
+        """Lower the DAG onto preallocated shm channels.
+
+        General (non-linear) lowering: one channel per producer node
+        (InputNode / stage output) with ``num_readers`` = number of distinct
+        consumer processes — the native channel's broadcast semantics give
+        fan-out for free; fan-in stages read one value per input channel
+        per iteration. ``CollectiveOutputNode`` groups lower to an
+        allreduce INSIDE each participating stage (reference
+        ``collective_node.py:23``), so reduced tensors flow downstream
+        without driver involvement.
+        """
         import cloudpickle
 
         import ray_tpu
         from ray_tpu.graph.channels import ShmChannel
+        from ray_tpu.graph.collective_node import CollectiveOutputNode
 
-        stages = self._linear_stages()
-        if stages is None:
+        input_node: Optional[InputNode] = None
+        stage_nodes: List[ClassMethodNode] = []
+        for node in self._schedule:
+            if isinstance(node, InputNode):
+                if input_node is not None:
+                    raise ValueError("a DAG must have exactly one InputNode")
+                input_node = node
+            elif isinstance(node, ClassMethodNode):
+                if not node._parent_is_node:
+                    raise ValueError(
+                        "channels=True requires DAG-owned actors "
+                        "(ClassNode.bind), not live handles")
+                if node._bound_kwargs:
+                    raise ValueError(
+                        "channel stages take positional args only")
+                stage_nodes.append(node)
+            elif isinstance(node, InputAttributeNode):
+                raise ValueError(
+                    "channel DAGs take exactly one positional input")
+            elif not isinstance(node, (ClassNode, MultiOutputNode,
+                                       CollectiveOutputNode)):
+                raise TypeError(
+                    f"cannot channel-compile {type(node).__name__}")
+        if input_node is None or not stage_nodes:
             raise ValueError(
-                "channels=True requires a linear actor pipeline "
-                "(InputNode -> method -> method -> ...)")
+                "channels=True requires an InputNode feeding actor stages")
+
+        # collective groups: every branch input must be a distinct stage
+        coll_specs: Dict[int, tuple] = {}  # id(stage node) -> spec
+        coll_ops = {}
+        for node in self._schedule:
+            if not isinstance(node, CollectiveOutputNode):
+                continue
+            op = node._op
+            if id(op) in coll_ops:
+                continue
+            coll_ops[id(op)] = op
+            # register EVERY branch of the op: a rank whose reduced output
+            # is unconsumed is unreachable from the root, but the group
+            # still needs it participating in every allreduce
+            for rank, src in enumerate(op.inputs):
+                if not isinstance(src, ClassMethodNode):
+                    raise ValueError(
+                        "collective inputs must be actor-method nodes")
+                coll_specs[id(src)] = (op.group_name, rank,
+                                       op.world_size, op.op)
+
+        def producer_of(node):
+            """The node whose output channel carries ``node``'s value."""
+            if isinstance(node, CollectiveOutputNode):
+                return node._op.inputs[node._index]
+            return node
+
+        # outputs (driver-read channels), in declared order
+        root = self._root
+        out_nodes = (list(root._bound_args)
+                     if isinstance(root, MultiOutputNode) else [root])
+        self._multi_output = isinstance(root, MultiOutputNode)
+        out_producers = [producer_of(n) for n in out_nodes]
+
+        # consumer census per producer: distinct stages + the driver
+        consumers: Dict[int, set] = {}
+        for stage in stage_nodes:
+            for arg in stage._data_args():
+                if isinstance(arg, DAGNode):
+                    p = producer_of(arg)
+                    consumers.setdefault(id(p), set()).add(id(stage))
+        for p in out_producers:
+            consumers.setdefault(id(p), set()).add("driver")
+
+        # a collective stage's pre-reduce value must not ALSO be consumed
+        # directly (its channel carries only the reduced value)
+        for node in self._schedule:
+            if isinstance(node, ClassMethodNode) and id(node) in coll_specs:
+                direct = [
+                    s for s in stage_nodes
+                    if any(a is node for a in s._data_args()
+                           if isinstance(a, DAGNode))
+                ]
+                if direct or any(o is node for o in out_nodes):
+                    raise ValueError(
+                        "a stage feeding a collective cannot also be "
+                        "consumed directly (the reduced value replaces "
+                        "its output)")
+
         tag = uuid.uuid4().hex[:12]
-        self._channels = [
-            ShmChannel(f"/rtch_{tag}_{i}", capacity=capacity, num_readers=1)
-            for i in range(len(stages) + 1)]
-        for ch in self._channels:
-            ch._handle()  # create the segments before actors open them
+        chan_by_producer: Dict[int, ShmChannel] = {}
+        all_channels: List[ShmChannel] = []
+        for i, node in enumerate([input_node] + stage_nodes):
+            n_readers = len(consumers.get(id(node), set()))
+            if n_readers == 0:
+                if node is input_node:
+                    raise ValueError("no stage consumes the DAG input")
+                continue  # dead stage output: skip the channel
+            ch = ShmChannel(f"/rtch_{tag}_{i}", capacity=capacity,
+                            num_readers=n_readers)
+            ch._handle()  # create segments before actors open them
+            chan_by_producer[id(node)] = ch
+            all_channels.append(ch)
+        self._in_channel = chan_by_producer[id(input_node)]
+        self._out_channels = []
+        for p in out_producers:
+            if id(p) not in chan_by_producer:
+                raise ValueError(
+                    "DAG output must be a stage output or collective result")
+            self._out_channels.append(chan_by_producer[id(p)])
+        self._channels = all_channels
+
         remote_stage = ray_tpu.remote(_PipelineStage)
-        for i, (class_node, method) in enumerate(stages):
+        for stage in stage_nodes:
+            class_node = stage._parent
             opts = dict(class_node._options or {})
             opts.setdefault("num_cpus", 0)
             handle = remote_stage.options(**opts).remote(
                 cloudpickle.dumps(class_node._actor_class._cls),
                 class_node._bound_args, class_node._bound_kwargs)
             self._owned_actors.append(handle)
-            self._loop_refs.append(handle.run_loop.remote(
-                method, self._channels[i], self._channels[i + 1]))
+            in_specs = []
+            for arg in stage._data_args():
+                if isinstance(arg, DAGNode):
+                    in_specs.append(
+                        ("ch", chan_by_producer[id(producer_of(arg))]))
+                else:
+                    in_specs.append(("const", arg))
+            out_ch = chan_by_producer.get(id(stage))
+            if out_ch is None and id(stage) not in coll_specs:
+                continue  # output never consumed: don't run the loop
+            # (a collective rank ALWAYS runs — the group needs every rank
+            # even when its reduced output has no consumer)
+            self._loop_refs.append(handle.run_graph_loop.remote(
+                stage._method, in_specs, out_ch,
+                coll_specs.get(id(stage))))
 
     def _read_result(self, seq: int, timeout_s: float):
         if seq in self._result_buf:
             return self._result_buf.pop(seq)
         while self._read_seq <= seq:
-            value = self._channels[-1].read(timeout_s=timeout_s)
+            value = self._read_one_output(timeout_s)
             got = self._read_seq
             self._read_seq += 1
             if got == seq:
@@ -194,14 +336,34 @@ class CompiledDAG:
             self._result_buf[got] = value
         raise RuntimeError(f"result {seq} already consumed")
 
+    def _read_one_output(self, timeout_s: float):
+        """One aligned read across every output channel; a single-output
+        DAG returns the bare value, MultiOutputNode returns the list.
+
+        Only the FIRST channel is read at ``timeout_s``: once it has item k,
+        every sibling channel will produce item k too (aligned FIFO), so
+        the remaining reads use a generous timeout — a 0-timeout probe on
+        the first channel can then never strand a partial read."""
+        values = [self._out_channels[0].read(timeout_s=timeout_s)]
+        values += [ch.read(timeout_s=max(timeout_s, 60.0))
+                   for ch in self._out_channels[1:]]
+        err = next((v for v in values if isinstance(v, _StageError)), None)
+        if err is not None:
+            return err
+        if not self._multi_output:
+            return values[0]
+        return values
+
     def _validate(self):
+        from ray_tpu.graph.collective_node import CollectiveOutputNode
+
         n_inputs = sum(isinstance(n, InputNode) for n in self._schedule)
         if n_inputs > 1:
             raise ValueError("a DAG must have exactly one InputNode")
         for node in self._schedule:
             if isinstance(node, (InputNode, InputAttributeNode, ClassNode,
                                  ClassMethodNode, FunctionNode,
-                                 MultiOutputNode)):
+                                 MultiOutputNode, CollectiveOutputNode)):
                 continue
             raise TypeError(f"cannot compile node type {type(node).__name__}")
 
@@ -233,13 +395,13 @@ class CompiledDAG:
                 # the subsequent write wait on the fast (condvar) path
                 try:
                     while True:
-                        value = self._channels[-1].read(timeout_s=0.0)
+                        value = self._read_one_output(timeout_s=0.0)
                         self._result_buf[self._read_seq] = value
                         self._read_seq += 1
                 except TimeoutError:
                     pass
                 try:
-                    self._channels[0].write(args[0], timeout_s=0.02)
+                    self._in_channel.write(args[0], timeout_s=0.02)
                     break
                 except TimeoutError:
                     if time.monotonic() > deadline:

@@ -1,0 +1,154 @@
+"""Non-linear channel DAGs + collective nodes (reference
+python/ray/dag/collective_node.py:23, compiled_dag_node.py channel
+lowering for branching DAGs)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.graph import InputNode, MultiOutputNode, allreduce
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Arith:
+    def __init__(self, k=1):
+        self.k = k
+
+    def add(self, x):
+        return x + self.k
+
+    def mul(self, x):
+        return x * self.k
+
+    def combine(self, a, b):
+        return (a, b)
+
+    def addc(self, x, c):
+        return x + c
+
+
+class TestDiamondDag:
+    def test_diamond_channels(self, rt):
+        """input → A → (B, C) → D(b, c): fan-out via channel broadcast,
+        fan-in via per-channel reads."""
+        with InputNode() as inp:
+            a = Arith.bind(10).add.bind(inp)       # x + 10
+            b = Arith.bind(2).mul.bind(a)          # (x+10) * 2
+            c = Arith.bind(100).add.bind(a)        # (x+10) + 100
+            dag = Arith.bind().combine.bind(b, c)
+        compiled = dag.experimental_compile(channels=True)
+        try:
+            for x in range(5):
+                got = compiled.execute(x).get()
+                assert got == ((x + 10) * 2, x + 10 + 100), (x, got)
+        finally:
+            compiled.teardown()
+
+    def test_multi_output_channels(self, rt):
+        with InputNode() as inp:
+            a = Arith.bind(1).add.bind(inp)
+            b = Arith.bind(3).mul.bind(a)
+            c = Arith.bind(7).add.bind(a)
+            dag = MultiOutputNode([b, c])
+        compiled = dag.experimental_compile(channels=True)
+        try:
+            for x in (0, 4):
+                got = compiled.execute(x).get()
+                assert got == [(x + 1) * 3, x + 1 + 7]
+        finally:
+            compiled.teardown()
+
+    def test_constants_in_stage_args(self, rt):
+        with InputNode() as inp:
+            dag = Arith.bind().addc.bind(inp, 42)
+        compiled = dag.experimental_compile(channels=True)
+        try:
+            assert compiled.execute(1).get() == 43
+        finally:
+            compiled.teardown()
+
+    def test_pipelined_diamond_many_items(self, rt):
+        with InputNode() as inp:
+            a = Arith.bind(0).add.bind(inp)
+            b = Arith.bind(2).mul.bind(a)
+            c = Arith.bind(5).mul.bind(a)
+            dag = Arith.bind().combine.bind(b, c)
+        compiled = dag.experimental_compile(channels=True)
+        try:
+            results = [compiled.execute(i) for i in range(12)]
+            got = [r.get() for r in results]
+            assert got == [(i * 2, i * 5) for i in range(12)]
+        finally:
+            compiled.teardown()
+
+
+@ray_tpu.remote
+class GradWorker:
+    def __init__(self, scale):
+        self.scale = scale
+
+    def grad(self, x):
+        return np.asarray(x, np.float32) * self.scale
+
+    def norm(self, g):
+        return float(np.sum(g))
+
+
+class TestCollectiveNodes:
+    def test_allreduce_eager(self, rt):
+        """Eager execution: driver-side reduction, same semantics."""
+        workers = [GradWorker.bind(s) for s in (1.0, 2.0, 3.0)]
+        with InputNode() as inp:
+            outs = [w.grad.bind(inp) for w in workers]
+            reduced = allreduce.bind(outs)
+            dag = MultiOutputNode(reduced)
+        refs = dag.execute(np.ones(4, np.float32))
+        vals = ray_tpu.get(refs)
+        for v in vals:
+            np.testing.assert_allclose(v, np.full(4, 6.0))
+
+    def test_allreduce_between_channel_stages(self, rt):
+        """Channel-compiled: the allreduce runs INSIDE the stage actors
+        (collective group over the stages), and the reduced tensor feeds
+        the downstream stage — the reference's collective-node lowering."""
+        workers = [GradWorker.bind(s) for s in (1.0, 2.0)]
+        with InputNode() as inp:
+            outs = [w.grad.bind(inp) for w in workers]
+            reduced = allreduce.bind(outs)
+            # downstream consumer of ONE reduced branch
+            dag = GradWorker.bind(0.0).norm.bind(reduced[0])
+        compiled = dag.experimental_compile(channels=True)
+        try:
+            for k in (1.0, 2.0):
+                x = np.full(8, k, np.float32)
+                # sum over workers: (1+2)*k per element, 8 elements
+                assert compiled.execute(x).get(timeout_s=120) == \
+                    pytest.approx(8 * 3.0 * k)
+        finally:
+            compiled.teardown()
+
+    def test_allreduce_mean(self, rt):
+        workers = [GradWorker.bind(s) for s in (2.0, 4.0)]
+        with InputNode() as inp:
+            outs = [w.grad.bind(inp) for w in workers]
+            reduced = allreduce.bind(outs, op="mean")
+            dag = MultiOutputNode(reduced)
+        vals = ray_tpu.get(dag.execute(np.ones(2, np.float32)))
+        np.testing.assert_allclose(vals[0], np.full(2, 3.0))
+
+    def test_collective_stage_direct_consumption_rejected(self, rt):
+        workers = [GradWorker.bind(1.0), GradWorker.bind(2.0)]
+        with InputNode() as inp:
+            outs = [w.grad.bind(inp) for w in workers]
+            reduced = allreduce.bind(outs)
+            # outs[0] consumed BOTH by the collective and directly
+            dag = MultiOutputNode([reduced[0], outs[0]])
+        with pytest.raises(ValueError):
+            dag.experimental_compile(channels=True)
