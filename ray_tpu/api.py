@@ -159,6 +159,8 @@ def init(
             node_id=node_id,
         )
         cw.job_runtime_env = dict(runtime_env) if runtime_env else None
+        if GLOBAL_CONFIG.get("log_to_driver"):
+            _subscribe_worker_logs(cw)
         atexit.register(_shutdown_atexit)
         out = {"gcs_address": gcs_address, "node_id": node_id.hex()}
         if dashboard and _head is not None:
@@ -170,6 +172,29 @@ def init(
             _head["dashboard"] = dash
             out["dashboard_url"] = dash.url
         return out
+
+
+def _subscribe_worker_logs(cw) -> None:
+    """Print worker stdout/stderr lines this job produced, ``(pid=…)``
+    prefixed (reference log_monitor.py → driver UX)."""
+    import sys as _sys
+
+    my_job = cw.job_id.hex()
+
+    def on_log(_key, msg):
+        if msg.get("job_id") not in ("", my_job):
+            return  # another driver's workers
+        name = msg.get("actor_name") or ""
+        tag = (f"{name} pid={msg.get('pid')}" if name
+               else f"pid={msg.get('pid')}")
+        out = _sys.stderr if msg.get("stream") == "stderr" else _sys.stdout
+        for line in msg.get("lines", []):
+            print(f"({tag}) {line}", file=out)
+
+    try:
+        cw.gcs.subscriber.subscribe("worker_log", on_log)
+    except Exception:  # noqa: BLE001 — log relay is best-effort
+        logger.debug("worker-log subscription failed", exc_info=True)
 
 
 def _shutdown_atexit():

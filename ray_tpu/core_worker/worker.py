@@ -1270,6 +1270,9 @@ class CoreWorker:
         # process IS the materialized env, so this is just spec plumbing.
         if task.runtime_env is not None:
             self.job_runtime_env = task.runtime_env
+        if task.job_id is not None:
+            # log-relay attribution: this worker now works for that job
+            self.current_job_hex = task.job_id.hex()
         loop = asyncio.get_running_loop()
         if task.is_actor_task() and self._is_async_actor_call(task):
             # Async actor fast path: never parks a pool thread across the
@@ -1347,6 +1350,8 @@ class CoreWorker:
         task: TaskSpec = pickle.loads(creation_spec)
         if task.runtime_env is not None:
             self.job_runtime_env = task.runtime_env  # children inherit
+        if task.job_id is not None:
+            self.current_job_hex = task.job_id.hex()
         loop = asyncio.get_running_loop()
 
         def create():

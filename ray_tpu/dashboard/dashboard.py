@@ -32,6 +32,7 @@ class Dashboard:
                  host: str = "127.0.0.1", port: int = 0):
         self._gcs_address = tuple(gcs_address)
         self._gcs = GcsClient(self._gcs_address, client_id="dashboard")
+        self._session_dir = session_dir
         self.job_manager = JobManager(self._gcs_address, session_dir)
         self._http = HttpServer(host, port)
         self._io = IoContext.current()
@@ -79,6 +80,9 @@ class Dashboard:
         r("DELETE", "/api/jobs/{sid}", self._delete_job)
         r("GET", "/api/jobs/{sid}/logs", self._job_logs)
         r("GET", "/api/jobs/{sid}/logs/tail", self._job_logs_tail)
+        # session log files (reference: dashboard log module / log_monitor)
+        r("GET", "/api/logs", self._list_logs)
+        r("GET", "/api/logs/{name}", self._get_log)
 
     # ------------------------------------------------------------- handlers
     async def _version(self, _req: HttpRequest):
@@ -169,6 +173,42 @@ class Dashboard:
     async def _job_logs_tail(self, req: HttpRequest):
         return StreamResponse(
             self.job_manager.tail_logs(req.path_params["sid"]))
+
+    # log handlers ---------------------------------------------------------
+    async def _list_logs(self, _req: HttpRequest):
+        import os
+
+        def scan():
+            out = []
+            for fname in sorted(os.listdir(self._session_dir)):
+                path = os.path.join(self._session_dir, fname)
+                if os.path.isfile(path) and fname.endswith(".log"):
+                    out.append({"name": fname,
+                                "size_bytes": os.path.getsize(path)})
+            return out
+
+        return await asyncio.to_thread(scan)
+
+    async def _get_log(self, req: HttpRequest):
+        import os
+
+        name = req.path_params["name"]
+        if "/" in name or ".." in name:
+            return HttpResponse({"error": "bad log name"}, 400)
+        path = os.path.join(self._session_dir, name)
+        if not os.path.isfile(path):
+            return HttpResponse({"error": "no such log"}, 404)
+        tail = int(req.query.get("tail", "0") or 0)
+
+        def read():
+            with open(path, "r", errors="replace") as f:
+                text = f.read()
+            if tail > 0:
+                text = "\n".join(text.splitlines()[-tail:])
+            return text
+
+        return HttpResponse(await asyncio.to_thread(read),
+                            content_type="text/plain")
 
     async def _index(self, _req: HttpRequest):
         return HttpResponse(_INDEX_HTML, content_type="text/html")

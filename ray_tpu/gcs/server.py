@@ -276,6 +276,7 @@ class GcsServer:
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
             "add_task_events", "get_task_events",
             "get_system_config", "health_check", "debug_state",
+            "publish_worker_log",
         ):
             s.register(name, getattr(self, f"h_{name}"))
 
@@ -872,6 +873,18 @@ class GcsServer:
             jid = JobID(job_id).hex()
             evs = [e for e in evs if e.get("job_id") == jid]
         return evs[-limit:]
+
+    # ---------------------------------------------------------- worker logs
+    async def h_publish_worker_log(self, job_id: str, pid: int,
+                                   worker_id: str, stream: str,
+                                   lines: List[str], actor_name: str = ""):
+        """Relay a batch of worker stdout/stderr lines to subscribed
+        drivers (reference: log_monitor.py tail → GCS pubsub → driver)."""
+        self.publisher.publish("worker_log", job_id or "", {
+            "job_id": job_id, "pid": pid, "worker_id": worker_id,
+            "stream": stream, "lines": lines, "actor_name": actor_name,
+        })
+        return True
 
     # ------------------------------------------------------------------ misc
     async def h_get_system_config(self):
