@@ -134,7 +134,9 @@ _D("lease_request_batch_size", int, 10, "leases requested per shape at once")
 _D("log_to_driver", bool, True,
    "stream worker stdout/stderr to subscribed drivers via GCS pubsub")
 _D("worker_log_flush_interval_s", float, 0.2, "worker log relay batch period")
-_D("num_prestart_workers", int, 0, "workers forked at raylet boot")
+_D("num_prestart_workers", int, 2, "workers forked at raylet boot")
+_D("worker_factory_enabled", bool, True,
+   "forkserver worker factory: fork warm interpreters instead of exec")
 _D("worker_register_timeout_s", int, 60, "")
 _D("idle_worker_killing_time_threshold_ms", int, 1000, "idle reap threshold")
 _D("maximum_startup_concurrency", int, 4, "concurrent worker forks")

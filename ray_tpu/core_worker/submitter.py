@@ -39,9 +39,28 @@ class NormalTaskSubmitter:
         self._queues: Dict[tuple, List[TaskSpec]] = {}
         self._leases_in_flight: Dict[tuple, int] = {}
         self._lease_counter = 0
+        self._pending: List[TaskSpec] = []
+        self._pending_lock = threading.Lock()
+        self._wakeup_scheduled = False
 
     def submit(self, spec: TaskSpec):
-        self._io.loop.call_soon_threadsafe(self._enqueue, spec)
+        # Batched wakeup: a burst of submits from caller threads schedules
+        # ONE loop callback that drains them all, instead of one
+        # call_soon_threadsafe (pipe write + loop iteration) per task —
+        # the n:n fan-out paths are wakeup-bound otherwise.
+        with self._pending_lock:
+            self._pending.append(spec)
+            if self._wakeup_scheduled:
+                return
+            self._wakeup_scheduled = True
+        self._io.loop.call_soon_threadsafe(self._drain_pending)
+
+    def _drain_pending(self):
+        with self._pending_lock:
+            specs, self._pending = self._pending, []
+            self._wakeup_scheduled = False
+        for spec in specs:
+            self._enqueue(spec)
 
     def _enqueue(self, spec: TaskSpec):
         key = spec.shape_key()
@@ -201,6 +220,12 @@ class ActorTaskSubmitter:
         self._pump_scheduled = False
         self._resolving = False
         self._seq_lock = threading.Lock()
+        self._pending: List[TaskSpec] = []
+        self._pending_lock = threading.Lock()
+        self._wakeup_scheduled = False
+        # set by pubsub actor-state events: resolution wakes immediately on
+        # ALIVE instead of sleeping a fixed poll interval
+        self._state_event = asyncio.Event()
 
     def next_seq(self) -> int:
         # Called from arbitrary caller threads (e.g. a server fanning out
@@ -213,7 +238,20 @@ class ActorTaskSubmitter:
             return self._seq
 
     def submit(self, spec: TaskSpec):
-        self._io.loop.call_soon_threadsafe(self._enqueue, spec)
+        # batched wakeup (see NormalTaskSubmitter.submit)
+        with self._pending_lock:
+            self._pending.append(spec)
+            if self._wakeup_scheduled:
+                return
+            self._wakeup_scheduled = True
+        self._io.loop.call_soon_threadsafe(self._drain_pending)
+
+    def _drain_pending(self):
+        with self._pending_lock:
+            specs, self._pending = self._pending, []
+            self._wakeup_scheduled = False
+        for spec in specs:
+            self._enqueue(spec)
 
     def _enqueue(self, spec: TaskSpec):
         if self._state == "DEAD":
@@ -286,7 +324,13 @@ class ActorTaskSubmitter:
             if state == "DEAD":
                 self._mark_dead(ActorDiedError(self.actor_id, info.get("death_cause", "")))
                 return
-            await asyncio.sleep(0.2)
+            # actor still PENDING/RESTARTING: wake on the pubsub state
+            # event (sub-ms after ALIVE) with a poll-interval fallback
+            self._state_event.clear()
+            try:
+                await asyncio.wait_for(self._state_event.wait(), 0.2)
+            except asyncio.TimeoutError:
+                pass
         self._mark_dead(ActorDiedError(self.actor_id, "timed out resolving actor address"))
 
     def _encode_spec(self, spec: TaskSpec) -> bytes:
@@ -358,6 +402,7 @@ class ActorTaskSubmitter:
     def notify_actor_state(self, view: dict):
         """Pubsub-driven: DEAD → fail; ALIVE after restart → reconnect."""
         state = view.get("state")
+        self._io.loop.call_soon_threadsafe(self._state_event.set)
         if state == "DEAD" and self._state != "DEAD":
             self._io.loop.call_soon_threadsafe(
                 self._mark_dead, ActorDiedError(self.actor_id, view.get("death_cause", "")))

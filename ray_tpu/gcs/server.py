@@ -576,7 +576,12 @@ class GcsServer:
         )
         self._actors[aid] = rec
         self._persist_actor(rec)
-        await self._schedule_actor(rec)
+        # Registration returns immediately (reference semantics: actor
+        # creation is ASYNC — ActorClass.remote() must not block the driver
+        # for the whole spawn chain); scheduling proceeds concurrently, so
+        # a burst of creations parallelizes across the worker pool's
+        # startup concurrency instead of serializing end-to-end.
+        self._io.spawn(self._schedule_actor(rec))
         return {"ok": True}
 
     async def _schedule_actor(self, rec: ActorRecord):

@@ -102,12 +102,12 @@ def pick_victim(workers: List, rss_fn: Callable[[int], int] = process_rss):
     breaking ties by largest RSS so one kill actually relieves pressure."""
     candidates = []
     for w in workers:
-        if w.state not in ("LEASED", "ACTOR") or w.proc is None:
+        if w.state not in ("LEASED", "ACTOR") or w.pid is None:
             continue
-        if w.proc.poll() is not None:
+        if not w.alive():
             continue
         retriable = w.state == "LEASED"  # tasks retry; actors restart at cost
-        rss = rss_fn(w.proc.pid)
+        rss = rss_fn(w.pid)
         candidates.append((retriable, w.idle_since, rss, w))
     if not candidates:
         return None

@@ -19,6 +19,7 @@ import asyncio
 import logging
 import os
 import pickle
+import signal
 import subprocess
 import sys
 import time
@@ -57,6 +58,55 @@ class WorkerHandle:
     actor_id: Optional[bytes] = None
     idle_since: float = field(default_factory=time.monotonic)
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
+    # factory-forked workers have a bare pid instead of a Popen handle
+    factory_pid: Optional[int] = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else self.factory_pid
+
+    def alive(self) -> bool:
+        if self.proc is not None:
+            return self.proc.poll() is None
+        if self.factory_pid is None:
+            return False
+        try:
+            os.kill(self.factory_pid, 0)  # zombies are reaped by the factory
+            return True
+        except OSError:
+            return False
+
+    def exit_reason(self) -> str:
+        if self.proc is not None:
+            return f"exit code {self.proc.returncode}"
+        return "process gone"
+
+    def _signal(self, sig) -> None:
+        if self.proc is not None:
+            (self.proc.terminate if sig == signal.SIGTERM
+             else self.proc.kill)()
+        elif self.factory_pid is not None:
+            try:
+                os.kill(self.factory_pid, sig)
+            except OSError:
+                pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def force_kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def wait_dead(self, timeout: float) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pass
+            return
+        deadline = time.monotonic() + timeout
+        while self.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
 
 
 @dataclass
@@ -104,6 +154,8 @@ class Raylet:
         self._stopped = False
         self._bg_tasks: List = []
         self._fake_worker_env = fake_worker_env or {}
+        self._factory = None        # forkserver client (worker_factory.py)
+        self._factory_proc = None
         from ray_tpu.runtime_env.agent import RuntimeEnvAgent
 
         self.runtime_env_agent = RuntimeEnvAgent(self.session_dir)
@@ -142,7 +194,7 @@ class Raylet:
              "address": w.address}
             for w in self._workers.values()
             if w.state == "ACTOR" and w.actor_id is not None
-            and (w.proc is None or w.proc.poll() is None)
+            and w.alive()
         ]
         held_bundles = [
             {"pg_id": pgid.binary(),
@@ -178,21 +230,76 @@ class Raylet:
         self.gcs.subscriber.subscribe("system_config", self._on_system_config)
         self._io.spawn_threadsafe(self._report_loop())
         self._io.spawn_threadsafe(self._reap_loop())
+        if GLOBAL_CONFIG.get("worker_factory_enabled"):
+            self._start_factory()
+        n_prestart = GLOBAL_CONFIG.get("num_prestart_workers")
+        if n_prestart > 0:
+            # warm pool: actor/task creation becomes a registration
+            # handshake instead of an interpreter boot (reference:
+            # worker_pool prestart)
+            async def prestart():
+                for _ in range(n_prestart):
+                    try:
+                        await self._start_worker()
+                    except Exception:  # noqa: BLE001 — warm pool is optional
+                        logger.debug("prestart failed", exc_info=True)
+                        return
+
+            self._io.spawn_threadsafe(prestart())
         logger.info("raylet %s serving at %s", self.node_id.hex()[:8], self.server.address)
+
+    def _start_factory(self):
+        """Boot the forkserver worker factory (worker_factory.py): one warm
+        interpreter whose forks cut worker creation from interpreter-boot
+        cost to ~fork cost."""
+        from ray_tpu.common.tpu_detect import defer_tpu_preload
+        from ray_tpu.raylet.worker_factory import FactoryClient
+
+        sock = os.path.join(self.session_dir,
+                            f"factory_{self.node_id.hex()[:8]}.sock")
+        env = defer_tpu_preload(dict(os.environ))
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if pkg_root not in env.get("PYTHONPATH", "").split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                                 if env.get("PYTHONPATH") else pkg_root)
+        log_path = os.path.join(self.session_dir, "worker_factory.log")
+        self._factory_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.raylet.worker_factory", sock],
+            env=env, stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(sock):
+            if (self._factory_proc.poll() is not None
+                    or time.monotonic() > deadline):
+                logger.warning("worker factory failed to start; "
+                               "falling back to exec spawning")
+                self._factory_proc = None
+                return
+            time.sleep(0.05)
+        self._factory = FactoryClient(sock)
+        logger.debug("worker factory up at %s", sock)
 
     def stop(self):
         self._stopped = True
         for t in self._bg_tasks:
             t.cancel()
         for w in list(self._workers.values()):
-            if w.proc is not None and w.proc.poll() is None:
-                w.proc.terminate()
+            if w.alive():
+                w.terminate()
         for w in list(self._workers.values()):
-            if w.proc is not None:
-                try:
-                    w.proc.wait(timeout=3)
-                except subprocess.TimeoutExpired:
-                    w.proc.kill()
+            w.wait_dead(3.0)
+            if w.alive():
+                w.force_kill()
+        if getattr(self, "_factory", None) is not None:
+            self._factory.shutdown()
+            self._factory = None
+        if getattr(self, "_factory_proc", None) is not None:
+            self._factory_proc.terminate()
+            try:
+                self._factory_proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self._factory_proc.kill()
+            self._factory_proc = None
         self.gcs.close()
         self.server.stop()
         if self.cgroups is not None:
@@ -276,8 +383,9 @@ class Raylet:
         idle_ttl = GLOBAL_CONFIG.get("idle_worker_killing_time_threshold_ms") / 1000.0
         while not self._stopped:
             for w in list(self._workers.values()):
-                if w.proc is not None and w.proc.poll() is not None and w.state != "DEAD":
-                    await self._on_worker_dead(w, f"exit code {w.proc.returncode}")
+                if w.state != "DEAD" and (w.pid is not None) \
+                        and not w.alive():
+                    await self._on_worker_dead(w, w.exit_reason())
             if GLOBAL_CONFIG.get("memory_monitor_enabled"):
                 pressured, frac = self.memory_monitor.is_pressured()
                 if pressured:
@@ -312,11 +420,11 @@ class Raylet:
         # kill FIRST, account after: freeing the lease before the hog is
         # dead would re-grant pending work while pressure is still rising,
         # and the cgroup can only be removed once its member is gone
-        if victim.proc is not None and victim.proc.poll() is None:
-            victim.proc.kill()
+        if victim.alive():
+            victim.force_kill()
             import asyncio as _asyncio
 
-            await _asyncio.to_thread(self._wait_proc, victim.proc, 5.0)
+            await _asyncio.to_thread(victim.wait_dead, 5.0)
         await self._on_worker_dead(
             victim,
             f"killed by the memory monitor: node memory usage "
@@ -360,8 +468,8 @@ class Raylet:
             self.runtime_env_agent.release(w.env_key)
         w.state = "DEAD"
         self._workers.pop(w.worker_id, None)
-        if w.proc is not None and w.proc.poll() is None:
-            w.proc.terminate()
+        if w.alive():
+            w.terminate()
 
     # ------------------------------------------------------------ worker pool
     async def _start_worker(self, ctx=None) -> WorkerHandle:
@@ -391,6 +499,27 @@ class Raylet:
         env["RT_NODE_ID"] = self.node_id.hex()
         env["RT_SESSION_DIR"] = self.session_dir
         log_path = os.path.join(self.session_dir, f"worker-{worker_id.hex()[:8]}.log")
+        # Default-env workers fork off the warm factory (~10 ms); runtime
+        # envs that may swap the interpreter (pip/conda) keep the exec path.
+        if self._factory is not None and ctx.env_key is None:
+            try:
+                pid = await asyncio.to_thread(
+                    self._factory.spawn, env, log_path,
+                    ctx.cwd or os.getcwd())
+                w = WorkerHandle(worker_id=worker_id, proc=None,
+                                 factory_pid=pid, env_key=ctx.env_key)
+                self.runtime_env_agent.acquire(ctx.env_key)
+                if self.cgroups is not None:
+                    cg = self.cgroups.create_worker_cgroup(worker_id.hex())
+                    if cg is not None:
+                        self.cgroups.attach(cg, pid)
+                self._workers[worker_id] = w
+                logger.debug("factory-forked worker %s (pid %s)",
+                             worker_id.hex()[:8], pid)
+                return w
+            except Exception:  # noqa: BLE001 — fall back to exec spawn
+                logger.warning("factory spawn failed; exec fallback",
+                               exc_info=True)
         logfile = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core_worker.worker_main"],
@@ -431,7 +560,7 @@ class Raylet:
         while True:
             for w in self._workers.values():
                 if (w.state == "IDLE" and w.env_key == env_key
-                        and (w.proc is None or w.proc.poll() is None)):
+                        and w.alive()):
                     w.state = "LEASED"
                     return w
             starting_all = [w for w in self._workers.values()
@@ -623,7 +752,7 @@ class Raylet:
         if w is None:
             return False
         self._free_lease(w)
-        if disconnect or w.proc is None or w.proc.poll() is not None:
+        if disconnect or not w.alive():
             self._kill_worker_proc(w)
         else:
             w.state = "IDLE"

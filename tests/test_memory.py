@@ -51,6 +51,13 @@ class _FakeWorker:
     proc: object
     idle_since: float
 
+    @property
+    def pid(self):
+        return self.proc.pid
+
+    def alive(self):
+        return self.proc.poll() is None
+
 
 class _Wid:
     def hex(self):
