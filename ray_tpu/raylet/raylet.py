@@ -267,17 +267,29 @@ class Raylet:
         self._factory_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.raylet.worker_factory", sock],
             env=env, stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
-        deadline = time.monotonic() + 30.0
-        while not os.path.exists(sock):
-            if (self._factory_proc.poll() is not None
-                    or time.monotonic() > deadline):
-                logger.warning("worker factory failed to start; "
-                               "falling back to exec spawning")
-                self._factory_proc = None
-                return
-            time.sleep(0.05)
-        self._factory = FactoryClient(sock)
-        logger.debug("worker factory up at %s", sock)
+
+        def wait_ready(proc=self._factory_proc):
+            # Non-blocking adoption: raylet startup (and anything timing
+            # it, e.g. the autoscaler's launch bookkeeping) must not stall
+            # on interpreter boot; workers exec-spawn until the factory's
+            # socket is up, then forks take over.
+            deadline = time.monotonic() + 30.0
+            while not os.path.exists(sock):
+                if (proc.poll() is not None
+                        or time.monotonic() > deadline
+                        or self._stopped):
+                    logger.warning("worker factory failed to start; "
+                                   "exec spawning stays in effect")
+                    return
+                time.sleep(0.05)
+            if self._factory_proc is proc and not self._stopped:
+                self._factory = FactoryClient(sock)
+                logger.debug("worker factory up at %s", sock)
+
+        import threading as _threading
+
+        _threading.Thread(target=wait_ready, daemon=True,
+                          name="factory-wait").start()
 
     def stop(self):
         self._stopped = True

@@ -177,6 +177,15 @@ class PopulationBasedTraining:
         """"continue" or "exploit"; the controller then calls
         :meth:`exploit` for the clone instructions."""
         self._scores[trial_id] = score
+        # Quantiles over a PARTIAL population mislead: before every trial
+        # has reported, the "top quantile" can be another straggler and a
+        # bad trial exploits a bad donor (then burns its perturbation
+        # window). The controller tells us the population size; hold
+        # exploits until the whole population has scores (reference PBT
+        # quantiles run over all live trials).
+        pop_size = getattr(self, "_population_size", 0)
+        if pop_size and len(self._scores) < pop_size:
+            return "continue"
         last = self._last_perturb.get(trial_id, 0)
         if step - last < self.perturbation_interval:
             return "continue"
@@ -185,9 +194,14 @@ class PopulationBasedTraining:
         k = max(1, int(len(pop) * self.quantile_fraction))
         if len(pop) < 2 * k:
             return "continue"
-        bottom = {tid for tid, _ in pop[:k]}
-        if trial_id not in bottom:
-            return "continue"
+        # Quantile membership by SCORE, ties inclusive: with identity-based
+        # membership two tied stragglers alternate at pop[0] as their
+        # reports interleave and NEITHER ever exploits (each sees the other
+        # as "the" bottom trial).
+        bottom_cut = pop[k - 1][1]
+        top_cut = pop[-k][1]
+        if score > bottom_cut or bottom_cut >= top_cut:
+            return "continue"  # not a straggler / degenerate flat population
         self._exploit_src = [tid for tid, _ in pop[-k:]]
         return "exploit"
 
@@ -451,6 +465,10 @@ class Tuner:
                 expand_param_space(self._space, cfg.num_samples, cfg.seed)))
             pending = sorted(configs.items())
         running: Dict[int, dict] = {}   # trial_id -> {actor, config}
+        if cfg.scheduler is not None and configs:
+            # population-aware schedulers (PBT) gate decisions on full
+            # population coverage
+            cfg.scheduler._population_size = len(configs)
         if cfg.search_alg is None:
             suggest_budget = 0
         else:  # fresh run: all of num_samples; restore: the unsuggested rest
