@@ -25,7 +25,15 @@ class AlgorithmConfig:
     env: Union[str, Any] = "CartPole-v1"
     # factories producing fresh Connector instances per env runner
     connectors: tuple = ()
+    # module-to-env (action-path) connector factories per env runner
+    module_to_env_connectors: tuple = ()
+    # learner-side batch connectors (applied just before each update)
+    learner_connectors: tuple = ()
     num_env_runners: int = 2
+    # vectorized envs per runner (reference num_envs_per_env_runner +
+    # rllib/env/vector/): N env copies per actor, one batched policy
+    # forward per step; sample() then returns N per-env fragments
+    num_envs_per_env_runner: int = 1
     rollout_fragment_length: int = 256
     gamma: float = 0.99
     lr: float = 3e-4
@@ -39,8 +47,12 @@ class AlgorithmConfig:
         self.env = env
         return self
 
-    def env_runners(self, num_env_runners: int) -> "AlgorithmConfig":
+    def env_runners(self, num_env_runners: int,
+                    num_envs_per_env_runner: Optional[int] = None
+                    ) -> "AlgorithmConfig":
         self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -66,13 +78,21 @@ class Algorithm:
         self._env_probe = _probe_env(config.env, config.connectors)
         remote_runner = ray_tpu.remote(EnvRunner)
         actors = [
-            remote_runner.remote(config.env, seed=config.seed,
-                                 worker_index=i,
-                                 connectors=list(config.connectors))
+            remote_runner.remote(
+                config.env, seed=config.seed, worker_index=i,
+                connectors=list(config.connectors),
+                num_envs=getattr(config, "num_envs_per_env_runner", 1),
+                module_to_env_connectors=list(
+                    getattr(config, "module_to_env_connectors", ())))
             for i in range(config.num_env_runners)
         ]
         self.env_runner_group = FaultTolerantActorManager(actors)
         self._return_window: List[float] = []
+        from ray_tpu.rl.connectors import LearnerConnectorPipeline
+
+        self._learner_pipeline = LearnerConnectorPipeline([
+            c if not isinstance(c, type) else c()
+            for c in getattr(config, "learner_connectors", ())])
 
     # -------------------------------------------------------------- train
     def train(self) -> Dict[str, Any]:
@@ -107,7 +127,13 @@ class Algorithm:
             if good:
                 self.env_runner_group.foreach_actor(
                     lambda a: a.set_connector_state.remote(good[0]))
-        return [r.value for r in results if r.ok]
+        out: List[Dict[str, Any]] = []
+        for r in results:
+            if not r.ok:
+                continue
+            # vectorized runners return a LIST of per-env fragments
+            out.extend(r.value if isinstance(r.value, list) else [r.value])
+        return out
 
     def episode_return_mean(self) -> float:
         if not self._return_window:
@@ -116,6 +142,63 @@ class Algorithm:
 
     def get_weights(self) -> Dict[str, np.ndarray]:
         raise NotImplementedError
+
+    # ---------------------------------------------------------- checkpoints
+    def save_checkpoint(self, path: str) -> str:
+        """Component-tree checkpoint (reference: Checkpointable mixin,
+        rllib/utils/checkpoints.py — Algorithm -> Learner weights +
+        connector states on BOTH the env-runner and learner sides)."""
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        runner_states = [
+            r.value for r in self.env_runner_group.foreach_actor(
+                lambda a: a.get_connector_state.remote())
+            if r.ok
+        ]
+        state = {
+            "weights": self.get_weights(),
+            "iteration": self.iteration,
+            "weights_version": self._weights_version,
+            "return_window": list(self._return_window),
+            "env_runner_connector_state": (runner_states[0]
+                                           if runner_states else None),
+            "learner_connector_state": self._learner_pipeline.get_state(),
+        }
+        fname = os.path.join(path, "algorithm_state.pkl")
+        with open(fname, "wb") as f:
+            pickle.dump(state, f)
+        return fname
+
+    def restore_from_checkpoint(self, path: str) -> None:
+        import os
+        import pickle
+
+        fname = (path if path.endswith(".pkl")
+                 else os.path.join(path, "algorithm_state.pkl"))
+        with open(fname, "rb") as f:
+            state = pickle.load(f)
+        self.set_weights(state["weights"])
+        self.iteration = state["iteration"]
+        self._weights_version = state["weights_version"]
+        self._return_window = list(state["return_window"])
+        if state.get("env_runner_connector_state") is not None:
+            cs = state["env_runner_connector_state"]
+            self.env_runner_group.foreach_actor(
+                lambda a: a.set_connector_state.remote(cs))
+        self._learner_pipeline.set_state(
+            state.get("learner_connector_state", {}))
+
+    def set_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        learner = getattr(self, "learner", None)
+        group = getattr(self, "learner_group", None)
+        if group is not None:
+            group.set_weights(weights)
+        elif learner is not None:
+            learner.set_weights(weights)
+        else:
+            raise NotImplementedError
 
     def stop(self):
         for i in list(self.env_runner_group.actors):
@@ -158,6 +241,7 @@ class PPO(Algorithm):
         }
         adv = batch["advantages"]
         batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        batch = self._learner_pipeline(batch)
         metrics = self.learner.update(batch)
         self._weights_version += 1
         self._return_window = (self._return_window + returns)[-100:]

@@ -142,3 +142,120 @@ class FrameStack(Connector):
 
     def transformed_size(self, obs_size: int) -> int:
         return obs_size * self.k
+
+
+# ---------------------------------------------------------- module-to-env
+class ModuleToEnvConnector(Connector):
+    """Action-path transform: what the policy emitted → what the env
+    steps on (reference: rllib module-to-env connector pipeline). Same
+    state/checkpoint contract as the obs side."""
+
+    def __call__(self, action):
+        raise NotImplementedError
+
+
+class ActionLambda(ModuleToEnvConnector):
+    def __init__(self, fn: Callable[[Any], Any]):
+        self._fn = fn
+
+    def __call__(self, action):
+        return self._fn(action)
+
+
+class ActionRepeat(ModuleToEnvConnector):
+    """Sticky actions: repeat the previous action with prob p (the
+    standard Atari stochasticity knob; state = last action)."""
+
+    def __init__(self, p: float = 0.25, seed: int = 0):
+        self.p = p
+        self._rng = np.random.default_rng(seed)
+        self._last = None
+
+    def __call__(self, action):
+        if self._last is not None and self._rng.random() < self.p:
+            return self._last
+        self._last = action
+        return action
+
+    def reset(self):
+        self._last = None
+
+    def get_state(self):
+        return {"last": self._last}
+
+    def set_state(self, state):
+        self._last = state.get("last")
+
+
+# ------------------------------------------------------------ learner side
+class LearnerConnector:
+    """Batch-level transform applied just before the learner update
+    (reference: rllib learner connector pipeline). Operates on the whole
+    train-batch dict; stateful pieces are checkpointable like the env
+    side."""
+
+    def __call__(self, batch: Dict[str, np.ndarray]
+                 ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class LearnerConnectorPipeline(LearnerConnector):
+    def __init__(self, connectors: Optional[List[LearnerConnector]] = None):
+        self.connectors = list(connectors or [])
+
+    def __call__(self, batch):
+        for c in self.connectors:
+            batch = c(batch)
+        return batch
+
+    def get_state(self):
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state):
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+
+class BatchLambda(LearnerConnector):
+    def __init__(self, fn: Callable[[Dict], Dict]):
+        self._fn = fn
+
+    def __call__(self, batch):
+        return self._fn(batch)
+
+
+class AdvantageStandardizer(LearnerConnector):
+    """Zero-mean/unit-std advantages per train batch (the standard PPO
+    stabilizer, expressed as a connector so it is composable/removable)."""
+
+    def __init__(self, key: str = "advantages", eps: float = 1e-8):
+        self.key = key
+        self.eps = eps
+
+    def __call__(self, batch):
+        if self.key in batch:
+            adv = batch[self.key]
+            batch = dict(batch)
+            batch[self.key] = (adv - adv.mean()) / (adv.std() + self.eps)
+        return batch
+
+
+class RewardClip(LearnerConnector):
+    """Clip rewards into [lo, hi] at train time (DQN-style stabilization)."""
+
+    def __init__(self, lo: float = -1.0, hi: float = 1.0,
+                 key: str = "rewards"):
+        self.lo, self.hi, self.key = lo, hi, key
+
+    def __call__(self, batch):
+        if self.key in batch:
+            batch = dict(batch)
+            batch[self.key] = np.clip(batch[self.key], self.lo, self.hi)
+        return batch

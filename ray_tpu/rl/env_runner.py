@@ -30,26 +30,49 @@ def _make_connector(c):
 class EnvRunner:
     def __init__(self, env_spec: Union[str, Any] = "CartPole-v1",
                  seed: int = 0, worker_index: int = 0,
-                 connectors=None):
+                 connectors=None, num_envs: int = 1,
+                 module_to_env_connectors=None):
         from ray_tpu.rl.connectors import ConnectorPipeline
 
-        self.env = make_env(env_spec, seed=seed + worker_index)
+        self.num_envs = max(1, num_envs)
+        # Vectorization (reference rllib/env/vector/): N env copies stepped
+        # in lockstep with ONE batched policy forward per step — sampling
+        # throughput stops walling on per-env matmul overhead.
+        self.envs = [make_env(env_spec,
+                              seed=seed + worker_index * 1000 + i)
+                     for i in range(self.num_envs)]
+        self.env = self.envs[0]  # back-compat alias
         self._rng = np.random.default_rng(seed * 100003 + worker_index)
         self._params: Optional[Params] = None
         # env-to-module pipeline: raw obs -> what the policy consumes
         # (reference connector_v2 env-runner pipeline)
         self._pipeline = ConnectorPipeline(
             [_make_connector(c) for c in (connectors or [])])
-        raw, _ = self.env.reset(seed=seed + worker_index)
-        self._obs = self._pipeline(raw)
+        # module-to-env pipeline: policy action -> env action
+        self._m2e = ConnectorPipeline(
+            [_make_connector(c) for c in (module_to_env_connectors or [])])
+        obs0 = []
+        for i, env in enumerate(self.envs):
+            raw, _ = env.reset(seed=seed + worker_index * 1000 + i)
+            obs0.append(self._pipeline(raw))
+        self._obs = obs0[0]
+        self._obs_vec = np.stack(obs0)
         self._episode_return = 0.0
+        self._episode_returns_vec = np.zeros(self.num_envs)
         self._weights_version = -1
 
     def get_connector_state(self):
+        if self._m2e.connectors:
+            return {"env_to_module": self._pipeline.get_state(),
+                    "module_to_env": self._m2e.get_state()}
         return self._pipeline.get_state()
 
     def set_connector_state(self, state) -> bool:
-        self._pipeline.set_state(state)
+        if isinstance(state, dict) and "env_to_module" in state:
+            self._pipeline.set_state(state["env_to_module"])
+            self._m2e.set_state(state.get("module_to_env", {}))
+        else:
+            self._pipeline.set_state(state)
         return True
 
     def ping(self) -> bool:
@@ -63,7 +86,61 @@ class EnvRunner:
     def get_weights_version(self) -> int:
         return self._weights_version
 
-    def sample(self, num_steps: int) -> Dict[str, Any]:
+    def sample(self, num_steps: int):
+        """One fragment dict for num_envs == 1 (back-compat), else a LIST
+        of per-env fragment dicts — each a normal fragment, so every
+        consumer (GAE, aggregators, v-trace) is unchanged."""
+        if self.num_envs > 1:
+            return self._sample_vector(num_steps)
+        return self._sample_single(num_steps)
+
+    def _sample_vector(self, num_steps: int):
+        from ray_tpu.rl.module import np_forward, np_sample_actions_batch
+
+        assert self._params is not None, "set_weights first"
+        N = self.num_envs
+        obs_buf = np.empty((N, num_steps) + self._obs_vec.shape[1:],
+                           np.float32)
+        act_buf = np.empty((N, num_steps), np.int32)
+        rew_buf = np.empty((N, num_steps), np.float32)
+        done_buf = np.empty((N, num_steps), np.bool_)
+        logp_buf = np.empty((N, num_steps), np.float32)
+        val_buf = np.empty((N, num_steps), np.float32)
+        episode_returns = [[] for _ in range(N)]
+
+        for t in range(num_steps):
+            actions, logps, values = np_sample_actions_batch(
+                self._params, self._obs_vec, self._rng)
+            obs_buf[:, t] = self._obs_vec
+            act_buf[:, t] = actions
+            logp_buf[:, t] = logps
+            val_buf[:, t] = values
+            for i, env in enumerate(self.envs):
+                raw, reward, terminated, truncated, _ = env.step(
+                    self._m2e(int(actions[i])))
+                self._obs_vec[i] = self._pipeline(raw)
+                rew_buf[i, t] = reward
+                done_buf[i, t] = terminated or truncated
+                self._episode_returns_vec[i] += reward
+                if terminated or truncated:
+                    episode_returns[i].append(
+                        float(self._episode_returns_vec[i]))
+                    self._episode_returns_vec[i] = 0.0
+                    raw, _ = env.reset()
+                    self._obs_vec[i] = self._pipeline(raw)
+
+        _, last_vals = np_forward(self._params, self._obs_vec)
+        return [
+            {"obs": obs_buf[i], "actions": act_buf[i],
+             "rewards": rew_buf[i], "dones": done_buf[i],
+             "logp": logp_buf[i], "values": val_buf[i],
+             "last_value": float(last_vals[i]),
+             "episode_returns": episode_returns[i],
+             "weights_version": self._weights_version}
+            for i in range(N)
+        ]
+
+    def _sample_single(self, num_steps: int) -> Dict[str, Any]:
         assert self._params is not None, "set_weights first"
         obs_buf = np.empty((num_steps,) + self._obs.shape, np.float32)
         act_buf = np.empty(num_steps, np.int32)
@@ -80,7 +157,8 @@ class EnvRunner:
             act_buf[t] = action
             logp_buf[t] = logp
             val_buf[t] = value
-            raw, reward, terminated, truncated, _ = self.env.step(action)
+            raw, reward, terminated, truncated, _ = self.env.step(
+                self._m2e(action))
             self._obs = self._pipeline(raw)
             rew_buf[t] = reward
             # Truncation treated as termination for GAE (standard
@@ -91,6 +169,7 @@ class EnvRunner:
                 episode_returns.append(self._episode_return)
                 self._episode_return = 0.0
                 self._pipeline.reset()
+                self._m2e.reset()
                 raw, _ = self.env.reset()
                 self._obs = self._pipeline(raw)
 

@@ -44,10 +44,13 @@ class Aggregator:
         # max_concurrency > 1 runs these sync methods on multiple threads
         self._lock = threading.Lock()
 
-    def add_fragment(self, fragment: Dict[str, np.ndarray]) -> int:
+    def add_fragment(self, fragment) -> int:
+        # vectorized runners ship a LIST of per-env fragments in one call
+        frags = fragment if isinstance(fragment, list) else [fragment]
         with self._lock:
-            self._buffer.append(fragment)
-            self._steps += len(fragment["obs"])
+            for f in frags:
+                self._buffer.append(f)
+                self._steps += len(f["obs"])
             return self._steps
 
     def get_ready_batch(self) -> Optional[Dict[str, Any]]:
@@ -319,7 +322,9 @@ class IMPALA(Algorithm):
             self._agg_rr += 1
             # fragment bytes travel runner→aggregator via the ref
             agg.add_fragment.remote(ref)
-            self._steps_sampled += self.config.rollout_fragment_length
+            self._steps_sampled += (
+                self.config.rollout_fragment_length
+                * getattr(self.config, "num_envs_per_env_runner", 1))
             if idx in self.env_runner_group.healthy_actor_ids():
                 self._kick_runner(idx, self.env_runner_group.actors[idx])
 

@@ -82,3 +82,21 @@ def np_sample_action(params: Params, obs: np.ndarray,
     p /= p.sum()
     action = int(rng.choice(len(p), p=p))
     return action, float(np.log(p[action] + 1e-20)), float(value[0])
+
+
+def np_sample_actions_batch(params: Params, obs: np.ndarray,
+                            rng: np.random.Generator):
+    """Vectorized categorical sample over a batch of observations:
+    (N, obs) → (actions (N,), logps (N,), values (N,)). One forward matmul
+    for the whole env vector — the point of vectorized env runners
+    (reference rllib/env/vector/)."""
+    logits, values = np_forward(params, obs)
+    logits = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(axis=1, keepdims=True)
+    # Gumbel-max: one vectorized draw instead of N rng.choice calls
+    g = rng.gumbel(size=p.shape)
+    actions = (np.log(p + 1e-20) + g).argmax(axis=1)
+    logps = np.log(p[np.arange(len(p)), actions] + 1e-20)
+    return actions.astype(np.int32), logps.astype(np.float32), \
+        values.astype(np.float32)

@@ -1,0 +1,134 @@
+"""Vectorized env runners, module-to-env + learner connectors, and
+Algorithm checkpointing (reference: rllib/env/vector/, connector_v2
+pipelines, Checkpointable)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.connectors import (
+    ActionLambda,
+    AdvantageStandardizer,
+    BatchLambda,
+    LearnerConnectorPipeline,
+    ObsNormalizer,
+    RewardClip,
+)
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.module import init_policy_params
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestVectorizedRunner:
+    def _params(self):
+        return init_policy_params(4, 2, hidden=(16, 16), seed=0)
+
+    def test_vector_returns_per_env_fragments(self, rt):
+        r = EnvRunner("CartPole-v1", seed=0, num_envs=3)
+        r.set_weights(self._params(), 1)
+        frags = r.sample(32)
+        assert isinstance(frags, list) and len(frags) == 3
+        for f in frags:
+            assert f["obs"].shape == (32, 4)
+            assert f["actions"].shape == (32,)
+            assert np.isfinite(f["last_value"])
+            assert f["weights_version"] == 1
+
+    def test_single_env_backcompat(self, rt):
+        r = EnvRunner("CartPole-v1", seed=0, num_envs=1)
+        r.set_weights(self._params(), 1)
+        f = r.sample(16)
+        assert isinstance(f, dict) and f["obs"].shape == (16, 4)
+
+    def test_vector_envs_decorrelated(self, rt):
+        """Different seeds per env copy: trajectories must differ."""
+        r = EnvRunner("CartPole-v1", seed=0, num_envs=2)
+        r.set_weights(self._params(), 1)
+        a, b = r.sample(32)
+        assert not np.allclose(a["obs"], b["obs"])
+
+    def test_ppo_with_vectorized_runners_learns(self, rt):
+        import time
+
+        from ray_tpu.rl import PPOConfig
+
+        algo = PPOConfig(seed=0, hidden=(32, 32), env="CartPole-v1",
+                         num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=128, lr=1e-3).build()
+        best = 0.0
+        deadline = time.monotonic() + 180
+        for _ in range(30):
+            res = algo.train()
+            er = res["env_runners"]["episode_return_mean"]
+            if er == er:
+                best = max(best, er)
+            # 2 runners x 2 envs x 128 steps per iteration
+            assert res["env_runners"]["num_env_steps_sampled"] == 512
+            if best >= 100 or time.monotonic() > deadline:
+                break
+        algo.stop()
+        assert best >= 100, best
+
+
+class TestConnectors:
+    def test_module_to_env_action_transform(self, rt):
+        flipped = []
+
+        def flip(a):
+            flipped.append(a)
+            return 1 - a
+
+        r = EnvRunner("CartPole-v1", seed=0,
+                      module_to_env_connectors=[ActionLambda(flip)])
+        r.set_weights(init_policy_params(4, 2, hidden=(8,), seed=0), 1)
+        r.sample(8)
+        assert len(flipped) == 8  # every action went through the pipeline
+
+    def test_learner_pipeline_order_and_state(self):
+        calls = []
+        pipe = LearnerConnectorPipeline([
+            BatchLambda(lambda b: (calls.append("a"), b)[1]),
+            RewardClip(-1, 1),
+            AdvantageStandardizer(),
+        ])
+        batch = {"rewards": np.array([5.0, -7.0]),
+                 "advantages": np.array([1.0, 3.0], np.float32)}
+        out = pipe(batch)
+        assert calls == ["a"]
+        assert out["rewards"].tolist() == [1.0, -1.0]
+        assert abs(out["advantages"].mean()) < 1e-6
+
+    def test_checkpoint_roundtrip_with_connector_state(self, rt, tmp_path):
+        from ray_tpu.rl import PPOConfig
+
+        algo = PPOConfig(seed=0, hidden=(16,), env="CartPole-v1",
+                         num_env_runners=1, rollout_fragment_length=64,
+                         connectors=(ObsNormalizer,)).build()
+        algo.train()
+        path = algo.save_checkpoint(str(tmp_path / "ckpt"))
+        w0 = algo.get_weights()
+        it0 = algo.iteration
+        states = [r.value for r in algo.env_runner_group.foreach_actor(
+            lambda a: a.get_connector_state.remote()) if r.ok]
+        algo.stop()
+
+        algo2 = PPOConfig(seed=1, hidden=(16,), env="CartPole-v1",
+                          num_env_runners=1, rollout_fragment_length=64,
+                          connectors=(ObsNormalizer,)).build()
+        algo2.restore_from_checkpoint(path)
+        assert algo2.iteration == it0
+        for k in w0:
+            np.testing.assert_array_equal(algo2.get_weights()[k], w0[k])
+        states2 = [r.value for r in algo2.env_runner_group.foreach_actor(
+            lambda a: a.get_connector_state.remote()) if r.ok]
+        # the restored runner's normalizer carries the saved running stats
+        assert states2[0][0]["count"] == states[0][0]["count"]
+        np.testing.assert_allclose(states2[0][0]["mean"],
+                                   states[0][0]["mean"])
+        algo2.stop()
