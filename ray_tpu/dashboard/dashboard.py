@@ -37,6 +37,11 @@ class Dashboard:
         self._http = HttpServer(host, port)
         self._io = IoContext.current()
         self._started = time.time()
+        import collections as _collections
+
+        self._history = _collections.deque(maxlen=720)  # ~1h at 5s period
+        self._history_period = 5.0
+        self._history_stopped = False
         self._register_routes()
 
     @property
@@ -50,15 +55,45 @@ class Dashboard:
 
     def start(self):
         self._io.run(self._http.start(), timeout=10)
+        self._io.spawn_threadsafe(self._history_loop())
         logger.info("dashboard serving at %s", self.url)
 
     def stop(self):
+        self._history_stopped = True
         try:
             self._io.run(self._http.stop(), timeout=5)
         except Exception:  # noqa: BLE001
             pass
         self.job_manager.close()
         self._gcs.close()
+
+    async def _history_loop(self):
+        """Metrics time series (reference: dashboard/modules/metrics —
+        Grafana provisioning; minimum-bar equivalent here is an in-memory
+        ring of cluster snapshots served at /api/metrics/history and
+        charted on the index page)."""
+        while not self._history_stopped:
+            try:
+                res = await self._gcs.call_async("get_cluster_resources")
+                actors = await self._gcs.call_async("list_actors")
+                nodes = await self._gcs.call_async("get_all_nodes")
+                total = res.get("total", {})
+                avail = res.get("available", {})
+                self._history.append({
+                    "ts": time.time(),
+                    "cpu_used": float(total.get("CPU", 0.0)
+                                      - avail.get("CPU", 0.0)),
+                    "cpu_total": float(total.get("CPU", 0.0)),
+                    "tpu_used": float(total.get("TPU", 0.0)
+                                      - avail.get("TPU", 0.0)),
+                    "tpu_total": float(total.get("TPU", 0.0)),
+                    "actors_alive": sum(
+                        1 for a in actors if a["state"] == "ALIVE"),
+                    "nodes_alive": sum(1 for n in nodes if n["alive"]),
+                })
+            except Exception:  # noqa: BLE001 — GCS restarting etc.
+                pass
+            await asyncio.sleep(self._history_period)
 
     # ---------------------------------------------------------------- routes
     def _register_routes(self):
@@ -72,6 +107,7 @@ class Dashboard:
         r("GET", "/api/cluster_resources", self._resources)
         r("GET", "/api/task_events", self._task_events)
         r("GET", "/api/metrics", self._metrics)
+        r("GET", "/api/metrics/history", self._metrics_history)
         # job REST surface (reference job_head.py)
         r("POST", "/api/jobs/", self._submit_job)
         r("GET", "/api/jobs/", self._list_jobs)
@@ -126,6 +162,10 @@ class Dashboard:
 
         return HttpResponse(prometheus_text(),
                             content_type="text/plain; version=0.0.4")
+
+    async def _metrics_history(self, req: HttpRequest):
+        limit = int(req.query.get("limit", "720"))
+        return list(self._history)[-limit:]
 
     # job handlers ---------------------------------------------------------
     async def _submit_job(self, req: HttpRequest):
@@ -230,7 +270,38 @@ _INDEX_HTML = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Cluster over time</h2>
+<div id="charts">
+ <svg id="ch_cpu" width="360" height="70"></svg>
+ <svg id="ch_actors" width="360" height="70"></svg>
+</div>
 <script>
+function sparkline(svgId, label, series, maxv) {
+  const svg = document.getElementById(svgId);
+  const W = 360, H = 70, pad = 14;
+  if (!series.length) { svg.innerHTML = ''; return; }
+  const mx = Math.max(maxv || 0, ...series, 1);
+  const pts = series.map((v, i) => {
+    const x = pad + (W - 2 * pad) * i / Math.max(series.length - 1, 1);
+    const y = H - pad - (H - 2 * pad) * v / mx;
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  }).join(' ');
+  svg.innerHTML =
+    `<rect x="0" y="0" width="${W}" height="${H}" fill="#fafafa" ` +
+    `stroke="#ddd"/>` +
+    `<polyline points="${pts}" fill="none" stroke="#4a7" ` +
+    `stroke-width="1.5"/>` +
+    `<text x="${pad}" y="12" font-size="10" fill="#555">${label} ` +
+    `(now ${series[series.length-1]}, max ${mx})</text>`;
+}
+async function refreshCharts() {
+  const h = await (await fetch('/api/metrics/history?limit=240')).json();
+  sparkline('ch_cpu', 'CPU in use', h.map(s => s.cpu_used),
+            h.length ? h[h.length-1].cpu_total : 0);
+  sparkline('ch_actors', 'actors alive', h.map(s => s.actors_alive), 0);
+}
+setInterval(refreshCharts, 5000);
+refreshCharts();
 async function refresh() {
   const o = await (await fetch('/api/overview')).json();
   document.getElementById('summary').textContent =

@@ -45,6 +45,26 @@ class Replica:
                     "total": float(self._total),
                     "model_ids": multiplex.loaded_model_ids(self._user)}
 
+    def supports_generator_stream(self) -> bool:
+        import inspect
+
+        fn = getattr(self._user, "stream", None)
+        return fn is not None and inspect.isgeneratorfunction(fn)
+
+    def handle_request_stream(self, args, kwargs):
+        """Generator-protocol streaming: the user's ``stream`` generator's
+        items push to the caller via ``num_returns="streaming"`` —
+        per-item delivery with owner-side backpressure, no poll RPCs
+        (reference: Serve response streaming over ObjectRefGenerator)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            yield from self._user.stream(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def handle_request(self, method: str, args, kwargs):
         from ray_tpu.serve import multiplex
 
@@ -281,6 +301,12 @@ class ServeController:
         dep = app["deployment"]
         opts = dict(dep.ray_actor_options)
         opts.setdefault("max_concurrency", dep.max_ongoing_requests)
+        # Deployment scheduler (reference
+        # serve/_private/deployment_scheduler.py): replicas of one
+        # deployment SPREAD across nodes by default, so one node's death
+        # never takes the whole deployment down and per-node proxies have
+        # a local replica to route to. Explicit strategies win.
+        opts.setdefault("scheduling_strategy", "SPREAD")
         remote_cls = ray_tpu.remote(Replica)
         logger.info("starting replica of %s", name)
         return remote_cls.options(**opts).remote(

@@ -303,6 +303,21 @@ class ProxyActor:
             return
         replica, ridx = acquired
         try:
+            use_gen = await loop.run_in_executor(
+                self._pool, lambda: ray_tpu.get(
+                    replica.supports_generator_stream.remote(),
+                    timeout=30.0))
+        except Exception:  # noqa: BLE001 — older replica: poll protocol
+            use_gen = False
+        if use_gen:
+            # streaming-generator protocol: items PUSH from the replica
+            # (num_returns="streaming" + owner backpressure), no poll RPCs
+            try:
+                await self._stream_via_generator(req, replica, writer)
+            finally:
+                handle._state.release(ridx)
+            return
+        try:
             req_id = await loop.run_in_executor(
                 self._pool, lambda: ray_tpu.get(
                     replica.handle_request.remote("submit", (req,), {}),
@@ -342,6 +357,41 @@ class ProxyActor:
                 pass
         finally:
             handle._state.release(ridx)
+        try:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _stream_via_generator(self, req, replica,
+                                    writer: asyncio.StreamWriter):
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        gen = replica.handle_request_stream.options(
+            num_returns="streaming").remote((req,), {})
+        try:
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"content-type: text/event-stream\r\n"
+                         b"cache-control: no-cache\r\n"
+                         b"transfer-encoding: chunked\r\n\r\n")
+            await writer.drain()
+            async for ref in gen:
+                chunk = await loop.run_in_executor(
+                    self._pool, lambda r=ref: ray_tpu.get(r, timeout=60.0))
+                payload = json.dumps(chunk).encode()
+                await self._write_chunk(writer, b"data: " + payload + b"\n\n")
+            await self._write_chunk(writer, b"data: [DONE]\n\n")
+        except (ConnectionError, OSError):
+            gen.close()  # consumer gone: cancel the stream at the replica
+            return
+        except Exception as e:  # noqa: BLE001
+            try:
+                await self._write_chunk(
+                    writer,
+                    b"event: error\ndata: " + str(e).encode() + b"\n\n")
+            except Exception:  # noqa: BLE001
+                pass
         try:
             writer.write(b"0\r\n\r\n")
             await writer.drain()

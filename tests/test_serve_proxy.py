@@ -206,3 +206,41 @@ def test_autoscale_under_http_load(proxy_addr):
         time.sleep(0.5)
     assert serve.status()["slow"]["running_replicas"] <= 1
     serve.delete("slow")
+
+
+def test_sse_generator_protocol_streaming(proxy_addr):
+    """Deployments with a sync-generator ``stream`` method ride the
+    streaming-generator protocol (num_returns="streaming"): items PUSH
+    from the replica through per-item object reports — no poll RPCs."""
+
+    @serve.deployment(name="genstream")
+    class GenStream:
+        def __call__(self, request):
+            return "non-streaming-ok"
+
+        def stream(self, request):
+            for i in range(5):
+                yield {"i": i, "sq": i * i}
+
+    serve.run(GenStream.bind(), name="genstream")
+
+    url = (f"http://{proxy_addr['http_host']}:{proxy_addr['http_port']}"
+           f"/genstream")
+    req = urllib.request.Request(
+        url, data=b"{}", headers={"Accept": "text/event-stream"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers.get_content_type() == "text/event-stream"
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+            if line == "data: [DONE]":
+                break
+    assert events[-1] == "[DONE]"
+    items = [json.loads(e) for e in events[:-1]]
+    assert items == [{"i": i, "sq": i * i} for i in range(5)]
+
+    status, _, body = _http(proxy_addr, "/genstream", data=b"{}")
+    assert status == 200 and b"non-streaming-ok" in body
+    serve.delete("genstream")
