@@ -187,6 +187,31 @@ def _mlp(x, layer):
                       layer["w_down"].astype(x.dtype))
 
 
+def make_block(config: LlamaConfig, rules: ShardingRules, cos, sin,
+               positions=None, mesh=None):
+    """The scanned transformer block as a reusable closure — shared by the
+    full forward and pipeline-parallel stage programs
+    (``models/pipeline.py``), so stage math can never drift from the
+    reference forward."""
+    c = config
+
+    def block(x, layer):
+        h = _attention(rmsnorm(x, layer["attn_norm"], c.norm_eps),
+                       layer, cos, sin, c, rules, positions, mesh)
+        x = x + h
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        x = x + _mlp(rmsnorm(x, layer["mlp_norm"], c.norm_eps), layer)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+        return x, None
+
+    if c.remat:
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if c.remat_policy == "dots"
+                  else jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(block, policy=policy)
+    return block
+
+
 def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
             rules: Optional[ShardingRules] = None,
             positions: Optional[jax.Array] = None, mesh=None) -> jax.Array:
@@ -208,20 +233,7 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
 
-    def block(x, layer):
-        h = _attention(rmsnorm(x, layer["attn_norm"], c.norm_eps),
-                       layer, cos, sin, c, rules, positions, mesh)
-        x = x + h
-        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
-        x = x + _mlp(rmsnorm(x, layer["mlp_norm"], c.norm_eps), layer)
-        x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
-        return x, None
-
-    if c.remat:
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if c.remat_policy == "dots"
-                  else jax.checkpoint_policies.nothing_saveable)
-        block = jax.checkpoint(block, policy=policy)
+    block = make_block(c, rules, cos, sin, positions, mesh)
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)

@@ -1,0 +1,122 @@
+"""Pipeline-parallel model execution over compiled channel DAGs.
+
+Reference: the reference framework has no native pipeline-parallel engine —
+its building block is compiled actor graphs with accelerator channels
+(SURVEY.md §2.3 "Pipeline parallel": ``python/ray/dag/`` + vLLM's
+``pipeline_parallel_size`` delegating stages to actors). This module is the
+TPU-native realization: the model's layer stack is split into contiguous
+stages, each stage is a resident actor holding its parameter shard and ONE
+jitted stage program, and microbatches stream through preallocated shm
+channels — stage k runs microbatch i while stage k+1 runs microbatch i-1,
+which is exactly 1F pipelining (inference/forward).
+
+Within each stage the program is still free to be GSPMD-sharded over its
+own mesh slice (tp/sp inside a stage compose with pp across stages).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class LlamaPipelineStage:
+    """One resident stage: layers [lo, hi) (+ embedding on the first
+    stage, final norm + head on the last). Constructed inside the DAG's
+    stage actor; the channel exec loop calls :meth:`forward` per item."""
+
+    def __init__(self, blob: bytes):
+        import cloudpickle
+        import jax
+
+        spec = cloudpickle.loads(blob)
+        self._cfg = spec["config"]
+        self._params = jax.tree.map(jax.numpy.asarray, spec["params"])
+        self._first = spec["first"]
+        self._last = spec["last"]
+        self._fn = jax.jit(self._apply)
+
+    def _apply(self, params, x):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import make_block
+        from ray_tpu.ops.norms import rmsnorm
+        from ray_tpu.ops.rope import rope_frequencies
+        from ray_tpu.parallel.sharding import ShardingRules
+
+        c = self._cfg
+        rules = ShardingRules()
+        if self._first:
+            x = params["embed"].astype(c.dtype)[x]
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+        block = make_block(c, rules, cos, sin)
+        x, _ = jax.lax.scan(block, x, params["layers"])
+        if self._last:
+            x = rmsnorm(x, params["final_norm"], c.norm_eps)
+            head = (params["embed"].T if c.tie_embeddings
+                    else params["lm_head"])
+            x = jnp.einsum("bse,ev->bsv", x, head.astype(x.dtype),
+                           preferred_element_type=jnp.float32)
+        return x
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        out = self._fn(self._params, jnp.asarray(x))
+        return np.asarray(out)
+
+
+def split_params(params: dict, config, n_stages: int) -> List[dict]:
+    """Slice the stacked layer tree into contiguous per-stage shards.
+    Stage 0 carries the embedding; the last stage carries final norm +
+    head (plus the embedding when tied)."""
+    import jax
+
+    L = config.n_layers
+    if not (1 <= n_stages <= L):
+        raise ValueError(f"n_stages {n_stages} not in [1, {L}]")
+    bounds = [round(i * L / n_stages) for i in range(n_stages + 1)]
+    shards = []
+    for s in range(n_stages):
+        lo, hi = bounds[s], bounds[s + 1]
+        shard = {"layers": jax.tree.map(lambda a: a[lo:hi],
+                                        params["layers"])}
+        if s == 0:
+            shard["embed"] = params["embed"]
+        if s == n_stages - 1:
+            shard["final_norm"] = params["final_norm"]
+            if config.tie_embeddings:
+                shard["embed"] = params["embed"]
+            else:
+                shard["lm_head"] = params["lm_head"]
+        shards.append(shard)
+    return shards
+
+
+def build_llama_pipeline(config, params, n_stages: int, *,
+                         channels: bool = True,
+                         channel_capacity: int = 64 << 20,
+                         stage_options: Optional[dict] = None):
+    """Compile an n-stage llama forward pipeline. Returns a CompiledDAG:
+    ``dag.execute(tokens).get()`` → logits; in channel mode consecutive
+    ``execute`` calls pipeline across stages."""
+    import cloudpickle
+
+    import ray_tpu
+    from ray_tpu.graph.dag import InputNode
+
+    shards = split_params(params, config, n_stages)
+    stage_cls = ray_tpu.remote(LlamaPipelineStage)
+    with InputNode() as inp:
+        node = inp
+        for s in range(n_stages):
+            blob = cloudpickle.dumps({
+                "config": config, "params": shards[s],
+                "first": s == 0, "last": s == n_stages - 1,
+            })
+            opts = dict(stage_options or {})
+            node = stage_cls.options(**opts).bind(blob).forward.bind(node)
+    return node.experimental_compile(channels=channels,
+                                     channel_capacity=channel_capacity)
