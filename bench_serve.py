@@ -1,0 +1,134 @@
+"""Serve/LLM throughput benchmark (BASELINE target #5 discipline).
+
+Drives the continuous-batching engine (``ray_tpu/serve/llm.py``) directly —
+the replica hot path, without HTTP overhead — with a closed-loop client
+pool, and reports decode throughput (tokens/s), time-to-first-token, and
+slot occupancy as ONE JSON line per config, plus a summary line in the
+driver's ``{"metric": ...}`` shape.
+
+On TPU hardware it uses the 1b model config; on CPU fallback it runs the
+debug config and marks the artifact accordingly (the same loud-fallback
+contract as bench.py — a CPU number is never presented as the headline).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+def run_engine_bench(model: str, num_slots: int, n_requests: int,
+                     prompt_len: int, max_tokens: int) -> dict:
+    import numpy as np
+
+    from ray_tpu.serve.llm import LLMEngine
+
+    engine = LLMEngine(model=model, num_slots=num_slots)
+    rng = np.random.default_rng(0)
+    vocab = engine.config.vocab_size
+
+    # warmup: compile prefill + decode
+    engine.generate(list(rng.integers(1, vocab, size=prompt_len)),
+                    max_tokens=4)
+
+    ttfts: list = []
+    done_tokens = [0]
+    lock = threading.Lock()
+    occupancy_samples: list = []
+
+    def client(i):
+        prompt = list(rng.integers(1, vocab, size=prompt_len))
+        t0 = time.perf_counter()
+        rid = engine.submit(prompt, max_tokens=max_tokens)
+        first = None
+        collected = 0
+        while True:
+            st = engine.poll(rid)
+            collected += len(st["chunks"])
+            if first is None and collected:
+                first = time.perf_counter() - t0
+            if st["done"]:
+                break
+            time.sleep(0.005)
+        with lock:
+            ttfts.append(first if first is not None
+                         else time.perf_counter() - t0)
+            done_tokens[0] += collected
+
+    def sampler(stop):
+        while not stop.is_set():
+            occupancy_samples.append(
+                engine.stats()["active_slots"] / num_slots)
+            time.sleep(0.05)
+
+    stop = threading.Event()
+    threading.Thread(target=sampler, args=(stop,), daemon=True).start()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    stop.set()
+    stats = engine.stats()
+    engine.shutdown()
+    import numpy as np
+
+    return {
+        "model": model,
+        "num_slots": num_slots,
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_tokens": max_tokens,
+        "wall_s": round(dt, 2),
+        "decode_tokens_per_s": round(done_tokens[0] / dt, 1),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1000, 1),
+        "ttft_p95_ms": round(float(np.percentile(ttfts, 95)) * 1000, 1),
+        "slot_occupancy_mean": round(float(np.mean(occupancy_samples)), 3)
+        if occupancy_samples else None,
+        "engine_steps": stats["steps"],
+    }
+
+
+def main():
+    # reuse bench.py's loud TPU-vs-CPU contract
+    from bench import _tpu_responsive
+
+    tpu_ok, reason = _tpu_responsive()
+    import os
+
+    if not tpu_ok:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        model, slots, n_req, plen, mtok = "debug", 8, 16, 32, 32
+    else:
+        model, slots, n_req, plen, mtok = "1b", 8, 24, 128, 128
+
+    result = run_engine_bench(model, slots, n_req, plen, mtok)
+    if not tpu_ok:
+        result["tpu_unavailable"] = reason
+    print(json.dumps(result))
+    headline = {
+        "metric": f"llm_serve_{result['model']}_decode_tokens_per_s",
+        "value": result["decode_tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": None,  # no reference serve-throughput number in-tree
+        "ttft_p50_ms": result["ttft_p50_ms"],
+        "slot_occupancy_mean": result["slot_occupancy_mean"],
+    }
+    if not tpu_ok:
+        headline["tpu_unavailable"] = reason
+    print(json.dumps(headline))
+    with open("BENCH_serve.json", "w") as f:
+        json.dump(result, f, indent=1)
+    return 0 if tpu_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""GCE TPU-pod node provider — the flagship cloud provider for a
+TPU-native framework.
+
+Reference: ``python/ray/autoscaler/_private/gcp/node_provider.py`` (and its
+TPU handling in ``gcp/config.py``), which launches individual VMs. The
+TPU-first redesign requests **pod slices**: one ``launch_node`` of type
+``v5e-16`` provisions a whole TPU-VM slice (4 hosts × 4 chips over one ICI
+domain) through the TPU API's nodes surface, and every host's startup
+script boots a raylet labeled ``rt.io/tpu-slice=<slice>`` +
+``rt.io/tpu-topology=<type>`` so placement-group gang policies can target
+one ICI domain (SURVEY.md §7: topology-aware bundles).
+
+The provider is written against a thin ``api`` duck type (``create_node``,
+``delete_node``, ``list_nodes`` in the TPU-API v2 shape) so it is testable
+against the recorded :class:`FakeGceApi` without a cloud and pluggable
+with a real ``googleapiclient`` wrapper in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+LABEL_SLICE = "rt.io/tpu-slice"
+LABEL_TOPOLOGY = "rt.io/tpu-topology"
+LABEL_NODE_TYPE = "rt.io/node-type"
+
+# accelerator type -> (hosts per slice, chips per host)
+SLICE_SHAPES = {
+    "v5litepod-4": (1, 4),
+    "v5litepod-8": (2, 4),
+    "v5litepod-16": (4, 4),
+    "v5litepod-32": (8, 4),
+    "v5p-8": (2, 4),
+    "v4-8": (1, 4),
+    "v4-16": (2, 4),
+}
+
+
+_STARTUP_TEMPLATE = """#!/bin/bash
+# boot one raylet per slice host, labeled into its ICI domain
+python -m ray_tpu start --address={gcs_address} \\
+  --labels='{{"{label_slice}": "{slice_name}", "{label_topology}": "{accel}"}}' \\
+  --num-tpus={chips}
+"""
+
+
+class GcePodProvider(NodeProvider):
+    """Launches/terminates TPU pod slices via the (injected) TPU API."""
+
+    def __init__(self, api, project: str, zone: str, gcs_address: str,
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 name_prefix: str = "rt"):
+        self._api = api
+        self._project = project
+        self._zone = zone
+        self._gcs_address = gcs_address
+        self._runtime_version = runtime_version
+        self._prefix = name_prefix
+        self._lock = threading.Lock()
+        self._launched: Dict[str, dict] = {}  # slice name -> request record
+
+    # ----------------------------------------------------------- interface
+    def launch_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        if node_type not in SLICE_SHAPES:
+            raise ValueError(
+                f"unknown TPU slice type {node_type!r}; "
+                f"known: {sorted(SLICE_SHAPES)}")
+        hosts, chips = SLICE_SHAPES[node_type]
+        name = f"{self._prefix}-{node_type}-{uuid.uuid4().hex[:8]}"
+        node_labels = dict(labels or {})
+        node_labels[LABEL_SLICE] = name
+        node_labels[LABEL_TOPOLOGY] = node_type
+        node_labels[LABEL_NODE_TYPE] = node_type
+        body = {
+            "name": name,
+            "acceleratorType": node_type,
+            "runtimeVersion": self._runtime_version,
+            "labels": {k.replace("/", "_").replace(".", "-"): v
+                       for k, v in node_labels.items()},
+            "metadata": {
+                "startup-script": _STARTUP_TEMPLATE.format(
+                    gcs_address=self._gcs_address,
+                    label_slice=LABEL_SLICE, slice_name=name,
+                    label_topology=LABEL_TOPOLOGY, accel=node_type,
+                    chips=chips),
+            },
+        }
+        self._api.create_node(project=self._project, zone=self._zone,
+                              body=body)
+        with self._lock:
+            self._launched[name] = {"type": node_type, "hosts": hosts,
+                                    "ts": time.time()}
+        logger.info("requested TPU slice %s (%s: %d hosts x %d chips)",
+                    name, node_type, hosts, chips)
+        return name
+
+    def terminate_node(self, node_handle: str) -> None:
+        self._api.delete_node(project=self._project, zone=self._zone,
+                              name=node_handle)
+        with self._lock:
+            self._launched.pop(node_handle, None)
+        logger.info("deleted TPU slice %s", node_handle)
+
+    def live_nodes(self) -> List[str]:
+        nodes = self._api.list_nodes(project=self._project, zone=self._zone)
+        return [n["name"] for n in nodes
+                if n.get("state") in ("CREATING", "READY", "REPAIRING")]
+
+    # ------------------------------------------------------------- helpers
+    def slice_info(self, node_handle: str) -> Optional[dict]:
+        for n in self._api.list_nodes(project=self._project,
+                                      zone=self._zone):
+            if n["name"] == node_handle:
+                return n
+        return None
+
+
+class FakeGceApi:
+    """Recorded TPU-API double (reference pattern:
+    ``autoscaler/_private/fake_multi_node``): create/delete/list with
+    simulated async provisioning — a created node is CREATING for
+    ``provision_delay_s`` and READY after, so autoscaler logic sees the
+    same state machine a real slice goes through."""
+
+    def __init__(self, provision_delay_s: float = 0.0):
+        self._nodes: Dict[str, dict] = {}
+        self._delay = provision_delay_s
+        self.calls: List[tuple] = []  # recorded (op, kwargs)
+        self._lock = threading.Lock()
+
+    def create_node(self, project: str, zone: str, body: dict) -> dict:
+        with self._lock:
+            self.calls.append(("create", {"project": project, "zone": zone,
+                                          "body": body}))
+            name = body["name"]
+            if name in self._nodes:
+                raise ValueError(f"node {name} already exists")
+            self._nodes[name] = dict(body, state="CREATING",
+                                     _created=time.time())
+            return {"name": f"operations/{uuid.uuid4().hex[:8]}"}
+
+    def delete_node(self, project: str, zone: str, name: str) -> dict:
+        with self._lock:
+            self.calls.append(("delete", {"project": project, "zone": zone,
+                                          "name": name}))
+            if name not in self._nodes:
+                raise KeyError(name)
+            self._nodes[name]["state"] = "DELETING"
+            del self._nodes[name]
+            return {"done": True}
+
+    def list_nodes(self, project: str, zone: str) -> List[dict]:
+        with self._lock:
+            self.calls.append(("list", {"project": project, "zone": zone}))
+            out = []
+            for n in self._nodes.values():
+                n = dict(n)
+                if (n["state"] == "CREATING"
+                        and time.time() - n["_created"] >= self._delay):
+                    n["state"] = "READY"
+                    self._nodes[n["name"]]["state"] = "READY"
+                out.append(n)
+            return out

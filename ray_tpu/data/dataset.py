@@ -426,6 +426,40 @@ class Dataset:
             cloudpickle.dumps(self), n, queue_depth)
         return [DataIterator(coord, i) for i in range(n)]
 
+    def zip(self, other: "Dataset") -> "Dataset":  # noqa: A003
+        """Row-aligned column concatenation (reference ``Dataset.zip`` /
+        zip operator): equal row counts required; overlapping column names
+        from ``other`` get an ``_1`` suffix. Both sides are repartitioned
+        by global row position into identical contiguous ranges (the
+        order-preserving shuffle), so block pairs align without any
+        central materialization."""
+        import ray_tpu
+
+        n_l, n_r = self.count(), other.count()
+        if n_l != n_r:
+            raise ValueError(
+                f"zip needs equal row counts: {n_l} vs {n_r}")
+        nb = builtins.max(1, builtins.min(self.num_blocks(),
+                                          other.num_blocks()))
+        left = self.repartition(nb)._execute()
+        right = other.repartition(nb)._execute()
+
+        @ray_tpu.remote
+        def _zip_blocks(bl, br):
+            rows_l = B.block_to_rows(bl)
+            rows_r = B.block_to_rows(br)
+            out = []
+            for lr, rr in builtins.zip(rows_l, rows_r):
+                row = dict(lr)
+                for k, v in rr.items():
+                    row[k + "_1" if k in row else k] = v
+                out.append(row)
+            return B.block_from_rows(out)
+
+        refs = [_zip_blocks.remote(lref, rref)
+                for lref, rref in builtins.zip(left, right)]
+        return Dataset([_FromRefs(refs)], self._max_inflight)
+
     # --------------------------------------------------------------- joins
     def join(self, other: "Dataset", on: str, how: str = "inner", *,
              right_on: Optional[str] = None,

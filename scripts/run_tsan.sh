@@ -1,0 +1,19 @@
+#!/bin/bash
+# TSAN + ASAN runs for the concurrency-critical native shm code
+# (reference: .bazelrc build:tsan/build:asan CI configs, SURVEY.md §4.5).
+set -e
+cd "$(dirname "$0")/.."
+
+SRC="cpp/test/tsan_shm.cc \
+     ray_tpu/object_store/native/shm_store.cc \
+     ray_tpu/object_store/native/shm_channel.cc"
+
+echo "== TSAN =="
+g++ -O1 -g -fsanitize=thread -std=c++17 -o /tmp/tsan_shm $SRC -lpthread -lrt
+TSAN_OPTIONS="halt_on_error=1" /tmp/tsan_shm
+
+echo "== ASAN =="
+g++ -O1 -g -fsanitize=address -std=c++17 -o /tmp/asan_shm $SRC -lpthread -lrt
+/tmp/asan_shm
+
+echo "sanitizer runs clean"

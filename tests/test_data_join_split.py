@@ -162,3 +162,22 @@ class TestOptimizer:
         ops = optimize(ds._ops)
         assert len(ops) == 1 and isinstance(ops[0], _Read)
         assert sorted(r["id"] for r in ds.take_all()) == list(range(4, 11))
+
+
+class TestZip:
+    def test_zip_aligns_rows(self, rt):
+        a = rd.range(30, num_blocks=3)
+        b = rd.from_items([{"y": i * 2} for i in range(30)], num_blocks=2)
+        rows = a.zip(b).take_all()
+        assert len(rows) == 30
+        assert all(r["y"] == r["id"] * 2 for r in rows)
+
+    def test_zip_name_collision_suffix(self, rt):
+        a = rd.from_items([{"v": 1}, {"v": 2}])
+        b = rd.from_items([{"v": 10}, {"v": 20}])
+        rows = a.zip(b).take_all()
+        assert rows == [{"v": 1, "v_1": 10}, {"v": 2, "v_1": 20}]
+
+    def test_zip_length_mismatch(self, rt):
+        with pytest.raises(ValueError):
+            rd.range(5).zip(rd.range(6))
