@@ -6,6 +6,7 @@ ops (shuffle/sort/repartition) materialize. ``iter_batches``/``split``
 are the training-ingest path feeding JaxTrainer workers.
 """
 
+from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     DataIterator,
     Dataset,

@@ -1,0 +1,49 @@
+"""DataContext — per-driver execution configuration for Datasets.
+
+Reference: ``python/ray/data/context.py`` (``DataContext.get_current()``):
+the knobs the streaming executor and operators consult. The TPU build keeps
+the same access pattern (a process-wide current context, overridable per
+dataset) with the knobs that exist in this executor:
+
+- ``max_inflight_blocks`` — the streaming window: how many block chains
+  may be in flight at once (driver-side backpressure).
+- ``op_concurrency_cap`` — per-operator budget: at most this many
+  concurrent tasks per map stage (None = bounded only by the window).
+  This is the reference's per-operator resource-budget/backpressure
+  policy reduced to its operative effect in a ref-chaining executor.
+- ``default_batch_size`` — ``iter_batches``/``map_batches`` default.
+- ``actor_pool_size`` / ``max_tasks_in_flight_per_actor`` — defaults for
+  ``ActorPoolStrategy`` stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    max_inflight_blocks: int = 16
+    op_concurrency_cap: Optional[int] = None
+    default_batch_size: int = 256
+    actor_pool_size: int = 2
+    max_tasks_in_flight_per_actor: int = 2
+    # collect per-stage wall/rows stats into Dataset.stats()
+    enable_stats: bool = True
+
+    _current: "Optional[DataContext]" = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current
+
+    @classmethod
+    def set_current(cls, ctx: "DataContext") -> None:
+        with cls._lock:
+            cls._current = ctx

@@ -81,14 +81,34 @@ class Dataset:
     """Lazy, immutable; every transform returns a new Dataset
     (reference ``Dataset`` semantics)."""
 
-    def __init__(self, ops: List[_Op], max_inflight: int = 16):
+    def __init__(self, ops: List[_Op], max_inflight: Optional[int] = None):
+        from ray_tpu.data.context import DataContext
+
         self._ops = ops
-        self._max_inflight = max_inflight
+        self._max_inflight = (max_inflight if max_inflight is not None
+                              else DataContext.get_current()
+                              .max_inflight_blocks)
         self._cached_refs: Optional[List] = None
+        self._stats: List[dict] = []  # per-executed-segment stage stats
 
     # ------------------------------------------------------------ lineage
     def _with(self, op: _Op) -> "Dataset":
         return Dataset(self._ops + [op], self._max_inflight)
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> str:
+        """Human-readable per-stage execution stats (reference
+        ``Dataset.stats()``): blocks processed and wall time per stage of
+        each executed segment. Populated by execution; empty before."""
+        if not self._stats:
+            return "(not executed yet)"
+        lines = []
+        for seg in self._stats:
+            lines.append(
+                f"segment[{seg['segment']}] stages={seg['stages'] or '-'} "
+                f"blocks={seg['blocks']} wall={seg['wall_s']:.3f}s "
+                f"window={seg['window']}")
+        return "\n".join(lines)
 
     # --------------------------------------------------------- transforms
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
@@ -262,6 +282,7 @@ class Dataset:
 
         try:
             i = 1
+            seg_no = 0
             while True:
                 segment = []
                 while i < len(ops) and isinstance(ops[i], _MapBlock):
@@ -269,8 +290,14 @@ class Dataset:
                     i += 1
                 stages = [make_stage(op) for op in segment]
                 ex = StreamingExecutor(self._max_inflight)
+                seg_stat = {"segment": seg_no,
+                            "stages": "->".join(op.name for op in segment),
+                            "blocks": 0, "wall_s": 0.0,
+                            "window": self._max_inflight}
+                self._stats.append(seg_stat)
+                seg_no += 1
                 gen = ex.iter_block_refs(sources, is_read_tasks=is_read,
-                                         stages=stages)
+                                         stages=stages, stats=seg_stat)
                 if i >= len(ops):
                     yield from gen
                     return

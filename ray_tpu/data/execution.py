@@ -36,10 +36,21 @@ from ray_tpu.data import block as B
 
 @dataclasses.dataclass
 class ActorPoolStrategy:
-    """compute= argument for map_batches (reference ActorPoolStrategy)."""
+    """compute= argument for map_batches (reference ActorPoolStrategy).
+    Defaults come from :class:`~ray_tpu.data.context.DataContext`."""
 
-    size: int = 2
-    max_tasks_in_flight_per_actor: int = 2
+    size: Optional[int] = None
+    max_tasks_in_flight_per_actor: Optional[int] = None
+
+    def __post_init__(self):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        if self.size is None:
+            self.size = ctx.actor_pool_size
+        if self.max_tasks_in_flight_per_actor is None:
+            self.max_tasks_in_flight_per_actor = \
+                ctx.max_tasks_in_flight_per_actor
 
 
 class ActorPool:
@@ -119,16 +130,54 @@ class StreamingExecutor:
 
     def iter_block_refs(self, source_refs_or_tasks: List[Any], *,
                         is_read_tasks: bool,
-                        stages: List[Any]) -> Iterator[Any]:
+                        stages: List[Any],
+                        stats: Optional[dict] = None) -> Iterator[Any]:
         """stages: callables `stage(block_ref) -> block_ref` (each submits
         one task/actor call). Yields final block refs in completion order
-        with at most max_inflight chains outstanding (backpressure)."""
+        with at most max_inflight chains outstanding (backpressure).
+
+        Per-operator budget (reference backpressure_policy/
+        ConcurrencyCapBackpressurePolicy): when DataContext's
+        ``op_concurrency_cap`` is set, a new chain is admitted only while
+        every stage has fewer than that many un-finished tasks — bounding
+        each operator's concurrent footprint, not just the global window.
+        """
+        import threading
+        import time as _time
+
         import ray_tpu
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.core_worker.worker import CoreWorker
 
         @ray_tpu.remote
         def _run_read(task):
             return task()
 
+        cap = DataContext.get_current().op_concurrency_cap
+        outstanding = [0] * len(stages)
+        out_lock = threading.Lock()
+
+        def track(k, ref):
+            with out_lock:
+                outstanding[k] += 1
+
+            def done(_k=k):
+                with out_lock:
+                    outstanding[_k] = max(0, outstanding[_k] - 1)
+
+            try:
+                CoreWorker.current_or_raise().memory_store \
+                    .add_done_callback(ref.object_id, done)
+            except Exception:  # noqa: BLE001
+                done()
+
+        def admit_ok() -> bool:
+            if not cap:
+                return True
+            with out_lock:
+                return all(o < cap for o in outstanding)
+
+        t0 = _time.perf_counter()
         pending: Dict[Any, int] = {}
         completed: Dict[int, Any] = {}
         source_iter = iter(source_refs_or_tasks)
@@ -136,28 +185,37 @@ class StreamingExecutor:
         order = 0
         next_emit = 0
         while True:
-            while not exhausted \
-                    and len(pending) + len(completed) < self.max_inflight:
+            while (not exhausted
+                   and len(pending) + len(completed) < self.max_inflight
+                   and admit_ok()):
                 try:
                     src = next(source_iter)
                 except StopIteration:
                     exhausted = True
                     break
                 ref = _run_read.remote(src) if is_read_tasks else src
-                for stage in stages:
+                for k, stage in enumerate(stages):
                     ref = stage(ref)
+                    track(k, ref)
                 pending[ref] = order
                 order += 1
             if not pending and not completed:
+                if stats is not None:
+                    stats["wall_s"] = _time.perf_counter() - t0
                 return
             if pending:
+                # short timeout when capped: admission may reopen on a
+                # done-callback rather than a head-of-line completion
                 ready, _ = ray_tpu.wait(list(pending), num_returns=1,
-                                        timeout=None)
+                                        timeout=0.5 if cap else None)
                 for ref in ready:
                     completed[pending.pop(ref)] = ref
             # Emit in PLAN order (Dataset semantics are ordered); the
             # out-of-order buffer is bounded by the in-flight window.
             while next_emit in completed:
+                if stats is not None:
+                    stats["blocks"] += 1
+                    stats["wall_s"] = _time.perf_counter() - t0
                 yield completed.pop(next_emit)
                 next_emit += 1
 

@@ -54,9 +54,18 @@ class _PipelineStage:
         allreduces the result across the DAG's collective group
         (reference ``collective_node.py`` lowering), writes the output.
 
+        Input reads run on a PREFETCH thread one item ahead of compute
+        (reference ``ExecutableTask.prepare:579`` overlapped comm): while
+        the method runs on item i, item i+1's channel reads — deserialize
+        + memcpy — proceed concurrently, so per-item cost approaches
+        max(compute, transfer) instead of their sum.
+
         ``in_specs``: ordered arg slots — ("ch", channel) | ("const", v).
         ``collective_spec``: None | (group_name, rank, world, op).
         """
+        import queue as _q
+        import threading as _threading
+
         from ray_tpu.graph.channels import ChannelClosed
 
         fn = getattr(self._inner, method)
@@ -73,11 +82,25 @@ class _PipelineStage:
         for kind, v in in_specs:
             if kind == "ch" and all(v is not c for c in distinct):
                 distinct.append(v)
+
+        _END = object()
+        prefetch_q: "_q.Queue" = _q.Queue(maxsize=1)  # one item ahead
+
+        def prefetch():
+            while True:
+                try:
+                    item = {id(ch): ch.read(timeout_s=3600.0)
+                            for ch in distinct}
+                except (ChannelClosed, TimeoutError):
+                    prefetch_q.put(_END)
+                    return
+                prefetch_q.put(item)
+
+        _threading.Thread(target=prefetch, daemon=True,
+                          name="stage-prefetch").start()
         while True:
-            try:
-                by_ch = {id(ch): ch.read(timeout_s=3600.0)
-                         for ch in distinct}
-            except (ChannelClosed, TimeoutError):
+            by_ch = prefetch_q.get()
+            if by_ch is _END:
                 break
             args = [by_ch[id(v)] if kind == "ch" else v
                     for kind, v in in_specs]

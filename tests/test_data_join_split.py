@@ -181,3 +181,28 @@ class TestZip:
     def test_zip_length_mismatch(self, rt):
         with pytest.raises(ValueError):
             rd.range(5).zip(rd.range(6))
+
+
+class TestDataContext:
+    def test_context_defaults_and_stats(self, rt):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        assert ctx.max_inflight_blocks == 16
+        ds = rd.range(40, num_blocks=4).map(lambda r: {"id": r["id"] * 2})
+        assert ds.count() == 40
+        s = ds.stats()
+        assert "blocks=4" in s and "wall=" in s, s
+
+    def test_op_concurrency_cap_respected(self, rt):
+        from ray_tpu.data.context import DataContext
+
+        old = DataContext.get_current().op_concurrency_cap
+        DataContext.get_current().op_concurrency_cap = 2
+        try:
+            ds = rd.range(30, num_blocks=6).map(
+                lambda r: {"id": r["id"] + 1})
+            got = sorted(r["id"] for r in ds.take_all())
+            assert got == list(range(1, 31))
+        finally:
+            DataContext.get_current().op_concurrency_cap = old
