@@ -118,6 +118,8 @@ _D("raylet_report_resources_period_ms", int, 100, "resource gossip interval")
 _D("gcs_rpc_server_reconnect_timeout_s", int, 60, "client retry window on GCS restart")
 _D("gcs_restart_reconcile_delay_s", float, 2.0,
    "post-restart window for raylets to re-claim actors/bundles before failover")
+_D("rpc_schema_validation", bool, True,
+   "validate inbound RPCs against the typed wire schemas (rpc/schema.py)")
 _D("rpc_retry_base_ms", int, 100, "retryable client initial backoff")
 _D("rpc_retry_max_ms", int, 5000, "retryable client max backoff")
 _D("rpc_connect_timeout_s", float, 10.0, "client connect timeout")

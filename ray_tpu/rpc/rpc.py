@@ -157,6 +157,24 @@ class IoContext:
             self.stats[name] = (count + 1, total + elapsed)
 
 
+_schema_enabled_cache: Optional[bool] = None
+
+
+def _schema_validation_enabled() -> bool:
+    """Wire-contract validation (rpc/schema.py), cached: a config lookup
+    per request would be measurable on the hot path."""
+    global _schema_enabled_cache
+    if _schema_enabled_cache is None:
+        try:
+            from ray_tpu.common.config import GLOBAL_CONFIG
+
+            _schema_enabled_cache = bool(
+                GLOBAL_CONFIG.get("rpc_schema_validation"))
+        except Exception:  # noqa: BLE001
+            _schema_enabled_cache = True
+    return _schema_enabled_cache
+
+
 class RpcServer:
     """Registers async handlers by method name; serves framed requests.
 
@@ -222,6 +240,10 @@ class RpcServer:
             reply = {"id": req_id, "error": ("nomethod", f"unknown method {method!r}", "")}
         else:
             try:
+                if _schema_validation_enabled():
+                    from ray_tpu.rpc.schema import validate as _validate
+
+                    _validate(method, kwargs)
                 result = await handler(**kwargs)
                 reply = {"id": req_id, "result": result}
             except Exception as e:  # noqa: BLE001 - handler errors go to caller
