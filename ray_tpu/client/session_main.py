@@ -59,7 +59,9 @@ class _RefUnpickler(pickle.Unpickler):
 class SessionDriver:
     def __init__(self):
         host = os.environ.get("RT_CLIENT_SESSION_HOST", "127.0.0.1")
-        self.server = RpcServer(host, 0)
+        # method names collide with core-service schemas (create_actor etc.)
+        # but carry a different contract: skip wire-schema validation
+        self.server = RpcServer(host, 0, validate_schemas=False)
         # every ref the client holds is pinned here until released — the
         # client-side refcount is authoritative (reference client ref
         # counting), the server keeps the object alive meanwhile

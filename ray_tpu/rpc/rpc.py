@@ -157,22 +157,17 @@ class IoContext:
             self.stats[name] = (count + 1, total + elapsed)
 
 
-_schema_enabled_cache: Optional[bool] = None
-
-
 def _schema_validation_enabled() -> bool:
-    """Wire-contract validation (rpc/schema.py), cached: a config lookup
-    per request would be measurable on the hot path."""
-    global _schema_enabled_cache
-    if _schema_enabled_cache is None:
-        try:
-            from ray_tpu.common.config import GLOBAL_CONFIG
+    """Wire-contract validation (rpc/schema.py). Reads the config registry
+    each time — GLOBAL_CONFIG caches internally and reset_cache()/
+    system_config propagation must be able to flip the knob at runtime
+    (a process-global cache here would pin the boot-time value)."""
+    try:
+        from ray_tpu.common.config import GLOBAL_CONFIG
 
-            _schema_enabled_cache = bool(
-                GLOBAL_CONFIG.get("rpc_schema_validation"))
-        except Exception:  # noqa: BLE001
-            _schema_enabled_cache = True
-    return _schema_enabled_cache
+        return bool(GLOBAL_CONFIG.get("rpc_schema_validation"))
+    except Exception:  # noqa: BLE001
+        return True
 
 
 class RpcServer:
@@ -182,9 +177,14 @@ class RpcServer:
     are pickled back.  One connection carries many concurrent requests.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 validate_schemas: bool = True):
         self.host = host
         self.port = port
+        # Core services share one method namespace with the wire-schema
+        # table; servers whose methods collide by NAME but not by contract
+        # (e.g. the ray:// session driver's create_actor) opt out.
+        self.validate_schemas = validate_schemas
         self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._io = IoContext.current()
@@ -240,10 +240,10 @@ class RpcServer:
             reply = {"id": req_id, "error": ("nomethod", f"unknown method {method!r}", "")}
         else:
             try:
-                if _schema_validation_enabled():
+                if self.validate_schemas and _schema_validation_enabled():
                     from ray_tpu.rpc.schema import validate as _validate
 
-                    _validate(method, kwargs)
+                    kwargs = _validate(method, kwargs)
                 result = await handler(**kwargs)
                 reply = {"id": req_id, "result": result}
             except Exception as e:  # noqa: BLE001 - handler errors go to caller

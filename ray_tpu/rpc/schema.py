@@ -59,15 +59,21 @@ class Message:
     fields: Tuple[Field, ...]
     allow_unknown: bool = True
 
-    def validate(self, kwargs: Dict[str, Any]) -> None:
+    def validate(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Check and return the kwargs to dispatch. Unknown fields are
+        STRIPPED (not just tolerated) when allowed: handlers don't take
+        **kwargs, so passing a newer client's extra fields through would
+        crash the handler and void the rolling-upgrade guarantee."""
         for f in self.fields:
             f.check(self.name, kwargs)
+        known = {f.name for f in self.fields}
+        unknown = set(kwargs) - known
+        if not unknown:
+            return kwargs
         if not self.allow_unknown:
-            known = {f.name for f in self.fields}
-            unknown = set(kwargs) - known
-            if unknown:
-                raise SchemaError(
-                    f"{self.name}: unknown fields {sorted(unknown)}")
+            raise SchemaError(
+                f"{self.name}: unknown fields {sorted(unknown)}")
+        return {k: v for k, v in kwargs.items() if k in known}
 
 
 def _m(name: str, *fields: Field) -> Message:
@@ -112,11 +118,14 @@ RPC_SCHEMAS: Dict[str, Message] = {
     "borrow_release": _m("borrow_release", req("object_id", bytes),
                          opt("worker_id", bytes), opt("token", bytes)),
     # ---- raylet service (reference node_manager.proto) ----
+    # NOTE: declare only fields the handler accepts — unknown inbound
+    # fields are stripped pre-dispatch, so a field listed here but absent
+    # from the handler would pass through and crash it.
     "request_worker_lease": _m(
         "request_worker_lease", req("lease_id", bytes),
         req("resources", dict), opt("strategy", bytes),
         opt("pg", (tuple, list)), opt("runtime_env", dict),
-        opt("timeout", _num)),
+        opt("grant_only_local", bool)),
     "return_worker": _m("return_worker", req("lease_id", bytes),
                         opt("disconnect", bool)),
     "register_worker": _m("register_worker", req("worker_id", bytes),
@@ -148,9 +157,11 @@ RPC_SCHEMAS: Dict[str, Message] = {
 }
 
 
-def validate(method: str, kwargs: Dict[str, Any]) -> None:
-    """Check a request against the wire contract; no-op for methods
-    without a declared schema."""
+def validate(method: str, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Check a request against the wire contract and return the kwargs to
+    dispatch (unknown fields stripped); pass-through for methods without
+    a declared schema."""
     schema = RPC_SCHEMAS.get(method)
-    if schema is not None:
-        schema.validate(kwargs)
+    if schema is None:
+        return kwargs
+    return schema.validate(kwargs)
