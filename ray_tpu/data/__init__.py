@@ -14,9 +14,11 @@ from ray_tpu.data.dataset import (  # noqa: F401
 )
 from ray_tpu.data.execution import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data.datasource import (  # noqa: F401
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     read_binary_files,
     read_csv,
@@ -24,8 +26,10 @@ from ray_tpu.data.datasource import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
     write_csv,
     write_json,
     write_parquet,

@@ -293,6 +293,101 @@ def from_pandas(df) -> Dataset:
     return Dataset([_Read([lambda: pa.Table.from_pandas(df)])])
 
 
+def from_arrow(table) -> Dataset:
+    """pyarrow Table(s) -> dataset, one block per table (reference:
+    ``from_arrow``)."""
+    tables = table if isinstance(table, (list, tuple)) else [table]
+    return Dataset([_Read([(lambda t=t: t) for t in tables])])
+
+
+def from_torch(torch_dataset, *, num_blocks: int = 1) -> Dataset:
+    """torch.utils.data.Dataset (map-style) -> dataset of ``{"item": x}``
+    rows (reference: ``from_torch``). Tensors become numpy arrays."""
+    n = len(torch_dataset)
+    per = -(-n // num_blocks) if n else 1
+
+    def make(lo, hi):
+        def read():
+            rows = []
+            for i in builtins.range(lo, hi):
+                item = torch_dataset[i]
+                if hasattr(item, "numpy"):
+                    item = item.numpy()
+                elif isinstance(item, tuple):
+                    item = tuple(x.numpy() if hasattr(x, "numpy") else x
+                                 for x in item)
+                rows.append({"item": item})
+            return B.block_from_rows(rows)
+
+        return read
+
+    tasks = [make(i * per, builtins.min((i + 1) * per, n))
+             for i in builtins.range(num_blocks) if i * per < n]
+    return Dataset([_Read(tasks or [lambda: B.block_from_rows([])])])
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 1) -> Dataset:
+    """DBAPI query -> dataset (reference: ``read_sql``). The factory
+    returns a NEW connection per read task (connections don't pickle);
+    works with sqlite3, psycopg2, or any DBAPI-2 driver. With
+    ``parallelism > 1`` the query is sharded by row number modulo N —
+    valid for engines supporting the standard ROW_NUMBER() or for
+    naturally keyed queries; use 1 when unsure."""
+    def make(shard, total):
+        def read():
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = [dict(builtins.zip(cols, r))
+                        for i, r in enumerate(cur.fetchall())
+                        if i % total == shard]
+                return B.block_from_rows(rows)
+            finally:
+                conn.close()
+
+        return read
+
+    n = builtins.max(1, parallelism)
+    return Dataset([_Read([make(s, n) for s in builtins.range(n)])])
+
+
+def read_webdataset(paths, *, suffixes: Optional[List[str]] = None
+                    ) -> Dataset:
+    """WebDataset-style tar shards -> one row per sample (reference:
+    ``read_webdataset``). Files sharing a basename stem group into one
+    row keyed by extension (``{"__key__": stem, "jpg": bytes, ...}``);
+    ``suffixes`` filters which extensions load. Pure stdlib tarfile —
+    no webdataset dependency."""
+    import tarfile
+
+    files = _expand(paths)
+
+    def make(task_path):
+        def read():
+            samples: Dict[str, Dict[str, Any]] = {}
+            order: List[str] = []
+            with tarfile.open(task_path) as tf:
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    name = os.path.basename(member.name)
+                    stem, _, ext = name.partition(".")
+                    if suffixes is not None and ext not in suffixes:
+                        continue
+                    if stem not in samples:
+                        samples[stem] = {"__key__": stem}
+                        order.append(stem)
+                    samples[stem][ext] = tf.extractfile(member).read()
+            return B.block_from_rows([samples[s] for s in order])
+
+        return read
+
+    return Dataset([_Read([make(f) for f in files])])
+
+
 def _json_writer(block, fname):
     """JSON-lines writer. ndarrays become lists; bytes become base64
     strings (JSON has no binary type)."""
