@@ -183,7 +183,10 @@ _D("testing_rpc_failure_seed", int, 0, "deterministic chaos seed")
 
 # --- TPU ---------------------------------------------------------------------
 _D("shm_store_enabled", bool, True, "node-local shared-memory object store")
-_D("shm_store_bytes", int, 256 * 1024 * 1024, "shm object store capacity")
+_D("shm_direct_put_threshold", int, 1 << 20,
+   "puts >= this many framed bytes serialize directly into the shm arena"
+   " (plasma create/seal; single memcpy)")
+_D("shm_store_bytes", int, 512 * 1024 * 1024, "shm object store capacity")
 _D("tpu_chips_per_host", int, 4, "chips exposed per raylet when unprobed")
 _D("tpu_topology", str, "", "slice topology label, e.g. v5e-32")
 

@@ -33,22 +33,31 @@ _HEADER = struct.Struct("<4sIQ")
 _SEG = struct.Struct("<QQ")
 
 
-def dumps(value: Any) -> bytes:
-    """Serialize; framed iff the value exports out-of-band buffers."""
+def plan(value: Any):
+    """Layout pass WITHOUT copying buffer bytes: returns
+    ``(meta, buffers, views, segs, total_size)`` — or
+    ``(meta, [], [], [], len(meta))`` for buffer-less values. Callers that
+    own a destination (e.g. a shm arena span) follow with
+    :func:`pack_into` for a single-copy write; ``dumps`` packs into a
+    fresh bytearray. Call ``release_buffers`` when done."""
     buffers: List[pickle.PickleBuffer] = []
     meta = cloudpickle.dumps(value, protocol=5,
                              buffer_callback=buffers.append)
     if not buffers:
-        return meta
+        return meta, [], [], [], len(meta)
     views = [b.raw() for b in buffers]
-    # layout pass: header | segment table | meta | aligned buffers
     off = _HEADER.size + _SEG.size * len(views) + len(meta)
     segs: List[Tuple[int, int]] = []
     for v in views:
         off = (off + _ALIGN - 1) // _ALIGN * _ALIGN
         segs.append((off, v.nbytes))
         off += v.nbytes
-    out = bytearray(off)
+    return meta, buffers, views, segs, off
+
+
+def pack_into(out, meta: bytes, views, segs) -> None:
+    """Write the frame into ``out`` (any writable buffer of the planned
+    total size). The ONE copy of the payload bytes happens here."""
     _HEADER.pack_into(out, 0, MAGIC, len(views), len(meta))
     pos = _HEADER.size
     for seg in segs:
@@ -57,8 +66,21 @@ def dumps(value: Any) -> bytes:
     out[pos:pos + len(meta)] = meta
     for (o, n), v in zip(segs, views):
         out[o:o + n] = v
+
+
+def release_buffers(buffers) -> None:
     for b in buffers:
         b.release()
+
+
+def dumps(value: Any) -> bytes:
+    """Serialize; framed iff the value exports out-of-band buffers."""
+    meta, buffers, views, segs, total = plan(value)
+    if not buffers:
+        return meta
+    out = bytearray(total)
+    pack_into(out, meta, views, segs)
+    release_buffers(buffers)
     return bytes(out)
 
 

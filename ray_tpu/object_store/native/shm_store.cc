@@ -333,6 +333,82 @@ int rts_put(int h, const uint8_t* id, uint32_t id_len,
   return 0;
 }
 
+// Two-phase create/seal (plasma CreateObject/Seal): the writer serializes
+// DIRECTLY into the arena — no staging buffer, no extra memcpy. The entry
+// is invisible to readers (and to eviction) until rts_seal; rts_abort
+// frees the span of a failed write.
+uint8_t* rts_create_unsealed(int h, const uint8_t* id, uint32_t id_len,
+                             uint64_t size) {
+  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return nullptr;
+  Store& st = g_stores[h];
+  Header* hdr = st.hdr;
+  if (LockHeld(hdr) != 0) return nullptr;
+  if (FindEntry(hdr, id, (uint8_t)id_len)) {
+    pthread_mutex_unlock(&hdr->lock);
+    return nullptr;  // EEXIST
+  }
+  uint64_t sz = size ? size : 1;
+  uint64_t off = AllocSpan(hdr, sz);
+  if (off == UINT64_MAX) {
+    EvictLocked(hdr, sz);
+    off = AllocSpan(hdr, sz);
+  }
+  if (off == UINT64_MAX) {
+    pthread_mutex_unlock(&hdr->lock);
+    return nullptr;  // ENOSPC
+  }
+  Entry* e = FindSlot(hdr, id, (uint8_t)id_len);
+  if (!e) {
+    FreeSpanInsert(hdr, off, sz);
+    pthread_mutex_unlock(&hdr->lock);
+    return nullptr;
+  }
+  e->used = 1;
+  e->sealed = 0;  // invisible to rts_get and EvictLocked until sealed
+  e->pending_delete = 0;
+  e->id_len = (uint8_t)id_len;
+  memcpy(e->id, id, id_len);
+  e->refcount = 0;
+  e->offset = off;
+  e->size = size;
+  e->alloc = sz;
+  e->lru_tick = ++hdr->lru_clock;
+  hdr->used_bytes += sz;
+  hdr->num_objects++;
+  uint8_t* ptr = st.base + off;
+  pthread_mutex_unlock(&hdr->lock);
+  return ptr;
+}
+
+int rts_seal(int h, const uint8_t* id, uint32_t id_len) {
+  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  Header* hdr = g_stores[h].hdr;
+  if (LockHeld(hdr) != 0) return -EINVAL;
+  Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
+  if (!e) {
+    pthread_mutex_unlock(&hdr->lock);
+    return -ENOENT;
+  }
+  e->sealed = 1;
+  e->lru_tick = ++hdr->lru_clock;
+  pthread_mutex_unlock(&hdr->lock);
+  return 0;
+}
+
+int rts_abort(int h, const uint8_t* id, uint32_t id_len) {
+  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  Header* hdr = g_stores[h].hdr;
+  if (LockHeld(hdr) != 0) return -EINVAL;
+  Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
+  if (!e || e->sealed) {
+    pthread_mutex_unlock(&hdr->lock);
+    return -ENOENT;
+  }
+  DeleteEntryLocked(hdr, e);
+  pthread_mutex_unlock(&hdr->lock);
+  return 0;
+}
+
 // Returns pointer into this process's mapping (pinned), or NULL.
 const uint8_t* rts_get(int h, const uint8_t* id, uint32_t id_len,
                        uint64_t* size_out) {

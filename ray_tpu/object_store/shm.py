@@ -49,6 +49,13 @@ def _load():
     lib.rts_get.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
                             ctypes.POINTER(ctypes.c_uint64)]
     lib.rts_get.restype = ctypes.POINTER(ctypes.c_ubyte)
+    lib.rts_create_unsealed.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.c_uint32, ctypes.c_uint64]
+    lib.rts_create_unsealed.restype = ctypes.POINTER(ctypes.c_ubyte)
+    for name in ("rts_seal", "rts_abort"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
+        fn.restype = ctypes.c_int
     for name in ("rts_release", "rts_contains", "rts_delete"):
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
@@ -95,6 +102,28 @@ class ShmObjectStore:
         if rc == -17:      # EEXIST
             return False
         raise OSError(-rc, f"shm put failed: {os.strerror(-rc)}")
+
+    def create(self, object_id: bytes, size: int) -> Optional[memoryview]:
+        """Two-phase write (plasma CreateObject): a WRITABLE view over a
+        freshly allocated arena span — serialize directly into it, then
+        :meth:`seal`. None if the id exists or space can't be found.
+        Unsealed entries are invisible to readers and to eviction."""
+        ptr = self._lib.rts_create_unsealed(self._h, object_id,
+                                            len(object_id), size)
+        if not ptr:
+            return None
+        addr = ctypes.addressof(ptr.contents)
+        return memoryview((ctypes.c_ubyte * size).from_address(addr)) \
+            .cast("B")
+
+    def seal(self, object_id: bytes) -> None:
+        rc = self._lib.rts_seal(self._h, object_id, len(object_id))
+        if rc != 0:
+            raise OSError(-rc, f"shm seal failed: {os.strerror(-rc)}")
+
+    def abort(self, object_id: bytes) -> None:
+        """Free the span of a failed two-phase write."""
+        self._lib.rts_abort(self._h, object_id, len(object_id))
 
     def get(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy view, pinned until :meth:`release`."""
