@@ -1,0 +1,67 @@
+"""Scaled-down scalability envelope (reference: release/benchmarks
+single_node.json rows — many args, many returns, deep queues, large
+objects — shrunk to CI size for this 1-core box; the shapes, not the
+absolute counts, are what regressions break)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_many_object_args_to_one_task(rt):
+    """BASELINE row: 10k+ args to a single task (scaled to 600)."""
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    refs = [ray_tpu.put(i) for i in range(600)]
+    assert ray_tpu.get(total.remote(*refs), timeout=180) == sum(range(600))
+
+
+def test_many_returns_from_one_task(rt):
+    """BASELINE row: 3k+ returns (scaled to 300)."""
+    n = 300
+
+    @ray_tpu.remote(num_returns=n)
+    def spread():
+        return tuple(range(n))
+
+    refs = spread.remote()
+    assert ray_tpu.get(refs, timeout=180) == list(range(n))
+
+
+def test_deep_task_queue_drains(rt):
+    """BASELINE row: 1M+ queued tasks (scaled to 3000): submission must
+    not block on execution, and the queue must fully drain."""
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    refs = [one.remote() for _ in range(3000)]  # enqueues ~instantly
+    assert sum(ray_tpu.get(refs, timeout=300)) == 3000
+
+
+def test_large_object_roundtrip(rt):
+    """BASELINE row: 100 GiB max get (scaled to 200 MB through the shm
+    create/seal path)."""
+    arr = np.arange(200 * 1024 * 1024 // 8, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    back = ray_tpu.get(ref, timeout=120)
+    assert back.shape == arr.shape
+    assert back[0] == 0 and back[-1] == arr[-1]
+    assert np.shares_memory(back, back)  # sanity; zero-copy is get's path
+
+
+def test_many_small_puts_then_gets(rt):
+    """Plasma-object fan row (10k+ objects in one get, scaled to 2000)."""
+    refs = [ray_tpu.put(i) for i in range(2000)]
+    vals = ray_tpu.get(refs, timeout=180)
+    assert vals == list(range(2000))
