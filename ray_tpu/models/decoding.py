@@ -46,22 +46,32 @@ def init_cache(config: LlamaConfig, num_slots: int,
 
 def _attend_cached(q, k_cache, v_cache, lengths, scale):
     """q: (B, 1, H, D) new-token queries; k/v_cache: (B, S, KV, D);
-    lengths: (B,) valid prefix per slot (incl. the new token)."""
+    lengths: (B,) valid prefix per slot (incl. the new token).
+
+    Dispatches to the Pallas flash-decoding kernel on TPU; the XLA path
+    uses a GROUPED einsum (q reshaped (B,KV,group,D)) so the KV cache is
+    never materialized head-repeated — on a (slots, S, KV, D) cache that
+    repeat was group x cache-size of wasted HBM traffic per step."""
     B, _, H, D = q.shape
     KV = k_cache.shape[2]
     group = H // KV
-    qf = q.astype(jnp.float32)
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        on_tpu = False
+    if on_tpu:
+        from ray_tpu.ops.pallas.decode_attention import decode_attention
+
+        return decode_attention(q, k_cache, v_cache, lengths, scale=scale)
+    qg = q.astype(jnp.float32).reshape(B, KV, group, D)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
-    if group > 1:
-        kf = jnp.repeat(kf, group, axis=2)
-        vf = jnp.repeat(vf, group, axis=2)
-    s = jnp.einsum("bqhd,bshd->bhqs", qf, kf) * scale     # (B,H,1,S)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * scale     # (B,KV,group,S)
     mask = (jnp.arange(s.shape[-1])[None, :] < lengths[:, None])
     s = jnp.where(mask[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqs,bshd->bqhd", p, vf)
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, vf)            # (B,KV,group,D)
+    return out.reshape(B, 1, H, D).astype(q.dtype)
 
 
 def _decode_block(x, layer, k_cache, v_cache, lengths, cos, sin,

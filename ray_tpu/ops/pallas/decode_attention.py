@@ -1,0 +1,131 @@
+"""Flash-decoding attention kernel (Pallas TPU): one new query token per
+slot against that slot's KV cache.
+
+The serving engine's decode step is HBM-bandwidth-bound: every step
+streams the whole KV cache once. This kernel keeps the running softmax
+state in VMEM while the cache streams through in blocks (online softmax,
+same recurrence as the training kernel in ``flash_attention.py``) and
+handles GQA by loading one kv head's whole query GROUP as the left matmul
+operand — no head-repeated cache materialization, which the previous XLA
+path paid group× per step.
+
+Layout contract: q (B, 1, H, D); k/v cache (B, S, KV, D); lengths (B,)
+int32 (valid prefix incl. the new token). Grid = (B·KV, S blocks) with the
+S dimension sequential; per-slot length masking uses a (1,1) VMEM block of
+the lengths array.
+
+Net-new vs the reference (its serving attention lives in vLLM's paged
+kernels, outside the repo); this is the TPU analog of flash-decoding.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref,
+                   *, scale: float, block_s: int, num_s_blocks: int,
+                   kv_len: int):
+    ik = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = len_ref[0, 0]
+    # blocks wholly past the valid prefix contribute nothing
+    @pl.when(ik * block_s < length)
+    def _compute():
+        q = q_ref[0]                       # (group, D)
+        k = k_ref[0, :, 0, :]              # (Bs, D)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (group, Bs)
+        col = ik * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ik == num_s_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, *, scale: float,
+                     block_s: int = 512, interpret: bool = False):
+    """q: (B, 1, H, D); k/v_cache: (B, S, KV, D); lengths: (B,) int32.
+    Returns (B, 1, H, D) in q.dtype."""
+    B, _, H, D = q.shape
+    S, KV = k_cache.shape[1], k_cache.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    group = H // KV
+
+    block_s = max(16, min(block_s, S))
+    s_p = math.ceil(S / block_s) * block_s
+    if s_p != S:
+        pad = ((0, 0), (0, s_p - S), (0, 0), (0, 0))
+        k_cache = jnp.pad(k_cache, pad)
+        v_cache = jnp.pad(v_cache, pad)
+    ns = s_p // block_s
+
+    qg = q.reshape(B, KV, group, D).reshape(B * KV, group, D)
+    # one (1,1) scalar block of lengths per (b, kv) program
+    len_in = jnp.broadcast_to(lengths[:, None], (B, KV)) \
+        .reshape(B * KV, 1).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_s=block_s, num_s_blocks=ns,
+        kv_len=S)
+
+    def kv_ix(bk, ik):
+        return (bk // KV, ik, bk % KV, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * KV, ns),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bk, ik: (bk, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, group, D), lambda bk, ik: (bk, 0, 0)),
+            pl.BlockSpec((1, block_s, 1, D), kv_ix),
+            pl.BlockSpec((1, block_s, 1, D), kv_ix),
+        ],
+        out_specs=pl.BlockSpec((1, group, D), lambda bk, ik: (bk, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * KV, group, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, D), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(len_in, qg, k_cache, v_cache)
+
+    return out.reshape(B, KV, group, D).reshape(B, 1, H, D)
