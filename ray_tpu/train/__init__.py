@@ -21,6 +21,7 @@ from ray_tpu.train.context import (  # noqa: F401
     TrainContext,
     checkpoint_dir,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train.scaling_policy import (  # noqa: F401

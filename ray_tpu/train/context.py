@@ -36,6 +36,8 @@ class TrainContext:
     # collective rendezvous namespaces so attempts never see stale state
     run_id: str = ""
 
+    # per-worker Data shards injected by the trainer (name -> DataIterator)
+    _datasets: dict = dataclasses.field(default_factory=dict, repr=False)
     # populated by the worker harness
     _reports: List[dict] = dataclasses.field(default_factory=list)
     _report_lock: threading.Lock = dataclasses.field(
@@ -66,6 +68,17 @@ class TrainContext:
 
     def get_storage_path(self) -> str:
         return self.storage_path
+
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's per-rank DataIterator from the trainer's
+        ``datasets`` (reference: ``ray.train.get_dataset_shard``): fed by
+        ONE streaming execution via ``Dataset.streaming_split`` — blocks
+        arrive as produced, with backpressure, re-iterable per epoch."""
+        if name not in self._datasets:
+            raise KeyError(
+                f"no dataset shard {name!r}; trainer datasets: "
+                f"{sorted(self._datasets)}")
+        return self._datasets[name]
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         """Checkpoint to resume from (set on restore / failure restart)."""
@@ -110,6 +123,12 @@ class TrainContext:
         with self._report_lock:
             out, self._reports = self._reports, []
             return out
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's Data shard (reference:
+    ``ray.train.get_dataset_shard``)."""
+    return get_context().get_dataset_shard(name)
 
 
 def get_context() -> TrainContext:

@@ -85,7 +85,8 @@ class JaxTrainer:
                  run_config: Optional[RunConfig] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
                  poll_interval_s: float = 0.2,
-                 scaling_policy=None):
+                 scaling_policy=None,
+                 datasets: Optional[dict] = None):
         self.train_fn = train_loop_per_worker
         self.config = train_loop_config
         self.scaling = scaling_config or ScalingConfig()
@@ -93,6 +94,32 @@ class JaxTrainer:
         self.resume_from = resume_from_checkpoint
         self.poll_interval_s = poll_interval_s
         self._policy_override = scaling_policy
+        # name -> Dataset: split per gang size at start, one DataIterator
+        # per rank (reference: Train dataset ingest via streaming_split)
+        self.datasets = dict(datasets or {})
+        self._split_coords: list = []
+
+    def _make_shards(self, size: int):
+        """Split each named dataset into per-rank streaming iterators for
+        THIS gang instance; a resize re-splits at the new size. Old split
+        coordinators are reaped so their executions stop."""
+        if not self.datasets:
+            return None
+        import ray_tpu
+
+        for coord in self._split_coords:
+            try:
+                ray_tpu.kill(coord)
+            except Exception:  # noqa: BLE001
+                pass
+        self._split_coords = []
+        shards = {}
+        for dname, ds in self.datasets.items():
+            its = ds.streaming_split(size)
+            if its:
+                self._split_coords.append(its[0]._coord)
+            shards[dname] = its
+        return shards
 
     # ------------------------------------------------------------------ fit
     def fit(self, timeout_s: float = 3600.0) -> Result:
@@ -145,6 +172,7 @@ class JaxTrainer:
                 # start() inside the try: a scheduling failure must still
                 # release the placement group + any created actors.
                 group.start(experiment_name=name, storage_path=storage,
+                            dataset_shards=self._make_shards(size),
                             train_fn=self.train_fn, config=self.config,
                             resume_from_path=resume.path if resume else None)
                 error, last_metrics = self._poll_until_done(
@@ -161,6 +189,14 @@ class JaxTrainer:
             finally:
                 group.shutdown()
             if error is None:
+                for coord in self._split_coords:
+                    try:
+                        import ray_tpu
+
+                        ray_tpu.kill(coord)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._split_coords = []
                 return Result(metrics=last_metrics,
                               checkpoint=manager.latest, path=storage)
             if isinstance(error, ResizeDecision):

@@ -45,7 +45,10 @@ class TrainWorker:
         from ray_tpu.train.context import TrainContext, _set_context
 
         resume = ctx_kwargs.pop("resume_from_path", None)
+        datasets = ctx_kwargs.pop("datasets", None)
         ctx = TrainContext(**ctx_kwargs)
+        if datasets:
+            ctx._datasets = dict(datasets)
         if resume:
             ctx.resume_from = Checkpoint(resume)
         self._ctx = ctx
@@ -115,6 +118,7 @@ class WorkerGroup:
     def start(self, *, experiment_name: str, storage_path: str,
               train_fn: Callable, config: Optional[dict],
               resume_from_path: Optional[str] = None,
+              dataset_shards: Optional[Dict[str, list]] = None,
               pg_timeout: float = 60.0) -> None:
         import ray_tpu
 
@@ -164,6 +168,9 @@ class WorkerGroup:
                 "storage_path": storage_path,
                 "coordinator": coordinator,
                 "resume_from_path": resume_from_path,
+                "datasets": ({name: shards[rank] for name, shards
+                              in dataset_shards.items()}
+                             if dataset_shards else None),
             }))
         ray_tpu.get(setups)
         ray_tpu.get([w.run.remote(train_fn, config) for w in self.workers])
