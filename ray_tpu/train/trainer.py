@@ -99,12 +99,9 @@ class JaxTrainer:
         self.datasets = dict(datasets or {})
         self._split_coords: list = []
 
-    def _make_shards(self, size: int):
-        """Split each named dataset into per-rank streaming iterators for
-        THIS gang instance; a resize re-splits at the new size. Old split
-        coordinators are reaped so their executions stop."""
-        if not self.datasets:
-            return None
+    def _reap_coords(self):
+        """Kill split coordinators so their streaming executions stop
+        (and their buffered block refs unpin)."""
         import ray_tpu
 
         for coord in self._split_coords:
@@ -113,6 +110,14 @@ class JaxTrainer:
             except Exception:  # noqa: BLE001
                 pass
         self._split_coords = []
+
+    def _make_shards(self, size: int):
+        """Split each named dataset into per-rank streaming iterators for
+        THIS gang instance; a resize re-splits at the new size. Old split
+        coordinators are reaped so their executions stop."""
+        if not self.datasets:
+            return None
+        self._reap_coords()
         shards = {}
         for dname, ds in self.datasets.items():
             its = ds.streaming_split(size)
@@ -155,6 +160,22 @@ class JaxTrainer:
         deadline = time.monotonic() + timeout_s
         next_size: Optional[int] = None  # explicit size from a resize
         started_once = False
+        try:
+            return self._fit_loop(
+                sc, policy, manager, name, storage, failures, last_metrics,
+                deadline)
+        finally:
+            # every exit (success, timeout, max-failures, scheduling
+            # failure) reaps split coordinators — a raising exit must not
+            # leave their streaming executions running
+            self._reap_coords()
+
+    def _fit_loop(self, sc, policy, manager, name, storage, failures,
+                  last_metrics, deadline):
+        from ray_tpu.train.scaling_policy import ResizeDecision
+
+        next_size: Optional[int] = None
+        started_once = False
         while True:
             bundle = sc.bundle()
             if next_size is not None:
@@ -189,14 +210,6 @@ class JaxTrainer:
             finally:
                 group.shutdown()
             if error is None:
-                for coord in self._split_coords:
-                    try:
-                        import ray_tpu
-
-                        ray_tpu.kill(coord)
-                    except Exception:  # noqa: BLE001
-                        pass
-                self._split_coords = []
                 return Result(metrics=last_metrics,
                               checkpoint=manager.latest, path=storage)
             if isinstance(error, ResizeDecision):

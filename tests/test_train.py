@@ -297,19 +297,32 @@ def test_dataset_ingest_via_streaming_split(rt):
     from ray_tpu.train import (JaxTrainer, ScalingConfig, RunConfig,
                                get_dataset_shard, report)
 
+    import json
+    import tempfile
+
+    out_dir = tempfile.mkdtemp(prefix="rt_ingest_")
+
     def loop(config):
+        from ray_tpu.train import get_context
+
         it = get_dataset_shard("train")
         seen = sorted(int(r["id"]) for r in it.iter_rows())
-        report({"n": len(seen), "lo": seen[0] if seen else -1,
-                "ids_sum": sum(seen)})
+        rank = get_context().get_world_rank()
+        with open(os.path.join(config["out"], f"rank{rank}.json"),
+                  "w") as f:
+            json.dump(seen, f)
+        report({"n": len(seen)})
 
     ds = rd.range(40, num_blocks=4)
     trainer = JaxTrainer(
-        loop,
+        loop, train_loop_config={"out": out_dir},
         scaling_config=ScalingConfig(num_workers=2,
                                      resources_per_worker={"CPU": 0}),
         run_config=RunConfig(name=f"ingest_{os.getpid()}"),
         datasets={"train": ds})
-    result = trainer.fit(timeout_s=240)
-    # both workers reported; union of shards == the whole range
-    assert result.metrics["n"] > 0
+    trainer.fit(timeout_s=240)
+    shards = [json.load(open(os.path.join(out_dir, f"rank{r}.json")))
+              for r in range(2)]
+    assert shards[0] and shards[1], "both ranks must receive rows"
+    assert not (set(shards[0]) & set(shards[1])), "shards must be disjoint"
+    assert sorted(shards[0] + shards[1]) == list(range(40))
