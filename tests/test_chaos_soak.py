@@ -1,0 +1,134 @@
+"""Chaos soak: random worker kills while round-3 features are under load
+(reference pattern: python/ray/tests/chaos + ResourceKiller actors,
+SURVEY §4.4). Bounded runtime; exercises retries, actor restarts, and
+streaming-generator replay under real process death."""
+
+import os
+import random
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _worker_pids():
+    """Pids of live worker processes: exec'd workers by cmdline, plus
+    factory-forked workers (fork keeps the factory's cmdline, so they are
+    identified as CHILDREN of a factory process)."""
+    import subprocess
+
+    def pgrep(pat):
+        out = subprocess.run(["pgrep", "-f", pat],
+                             capture_output=True, text=True).stdout.split()
+        return [int(p) for p in out]
+
+    pids = pgrep("ray_tpu.core_worker.worker_main")
+    factories = set(pgrep("ray_tpu.raylet.worker_factory"))
+    for cand in factories:
+        try:
+            with open(f"/proc/{cand}/status") as f:
+                ppid = int(next(ln for ln in f if ln.startswith("PPid"))
+                           .split()[1])
+        except (OSError, StopIteration):
+            continue
+        if ppid in factories:  # a forked worker, not the factory itself
+            pids.append(cand)
+    return pids
+
+
+def test_tasks_survive_random_worker_kills(rt):
+    """A stream of retriable tasks completes correctly while a chaos loop
+    SIGKILLs random worker processes."""
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.02)
+        return i * 3
+
+    rng = random.Random(0)
+    stop = time.monotonic() + 20.0
+    refs = []
+    submitted = 0
+    kills = 0
+    while time.monotonic() < stop:
+        refs.extend(work.remote(submitted + j) for j in range(10))
+        submitted += 10
+        if rng.random() < 0.3:
+            pids = _worker_pids()
+            if pids:
+                victim = rng.choice(pids)
+                try:
+                    os.kill(victim, signal.SIGKILL)
+                    kills += 1
+                except OSError:
+                    pass
+        time.sleep(0.2)
+        if submitted >= 300:
+            break
+    vals = ray_tpu.get(refs, timeout=300)
+    assert vals == [i * 3 for i in range(submitted)]
+    assert kills >= 1, "chaos loop never found a worker to kill"
+
+
+def test_streaming_generator_survives_kills(rt):
+    """Streaming tasks replay through worker death: all items arrive
+    exactly once even when the producer's worker is killed mid-stream."""
+    @ray_tpu.remote(num_returns="streaming", max_retries=5)
+    def gen(n):
+        for i in range(n):
+            time.sleep(0.02)
+            yield i
+
+    g = gen.remote(40)
+    got = []
+    killed = False
+    for k, ref in enumerate(g):
+        got.append(ray_tpu.get(ref))
+        if k == 5 and not killed:
+            for pid in _worker_pids():
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            killed = True
+    assert got == list(range(40))
+    assert killed
+
+
+def test_restartable_actor_through_kills(rt):
+    """An actor with max_restarts keeps serving (state resets, calls
+    resume) across a SIGKILL of its worker."""
+    @ray_tpu.remote(max_restarts=3)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    pid = ray_tpu.get(c.pid.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 90
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(c.incr.remote(), timeout=30)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert val == 1, f"restarted actor should reset state, got {val}"
+    assert ray_tpu.get(c.pid.remote(), timeout=30) != pid
