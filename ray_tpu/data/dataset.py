@@ -204,6 +204,34 @@ class Dataset:
 
         return self.map_batches(do)
 
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        """Keep only ``cols`` (reference ``Dataset.select_columns``)."""
+        cols = list(cols)
+
+        def do(batch):
+            missing = [c for c in cols if c not in batch]
+            if missing:
+                raise KeyError(f"select_columns: missing {missing}")
+            return {c: batch[c] for c in cols}
+
+        return self.map_batches(do)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        dropped = set(cols)
+
+        def do(batch):
+            return {c: v for c, v in batch.items() if c not in dropped}
+
+        return self.map_batches(do)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        mapping = dict(mapping)
+
+        def do(batch):
+            return {mapping.get(c, c): v for c, v in batch.items()}
+
+        return self.map_batches(do)
+
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with(_Shuffle("repartition",
                                    lambda _n_in: num_blocks))
@@ -427,6 +455,33 @@ class Dataset:
                      if lo < n else None)
         if carry and not drop_last:
             yield carry
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = False, device=None,
+                         sharding=None) -> Iterator[Dict]:
+        """``iter_batches`` with leaves placed as jax.Arrays (the TPU
+        ingest analog of the reference's ``iter_torch_batches``):
+        ``device``/``sharding`` forwards to ``jax.device_put`` — pass a
+        NamedSharding to land batches directly in a mesh layout."""
+        import jax
+
+        target = sharding if sharding is not None else device
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: (jax.device_put(v, target) if target is not None
+                       else jax.numpy.asarray(v))
+                   for k, v in batch.items()}
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[Dict]:
+        """``iter_batches`` with leaves as torch tensors (reference
+        ``Dataset.iter_torch_batches``; CPU tensors — this framework's
+        accelerator path is JAX)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
 
     def split(self, n: int) -> List["Dataset"]:
         """Split block refs into n datasets (per-worker shards)."""

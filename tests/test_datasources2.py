@@ -104,3 +104,31 @@ class TestFromArrowTorch:
         assert len(rows) == 10
         vals = sorted(int(np.asarray(r["item"])[0]) for r in rows)
         assert vals == [i * i for i in range(10)]
+
+
+class TestColumnOpsAndFrameworkBatches:
+    def test_select_drop_rename(self, rt):
+        ds = rd.from_items([{"a": i, "b": i * 2, "c": i * 3}
+                            for i in range(5)])
+        assert ds.select_columns(["a", "c"]).schema() == ["a", "c"]
+        assert ds.drop_columns(["b"]).schema() == ["a", "c"]
+        rows = ds.rename_columns({"a": "x"}).take(2)
+        assert set(rows[0]) == {"x", "b", "c"}
+        with pytest.raises(Exception):
+            ds.select_columns(["nope"]).take_all()
+
+    def test_iter_jax_batches(self, rt):
+        import jax.numpy as jnp
+
+        ds = rd.range(10)
+        batches = list(ds.iter_jax_batches(batch_size=4))
+        assert isinstance(batches[0]["id"], jnp.ndarray)
+        assert int(batches[0]["id"].sum()) == 0 + 1 + 2 + 3
+
+    def test_iter_torch_batches(self, rt):
+        import torch
+
+        ds = rd.range(6)
+        batches = list(ds.iter_torch_batches(batch_size=6))
+        assert isinstance(batches[0]["id"], torch.Tensor)
+        assert int(batches[0]["id"].sum()) == 15
