@@ -131,6 +131,9 @@ _D("scheduler_spread_threshold", float, 0.5, "utilization below which packing wi
 _D("max_pending_lease_requests_per_scheduling_category", int, 10, "")
 _D("worker_lease_timeout_ms", int, 30000, "")
 _D("lease_request_batch_size", int, 10, "leases requested per shape at once")
+_D("lease_idle_grace_ms", int, 100,
+   "idle lease retention: how long a drained lease waits for more"
+   " same-shape work before returning its worker")
 
 # --- workers -----------------------------------------------------------------
 _D("log_to_driver", bool, True,

@@ -42,6 +42,9 @@ class NormalTaskSubmitter:
         self._pending: List[TaskSpec] = []
         self._pending_lock = threading.Lock()
         self._wakeup_scheduled = False
+        # set when work arrives for a shape: an idle lease holder waits on
+        # it briefly instead of returning the worker (lease retention)
+        self._work_events: Dict[tuple, asyncio.Event] = {}
 
     def submit(self, spec: TaskSpec):
         # Batched wakeup: a burst of submits from caller threads schedules
@@ -65,6 +68,9 @@ class NormalTaskSubmitter:
     def _enqueue(self, spec: TaskSpec):
         key = spec.shape_key()
         self._queues.setdefault(key, []).append(spec)
+        ev = self._work_events.get(key)
+        if ev is not None:
+            ev.set()  # wake an idle lease holder before starting a new one
         in_flight = self._leases_in_flight.get(key, 0)
         max_leases = GLOBAL_CONFIG.get("lease_request_batch_size")
         if in_flight < min(len(self._queues[key]), max_leases):
@@ -159,12 +165,28 @@ class NormalTaskSubmitter:
         return None
 
     async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr):
+        """Drain queued tasks through one leased worker. When the queue
+        empties, the lease is RETAINED for a short grace window waiting for
+        more same-shape work (reference: lease pooling / idle lease reuse)
+        — a sequential sync caller otherwise pays a full lease round-trip
+        per task."""
         client = RpcClient(worker_addr)
+        grace_s = GLOBAL_CONFIG.get("lease_idle_grace_ms") / 1000.0
         try:
             while True:
                 queue = self._queues.get(key)
                 if not queue:
-                    return
+                    if grace_s <= 0:
+                        return
+                    ev = self._work_events.get(key)
+                    if ev is None:
+                        ev = self._work_events[key] = asyncio.Event()
+                    ev.clear()
+                    try:
+                        await asyncio.wait_for(ev.wait(), grace_s)
+                    except asyncio.TimeoutError:
+                        return  # stayed idle: give the worker back
+                    continue
                 spec = queue.pop(0)
                 logger.debug("pushing task %s to %s", spec.task_id.hex()[:8], worker_addr)
                 try:
