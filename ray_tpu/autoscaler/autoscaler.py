@@ -43,6 +43,20 @@ class NodeType:
     labels: Dict[str, str] = field(default_factory=dict)
 
 
+def _hex(nid) -> str:
+    return nid.hex() if hasattr(nid, "hex") else bytes(nid).hex()
+
+
+def _node_identities(node: dict) -> set:
+    """All strings by which a provider launch handle may refer to this
+    node: its node-id hex plus every node label value (cloud providers
+    stamp their launch handle into node labels)."""
+    ids = {_hex(node["node_id"])}
+    labels = (node.get("resources") or {}).get("labels") or {}
+    ids.update(str(v) for v in labels.values())
+    return ids
+
+
 def _fits(demand: Dict[str, float], capacity: Dict[str, float]) -> bool:
     return all(capacity.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
 
@@ -66,7 +80,11 @@ class Autoscaler:
             idle_timeout_s if idle_timeout_s is not None
             else GLOBAL_CONFIG.get("autoscaler_idle_timeout_s"))
         self._launched: Dict[str, str] = {}       # node handle -> type name
+        self._launch_time: Dict[str, float] = {}  # node handle -> monotonic
         self._idle_since: Dict[str, float] = {}
+        # a launched node that never registers (crashed boot, dead cloud
+        # instance) must not count as capacity forever
+        self._launch_timeout = GLOBAL_CONFIG.get("autoscaler_launch_timeout_s")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # raylets consult this flag to queue infeasible-now demands; set it
@@ -91,8 +109,13 @@ class Autoscaler:
         if terminate_nodes:
             for handle in list(self._launched):
                 self._provider.terminate_node(handle)
-                self._launched.pop(handle, None)
+                self._forget(handle)
         self._gcs.close()
+
+    def _forget(self, handle: str) -> None:
+        self._launched.pop(handle, None)
+        self._launch_time.pop(handle, None)
+        self._idle_since.pop(handle, None)
 
     def status(self) -> Dict[str, object]:
         return {"launched": dict(self._launched),
@@ -118,17 +141,51 @@ class Autoscaler:
             dict(d.get("resources", d)) for d in raw]
 
         alive = [n for n in nodes if n.get("alive")]
-        alive_ids = {n["node_id"].hex() if hasattr(n["node_id"], "hex")
-                     else bytes(n["node_id"]).hex() for n in alive}
+        # A provider handle is correlated with GCS nodes by node-id hex
+        # (LocalRayletProvider) or by a node label value (GcePodProvider
+        # stamps the slice-name handle into node labels) — a handle that
+        # matches neither has simply not registered yet.
+        alive_ids = set()
+        for n in alive:
+            alive_ids.update(_node_identities(n))
+        dead_ids = set()
+        for n in nodes:
+            if not n.get("alive"):
+                dead_ids.update(_node_identities(n))
+        dead_ids -= alive_ids  # multi-node slice: dead only if no node left
         # Simulate placement on current availability PLUS launched-but-not-
         # yet-registered nodes (their full type capacity) — otherwise every
         # tick re-launches for the same demand until max_workers
         # (launch→registration latency is seconds on a real provider).
         capacities = [dict((n.get("resources") or {}).get("available") or {})
                       for n in alive]
-        for handle, type_name in self._launched.items():
-            if handle not in alive_ids:
-                capacities.append(dict(self._types[type_name].resources))
+        now = time.monotonic()
+        for handle, type_name in list(self._launched.items()):
+            if handle in alive_ids:
+                self._launch_time.pop(handle, None)  # registered
+                continue
+            started = self._launch_time.get(handle)
+            timed_out = (started is not None
+                         and now - started > self._launch_timeout)
+            if handle in dead_ids or timed_out:
+                # registered-then-died, or never registered in time: the
+                # node must stop counting as capacity and stop occupying a
+                # max_workers slot. On terminate failure keep the entry so
+                # the terminate is retried next tick (never silently leak
+                # a running instance).
+                logger.warning(
+                    "dropping node %s (%s)", handle[:8],
+                    "died" if handle in dead_ids else
+                    f"never registered within {self._launch_timeout:.0f}s")
+                try:
+                    self._provider.terminate_node(handle)
+                except Exception:  # noqa: BLE001 — retried next tick
+                    logger.exception("terminate of %s failed; will retry",
+                                     handle[:8])
+                else:
+                    self._forget(handle)
+                continue  # either way: no capacity credit
+            capacities.append(dict(self._types[type_name].resources))
         unmet: List[Dict[str, float]] = []
         for demand in sorted(demands, key=lambda d: -sum(d.values())):
             for cap in capacities:
@@ -176,25 +233,44 @@ class Autoscaler:
             handle = self._provider.launch_node(
                 t.name, dict(t.resources), dict(t.labels))
             self._launched[handle] = t.name
+            self._launch_time[handle] = time.monotonic()
+            # only after the launch is recorded may the node register —
+            # otherwise a fast in-process node can satisfy pending demand
+            # while status() still shows nothing launched
+            try:
+                self._provider.confirm_launch(handle)
+            except Exception:  # noqa: BLE001 — boot failure: retry next tick
+                logger.exception("node %s failed to start", handle[:8])
+                try:
+                    # the provider may have allocated a real instance before
+                    # the failure; never leak it unattended
+                    self._provider.terminate_node(handle)
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+                self._forget(handle)
 
     def _terminate_idle(self, alive_nodes: List[dict], have_demand: bool):
         now = time.monotonic()
-        by_id = {n["node_id"].hex() if hasattr(n["node_id"], "hex")
-                 else bytes(n["node_id"]).hex(): n for n in alive_nodes}
         for handle in list(self._launched):
-            node = by_id.get(handle)
-            if node is None:
+            mine = [n for n in alive_nodes
+                    if handle in _node_identities(n)]
+            if not mine:
                 self._idle_since.pop(handle, None)
                 continue
-            snap = node.get("resources") or {}
-            total = snap.get("total") or {}
-            avail = snap.get("available") or {}
-            fully_idle = all(avail.get(k, 0.0) >= v for k, v in total.items())
+            # a multi-node launch (pod slice) is idle only when EVERY node
+            # belonging to the handle is fully idle
+            fully_idle = True
+            for node in mine:
+                snap = node.get("resources") or {}
+                total = snap.get("total") or {}
+                avail = snap.get("available") or {}
+                if not all(avail.get(k, 0.0) >= v for k, v in total.items()):
+                    fully_idle = False
+                    break
             if fully_idle and not have_demand:
                 first = self._idle_since.setdefault(handle, now)
                 if now - first >= self._idle_timeout:
                     self._provider.terminate_node(handle)
-                    self._launched.pop(handle, None)
-                    self._idle_since.pop(handle, None)
+                    self._forget(handle)
             else:
                 self._idle_since.pop(handle, None)

@@ -28,6 +28,13 @@ class NodeProvider:
         (the node registers itself with the GCS asynchronously)."""
         raise NotImplementedError
 
+    def confirm_launch(self, node_handle: str) -> None:
+        """Called once the autoscaler has recorded `node_handle` in its
+        bookkeeping. In-process providers (whose nodes would otherwise
+        register with the GCS instantly) defer registration until this
+        point so cluster state never runs ahead of autoscaler state; cloud
+        providers (registration takes minutes anyway) ignore it."""
+
     def terminate_node(self, node_handle: str) -> None:
         raise NotImplementedError
 
@@ -42,6 +49,7 @@ class LocalRayletProvider(NodeProvider):
     def __init__(self, gcs_address: Tuple[str, int]):
         self._gcs_address = tuple(gcs_address)
         self._nodes: Dict[str, object] = {}  # node_id hex -> Raylet
+        self._started: set = set()
         self._lock = threading.Lock()
 
     def launch_node(self, node_type: str, resources: Dict[str, float],
@@ -52,17 +60,39 @@ class LocalRayletProvider(NodeProvider):
         labels["rt.io/node-type"] = node_type
         raylet = Raylet(self._gcs_address, resources=dict(resources),
                         labels=labels)
-        raylet.start()
         handle = raylet.node_id.hex()
+        # Return the handle BEFORE the node registers with the GCS (real
+        # cloud providers return an instance id immediately; registration
+        # follows minutes later). Registering inside launch_node lets the
+        # cluster satisfy demand before the autoscaler has recorded the
+        # launch, racing anything that reads its bookkeeping. Registration
+        # happens in confirm_launch().
         with self._lock:
             self._nodes[handle] = raylet
         logger.info("autoscaler launched node %s type=%s resources=%s",
                     handle[:8], node_type, resources)
         return handle
 
+    def confirm_launch(self, node_handle: str) -> None:
+        with self._lock:
+            raylet = self._nodes.get(node_handle)
+            if raylet is None or node_handle in self._started:
+                return
+            self._started.add(node_handle)
+        try:
+            raylet.start()
+        except Exception:
+            # a node that failed to boot must not linger as launched-but-
+            # never-registering capacity; drop it and let the caller retry
+            with self._lock:
+                self._nodes.pop(node_handle, None)
+                self._started.discard(node_handle)
+            raise
+
     def terminate_node(self, node_handle: str) -> None:
         with self._lock:
             raylet = self._nodes.pop(node_handle, None)
+            self._started.discard(node_handle)
         if raylet is None:
             return
         try:

@@ -179,6 +179,8 @@ _D("autoscaling_enabled", bool, False,
    "queue infeasible-now demands for the autoscaler instead of failing them")
 _D("autoscaler_interval_s", float, 1.0, "reconcile loop period")
 _D("autoscaler_idle_timeout_s", float, 30.0, "idle node termination threshold")
+_D("autoscaler_launch_timeout_s", float, 120.0,
+   "drop a launched node that never registers with the GCS within this time")
 
 # --- chaos / testing ---------------------------------------------------------
 _D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep")

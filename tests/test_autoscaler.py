@@ -76,3 +76,84 @@ def test_max_workers_cap(scaled_cluster):
         placed = sum(1 for pg in pgs if pg.wait(timeout_seconds=0.5))
     assert placed >= 2
     assert len(autoscaler.status()["launched"]) <= 2
+
+
+class _NeverRegistersProvider:
+    """Launches nothing: handles never register with the GCS."""
+
+    def __init__(self):
+        self.launches = []
+        self.terminated = []
+
+    def launch_node(self, node_type, resources, labels):
+        handle = f"fake-{len(self.launches)}"
+        self.launches.append(handle)
+        return handle
+
+    def confirm_launch(self, handle):
+        pass
+
+    def terminate_node(self, handle):
+        self.terminated.append(handle)
+
+    def live_nodes(self):
+        return [h for h in self.launches if h not in self.terminated]
+
+
+def test_launch_timeout_drops_phantom_node():
+    """A launched node that never registers must stop counting as capacity
+    after autoscaler_launch_timeout_s, so the demand gets a fresh launch."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler
+    from ray_tpu.common.config import GLOBAL_CONFIG
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    provider = _NeverRegistersProvider()
+    autoscaler = None
+    prev_timeout = GLOBAL_CONFIG.get("autoscaler_launch_timeout_s")
+    GLOBAL_CONFIG.set_system_config_value("autoscaler_launch_timeout_s", 1.0)
+    try:
+        autoscaler = Autoscaler(
+            cluster.gcs.address,
+            node_types=[NodeType("cpu2", {"CPU": 2}, max_workers=4)],
+            provider=provider, interval_s=0.2, idle_timeout_s=30.0)
+        autoscaler.start()
+        ray_tpu.init(address=cluster.address)
+        from ray_tpu import placement_group
+
+        placement_group([{"CPU": 2}], strategy="PACK")  # unplaceable demand
+        _wait(lambda: len(provider.launches) >= 1, msg="first launch")
+        # phantom never registers: must be terminated + relaunched
+        _wait(lambda: provider.terminated and len(provider.launches) >= 2,
+              timeout=15, msg="phantom drop + relaunch")
+        assert provider.launches[0] in provider.terminated
+    finally:
+        ray_tpu.shutdown()
+        if autoscaler is not None:
+            autoscaler.stop()
+        cluster.shutdown()
+        GLOBAL_CONFIG.set_system_config_value("autoscaler_launch_timeout_s",
+                                              prev_timeout)
+
+
+def test_registered_then_died_node_is_dropped(scaled_cluster):
+    """A node that registered and then died must be dropped from launch
+    bookkeeping (it is not capacity) so new demand launches a fresh node."""
+    cluster, autoscaler = scaled_cluster
+    from ray_tpu import placement_group, remove_placement_group
+    from ray_tpu.gcs.client import GcsClient
+
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=60)
+    (handle,) = autoscaler.status()["launched"]
+    remove_placement_group(pg)
+
+    # simulate node death: GCS marks it dead while the autoscaler still
+    # tracks the launch
+    c = GcsClient(cluster.gcs.address)
+    c.call("unregister_node", node_id=bytes.fromhex(handle))
+    c.close()
+    _wait(lambda: handle not in autoscaler.status()["launched"],
+          timeout=15, msg="dead node dropped from bookkeeping")
+
+    pg2 = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg2.wait(timeout_seconds=60), "no relaunch after node death"
