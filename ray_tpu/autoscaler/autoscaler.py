@@ -245,9 +245,12 @@ class Autoscaler:
                     # the provider may have allocated a real instance before
                     # the failure; never leak it unattended
                     self._provider.terminate_node(handle)
-                except Exception:  # noqa: BLE001 — best effort
-                    pass
-                self._forget(handle)
+                except Exception:  # noqa: BLE001 — keep the entry: the
+                    # launch-timeout sweep will retry the terminate
+                    logger.exception("terminate of %s failed; will retry",
+                                     handle[:8])
+                else:
+                    self._forget(handle)
 
     def _terminate_idle(self, alive_nodes: List[dict], have_demand: bool):
         now = time.monotonic()

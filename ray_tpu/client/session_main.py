@@ -237,13 +237,20 @@ class SessionDriver:
 
     # ---------------------------------------------------------------- main
     def run(self):
+        import signal
+
+        stop = threading.Event()
+        # end_session SIGTERMs this process; the default handler would kill
+        # it mid-sleep WITHOUT running the finally below, so the session's
+        # job would never call finish_job and its actors would leak until
+        # the GCS driver-health loop notices. Exit promptly and cleanly.
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
         ray_tpu.init()  # RT_ADDRESS from the client server
         self.server.start()
         host, port = self.server.address
         print(f"SESSION_READY {host} {port}", flush=True)
         try:
-            while True:
-                time.sleep(1.0)
+            while not stop.wait(1.0):
                 if time.monotonic() - self._last_heartbeat > \
                         HEARTBEAT_TIMEOUT_S:
                     break  # client gone: release the job and exit

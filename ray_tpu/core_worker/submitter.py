@@ -30,6 +30,11 @@ from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcError
 logger = logging.getLogger(__name__)
 
 
+class _JobFinishedByRaylet(WorkerCrashedError):
+    """The raylet rejected a queued lease because this job was finished
+    (the GCS declared the driver dead). Terminal for the affected tasks."""
+
+
 class NormalTaskSubmitter:
     """Per-shape lease pools; pushes tasks directly to leased workers."""
 
@@ -85,6 +90,10 @@ class NormalTaskSubmitter:
             while self._queues.get(key):
                 try:
                     grant = await self._request_lease(sample)
+                except _JobFinishedByRaylet as jf_err:
+                    for spec in self._queues.pop(key, []):
+                        self._store_error(spec, jf_err)
+                    return
                 except RuntimeEnvError as env_err:
                     # Env setup failure fails the queued tasks terminally,
                     # matching the reference's RuntimeEnvSetupError semantics
@@ -142,6 +151,9 @@ class NormalTaskSubmitter:
                     strategy=strategy,
                     pg=pg,
                     runtime_env=spec.runtime_env,
+                    # the raylet reclaims this job's leases when the job
+                    # finishes (driver exit/death must free its workers)
+                    job_id=self._cw.job_id.binary(),
                     timeout=None,
                 )
             except Exception as e:  # noqa: BLE001
@@ -162,6 +174,13 @@ class NormalTaskSubmitter:
                 raise RuntimeEnvError(reply.get("error", "runtime env failed"))
             if status == "infeasible":
                 return None
+            if status == "job_finished":
+                # the raylet reclaimed this job's queued leases (driver
+                # declared dead); do NOT re-request — fail terminally so a
+                # false-positive death surfaces as an error, not a hang
+                raise _JobFinishedByRaylet(
+                    "lease rejected: this job was finished (driver "
+                    "unreachable or exited)")
         return None
 
     async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr):

@@ -23,7 +23,8 @@ from ray_tpu.common.config import GLOBAL_CONFIG
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.common.resources import NodeResources, ResourceRequest
 from ray_tpu.rpc.pubsub import Publisher
-from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcServer
+from ray_tpu.rpc.rpc import (IoContext, RetryableRpcClient, RpcClient,
+                             RpcServer)
 from ray_tpu.scheduling import ClusterView, NodeEntry, policies
 
 logger = logging.getLogger(__name__)
@@ -283,6 +284,7 @@ class GcsServer:
     def start(self):
         self.server.start()
         self._io.spawn_threadsafe(self._health_loop())
+        self._io.spawn_threadsafe(self._driver_health_loop())
         if self._recovered:
             self._io.spawn_threadsafe(self._reconcile_after_restart())
 
@@ -549,6 +551,55 @@ class GcsServer:
             if actor.job_id == jid and actor.state not in (ACTOR_DEAD,):
                 await self._kill_actor_internal(actor, "job finished")
         return True
+
+    async def _driver_health_loop(self):
+        """Finish jobs whose driver died without calling finish_job (SIGKILL,
+        SIGTERM mid-sleep, crashed client session driver): otherwise the
+        job's actors hold their resources forever and starve the cluster.
+        Reference: the GCS job manager observes driver disconnects
+        (gcs/gcs_server/gcs_job_manager.cc) and runs the same teardown as a
+        graceful exit."""
+        period = GLOBAL_CONFIG.get("health_check_period_ms") / 1000.0
+        threshold = GLOBAL_CONFIG.get("health_check_failure_threshold")
+        timeout = GLOBAL_CONFIG.get("health_check_timeout_ms") / 1000.0
+        misses: Dict[JobID, int] = {}
+        clients: Dict[JobID, RpcClient] = {}
+        while not self._stopped:
+            await asyncio.sleep(period)
+            for jid, rec in list(self._jobs.items()):
+                if rec.state != "RUNNING" or not rec.driver_address:
+                    c = clients.pop(jid, None)
+                    if c is not None:
+                        c.close()
+                    misses.pop(jid, None)
+                    continue
+                client = clients.get(jid)
+                if client is None:
+                    # plain RpcClient: each miss must count toward the
+                    # threshold, so no retry layer (it reconnects per call)
+                    client = RpcClient(tuple(rec.driver_address))
+                    clients[jid] = client
+                try:
+                    await client.call_async("ping", timeout=timeout)
+                    misses[jid] = 0
+                    continue
+                except Exception:  # noqa: BLE001 — count toward threshold
+                    misses[jid] = misses.get(jid, 0) + 1
+                    if misses[jid] < threshold:
+                        continue
+                logger.warning(
+                    "driver of job %s unreachable x%d; finishing job",
+                    jid.hex()[:8], misses[jid])
+                try:
+                    await self.h_finish_job(jid.binary())
+                except Exception:  # noqa: BLE001 — teardown failure must
+                    # not kill this loop; the job stays RUNNING and the
+                    # finish is retried at the next threshold crossing
+                    logger.exception("finishing job %s failed", jid.hex()[:8])
+                c = clients.pop(jid, None)
+                if c is not None:
+                    c.close()
+                misses.pop(jid, None)
 
     async def h_get_all_jobs(self):
         return [

@@ -56,6 +56,7 @@ class WorkerHandle:
     request: Optional[ResourceRequest] = None
     pg: Optional[Tuple[PlacementGroupID, int]] = None
     actor_id: Optional[bytes] = None
+    job_id: Optional[bytes] = None  # job owning the current lease
     idle_since: float = field(default_factory=time.monotonic)
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
     # factory-forked workers have a bare pid instead of a Popen handle
@@ -228,6 +229,7 @@ class Raylet:
         self.gcs.subscriber.subscribe("resources", self._on_resources_update)
         self.gcs.subscriber.subscribe("node", self._on_node_update)
         self.gcs.subscriber.subscribe("system_config", self._on_system_config)
+        self.gcs.subscriber.subscribe("job", self._on_job_update)
         self._io.spawn_threadsafe(self._report_loop())
         self._io.spawn_threadsafe(self._reap_loop())
         if GLOBAL_CONFIG.get("worker_factory_enabled"):
@@ -341,6 +343,36 @@ class Raylet:
             GLOBAL_CONFIG.set_system_config_value(key, msg.get("value"))
         except ValueError:
             logger.warning("unknown system_config key from GCS: %s", key)
+
+    def _on_job_update(self, job_hex: str, msg: dict):
+        """A finished job's leased workers must be reclaimed: the driver
+        died or exited, nobody will return those leases, and the held CPUs
+        would starve the cluster (reference: the raylet kills a dead job's
+        workers — worker_pool.cc HandleJobFinished)."""
+        if (msg or {}).get("state") != "FINISHED":
+            return
+
+        async def reclaim():
+            try:
+                job_raw = bytes.fromhex(job_hex)
+            except ValueError:
+                return
+            for w in list(self._workers.values()):
+                if (w.job_id == job_raw and w.lease_id is not None
+                        and w.state != "DEAD"):
+                    logger.info("reclaiming worker %s leased by finished "
+                                "job %s", w.worker_id.hex()[:8], job_hex[:8])
+                    # account first (frees lease, reports actor death),
+                    # then terminate the process
+                    await self._on_worker_dead(w, "job finished")
+                    self._kill_worker_proc(w)
+            # queued lease requests from the dead job will never be
+            # collected either — fail them out of the queue
+            for item in self._pending_leases:
+                if item.get("job_id") == job_raw and not item["future"].done():
+                    item["future"].set_result({"status": "job_finished"})
+
+        self._io.spawn_threadsafe(reclaim())
 
     def _on_node_update(self, node_hex: str, msg: dict):
         nid = NodeID.from_hex(node_hex)
@@ -478,10 +510,20 @@ class Raylet:
     def _kill_worker_proc(self, w: WorkerHandle):
         if w.state != "DEAD":
             self.runtime_env_agent.release(w.env_key)
+            # killing a live worker MUST return its held resources: this
+            # pops the worker from the table, so the reap loop will never
+            # run _on_worker_dead for it — without this, every kill of a
+            # leased/actor worker (job reclaim, kill_worker RPC, OOM
+            # killer) permanently leaks its CPUs/chips
+            if w.lease_id is not None:
+                self._free_lease(w)
+            else:
+                self._free_worker_resources(w)
         w.state = "DEAD"
         self._workers.pop(w.worker_id, None)
         if w.alive():
             w.terminate()
+        self._try_grant_pending()
 
     # ------------------------------------------------------------ worker pool
     async def _start_worker(self, ctx=None) -> WorkerHandle:
@@ -636,7 +678,8 @@ class Raylet:
     async def h_request_worker_lease(self, lease_id: bytes, resources: dict,
                                      strategy=None, pg: Optional[tuple] = None,
                                      grant_only_local: bool = False,
-                                     runtime_env: Optional[dict] = None):
+                                     runtime_env: Optional[dict] = None,
+                                     job_id: Optional[bytes] = None):
         """Two-level scheduling (reference: node_manager.proto:413 +
         cluster_task_manager.h): grant locally, spill, or queue."""
         request = ResourceRequest.from_dict(resources) if isinstance(resources, dict) and "resources" in resources else ResourceRequest(resources)
@@ -645,7 +688,7 @@ class Raylet:
 
         if self._local_available(request, pg_key):
             granted = await self._grant_lease(lease_id, request, pg_key,
-                                              runtime_env)
+                                              runtime_env, job_id=job_id)
             if granted is not None:
                 return granted
         if pg_key is not None or grant_only_local:
@@ -653,7 +696,7 @@ class Raylet:
             fut = asyncio.get_running_loop().create_future()
             self._pending_leases.append(
                 {"lease_id": lease_id, "request": request, "pg": pg_key,
-                 "runtime_env": runtime_env, "future": fut}
+                 "runtime_env": runtime_env, "future": fut, "job_id": job_id}
             )
             return await fut
         # consider spilling to another node
@@ -673,7 +716,7 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._pending_leases.append(
             {"lease_id": lease_id, "request": request, "pg": None,
-             "runtime_env": runtime_env, "future": fut}
+             "runtime_env": runtime_env, "future": fut, "job_id": job_id}
         )
         return await fut
 
@@ -687,7 +730,8 @@ class Raylet:
             self.runtime_env_agent.get_or_create, runtime_env)
 
     async def _grant_lease(self, lease_id: bytes, request: ResourceRequest,
-                           pg_key, runtime_env=None) -> Optional[dict]:
+                           pg_key, runtime_env=None,
+                           job_id: Optional[bytes] = None) -> Optional[dict]:
         # Materialize the env only on the node that will actually grant —
         # a request that spills elsewhere must not stage files here.
         try:
@@ -709,6 +753,7 @@ class Raylet:
         w.request = request
         w.assignment = assignment
         w.pg = pg_key
+        w.job_id = job_id
         self._leases[lease_id] = w.worker_id
         # tell the worker its chip visibility before it runs anything
         tpu_chips = (assignment or {}).get(TPU)
@@ -754,6 +799,7 @@ class Raylet:
             return
         self._leases.pop(w.lease_id, None)
         w.lease_id = None
+        w.job_id = None
         self._free_worker_resources(w)
 
     async def h_return_worker(self, lease_id: bytes, disconnect: bool = False):
@@ -784,7 +830,7 @@ class Raylet:
                 if self._local_available(item["request"], item["pg"]):
                     granted = await self._grant_lease(
                         item["lease_id"], item["request"], item["pg"],
-                        item.get("runtime_env"))
+                        item.get("runtime_env"), job_id=item.get("job_id"))
                     if granted is not None:
                         item["future"].set_result(granted)
                         continue

@@ -125,7 +125,7 @@ RPC_SCHEMAS: Dict[str, Message] = {
         "request_worker_lease", req("lease_id", bytes),
         req("resources", dict), opt("strategy", bytes),
         opt("pg", (tuple, list)), opt("runtime_env", dict),
-        opt("grant_only_local", bool)),
+        opt("grant_only_local", bool), opt("job_id", bytes)),
     "return_worker": _m("return_worker", req("lease_id", bytes),
                         opt("disconnect", bool)),
     "register_worker": _m("register_worker", req("worker_id", bytes),
