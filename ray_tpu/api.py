@@ -419,7 +419,18 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
-    logger.warning("cancel() is best-effort: not yet propagated to executors")
+    """Cancel the task that produces ``ref`` (reference:
+    python/ray/_private/worker.py cancel → CoreWorker::CancelTask).
+
+    Best-effort: a queued task is removed and its refs resolve to
+    :class:`TaskCancelledError`; a running sync task gets the error raised
+    asynchronously in its thread (blocking C calls need ``force``); a
+    running ``async def`` actor call is asyncio-cancelled; a streaming
+    generator stops at its next yield. ``force=True`` kills the executing
+    worker process. Already-finished tasks are unaffected."""
+    if _client is not None:
+        return _client.cancel(ref, force=force)
+    return _core_worker().cancel_task(ref, force=force)
 
 
 def get_actor(name: str, namespace: str = "default"):

@@ -163,6 +163,9 @@ class ClientContext:
         self._session.call("kill_actor", actor_raw=handle._raw,
                            no_restart=no_restart)
 
+    def cancel(self, ref: ClientObjectRef, force: bool = False):
+        return self._session.call("cancel", raw_id=ref._raw, force=force)
+
     def get_actor(self, name: str, namespace: str = "default"):
         raw = self._session.call("get_named_actor", name=name,
                                  namespace=namespace)

@@ -71,7 +71,7 @@ class SessionDriver:
         self._last_heartbeat = time.monotonic()
         for name in ("put", "get", "wait", "submit", "submit_named",
                      "create_actor", "create_named_actor",
-                     "actor_call", "kill_actor", "get_named_actor",
+                     "actor_call", "kill_actor", "get_named_actor", "cancel",
                      "release", "cluster_resources", "available_resources",
                      "nodes", "heartbeat"):
             self.server.register(name, getattr(self, f"h_{name}"))
@@ -197,6 +197,13 @@ class SessionDriver:
             return [self._track(r) for r in refs]
 
         return await asyncio.to_thread(do)
+
+    async def h_cancel(self, raw_id: bytes, force: bool = False):
+        ref = self._refs.get(raw_id)
+        if ref is None:
+            return {"status": "not_found"}
+        return await asyncio.to_thread(
+            lambda: ray_tpu.cancel(ref, force=force))
 
     async def h_kill_actor(self, actor_raw: bytes, no_restart: bool):
         handle = self._actors.get(actor_raw)

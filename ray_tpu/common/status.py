@@ -29,6 +29,14 @@ class TaskError(RtError):
         super().__init__(f"task {task_id} failed: {cause!r}\n{traceback_str}")
 
 
+class TaskCancelledError(RtError):
+    """The task was cancelled via cancel(); raised at `get` on its refs
+    (reference: python/ray/exceptions.py TaskCancelledError)."""
+
+    def __init__(self, message: str = "the task was cancelled"):
+        super().__init__(message)
+
+
 class WorkerCrashedError(RtError):
     """The worker executing the task died unexpectedly."""
 

@@ -21,6 +21,7 @@ from ray_tpu.common.config import GLOBAL_CONFIG
 from ray_tpu.common.ids import ActorID, ObjectID
 from ray_tpu.common.status import (
     ActorDiedError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -50,6 +51,12 @@ class NormalTaskSubmitter:
         # set when work arrives for a shape: an idle lease holder waits on
         # it briefly instead of returning the worker (lease retention)
         self._work_events: Dict[tuple, asyncio.Event] = {}
+        # cancellation state (owner side): task_id -> executor address for
+        # pushed-and-unfinished tasks; cancelled ids suppress push retries
+        from ray_tpu.common.containers import BoundedSet
+
+        self._pushed: Dict[bytes, Tuple[str, int]] = {}
+        self._cancelled = BoundedSet()
 
     def submit(self, spec: TaskSpec):
         # Batched wakeup: a burst of submits from caller threads schedules
@@ -207,7 +214,13 @@ class NormalTaskSubmitter:
                         return  # stayed idle: give the worker back
                     continue
                 spec = queue.pop(0)
+                tid = spec.task_id.binary()
+                if tid in self._cancelled:
+                    self._store_error(spec, TaskCancelledError(
+                        "the task was cancelled before it started"))
+                    continue
                 logger.debug("pushing task %s to %s", spec.task_id.hex()[:8], worker_addr)
+                self._pushed[tid] = tuple(worker_addr)
                 try:
                     reply = await client.call_async(
                         "push_task", spec=pickle.dumps(spec), timeout=None,
@@ -215,12 +228,37 @@ class NormalTaskSubmitter:
                 except Exception as e:  # noqa: BLE001 - leased worker died
                     await self._handle_push_failure(spec, e)
                     return
+                finally:
+                    self._pushed.pop(tid, None)
                 logger.debug("task %s replied", spec.task_id.hex()[:8])
                 self._cw.store_task_reply(spec, reply, worker_addr)
         finally:
             client.close()
 
+    def cancel(self, task_id_bin: bytes):
+        """Owner side. Returns ("queued", None) if removed before running,
+        ("running", executor_addr) if pushed, (None, None) if unknown
+        (finished or never submitted here). Runs on the IO loop."""
+        self._cancelled.add(task_id_bin)
+        for q in self._queues.values():
+            for spec in q:
+                if spec.task_id.binary() == task_id_bin:
+                    q.remove(spec)
+                    self._store_error(spec, TaskCancelledError(
+                        "the task was cancelled before it started"))
+                    return ("queued", None)
+        addr = self._pushed.get(task_id_bin)
+        if addr is not None:
+            return ("running", addr)
+        return (None, None)
+
     async def _handle_push_failure(self, spec: TaskSpec, exc: Exception):
+        if spec.task_id.binary() in self._cancelled:
+            # force-cancel kills the executor mid-push: that is the cancel
+            # completing, not a crash to retry
+            self._store_error(spec, TaskCancelledError(
+                "the task was cancelled while running"))
+            return
         if spec.max_retries > 0:
             spec.max_retries -= 1
             logger.info("retrying task %s after push failure: %s",
@@ -267,6 +305,11 @@ class ActorTaskSubmitter:
         # set by pubsub actor-state events: resolution wakes immediately on
         # ALIVE instead of sleeping a fixed poll interval
         self._state_event = asyncio.Event()
+        from ray_tpu.common.containers import BoundedSet
+
+        # cancelled call ids: never resent after an actor restart, and
+        # their failures surface as TaskCancelledError (not ActorDied)
+        self._cancelled = BoundedSet()
 
     def next_seq(self) -> int:
         # Called from arbitrary caller threads (e.g. a server fanning out
@@ -353,6 +396,17 @@ class ActorTaskSubmitter:
                 pending = sorted(self._inflight.values(),
                                  key=lambda s: s.sequence_number) + self._queue
                 self._inflight.clear()
+                # a cancelled call must not ride the resend protocol into
+                # the new incarnation (force-cancel kills the worker; the
+                # restart would otherwise re-execute the cancelled call)
+                still = []
+                for spec in pending:
+                    if spec.task_id.binary() in self._cancelled:
+                        self._fail_spec(spec, TaskCancelledError(
+                            "the actor call was cancelled"))
+                    else:
+                        still.append(spec)
+                pending = still
                 if pending and prev_addr is not None and self._address != prev_addr:
                     self._seq = 0
                     for spec in pending:
@@ -433,12 +487,31 @@ class ActorTaskSubmitter:
         self._queue.clear()
 
     def _fail_spec(self, spec: TaskSpec, error: Exception):
+        if spec.task_id.binary() in self._cancelled and not isinstance(
+                error, TaskCancelledError):
+            # e.g. force-cancel killed the actor worker: the death IS the
+            # cancel completing
+            error = TaskCancelledError("the actor call was cancelled")
         blob = pickle.dumps(error)
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error=blob)
         if spec.streaming:
             self._cw.generator_task_failed(spec.task_id, blob)
         self._cw.ack_args_handoffs(spec)
+
+    def cancel(self, task_id_bin: bytes):
+        """Owner side (same contract as NormalTaskSubmitter.cancel)."""
+        self._cancelled.add(task_id_bin)
+        for spec in self._queue:
+            if spec.task_id.binary() == task_id_bin:
+                self._queue.remove(spec)
+                self._fail_spec(spec, TaskCancelledError(
+                    "the actor call was cancelled before it started"))
+                return ("queued", None)
+        for spec in self._inflight.values():
+            if spec.task_id.binary() == task_id_bin:
+                return ("running", self._address)
+        return (None, None)
 
     def notify_actor_state(self, view: dict):
         """Pubsub-driven: DEAD → fail; ALIVE after restart → reconnect."""

@@ -44,6 +44,7 @@ from ray_tpu.common.status import (
     ObjectLostError,
     RtError,
     RtTimeoutError,
+    TaskCancelledError,
     TaskError,
 )
 from ray_tpu.common.task_spec import (
@@ -119,6 +120,7 @@ class CoreWorker:
             "actor_method_metadata", "object_info", "get_object_chunk",
             "incref_inflight", "borrow_ack", "borrow_release", "drop_copy",
             "handoff_done", "device_object_get", "report_generator_item",
+            "cancel_task", "cancel_running_task",
         ):
             self.server.register(name, getattr(self, f"h_{name}"))
         self.server.start()
@@ -140,6 +142,16 @@ class CoreWorker:
         self._actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
         self._actor_sub_lock = threading.Lock()
         self._actor_events_subscribed = False
+        # cancellation: executor side tracks what is running (thread ident
+        # for pool tasks, concurrent future for async actor calls) so a
+        # cancel_running_task RPC can interrupt it; owner side remembers
+        # cancelled task ids so retries/reconstruction never revive them.
+        # Bounded: day-scale drivers must not grow these forever.
+        from ray_tpu.common.containers import BoundedSet
+
+        self._running_tasks: Dict[bytes, dict] = {}
+        self._cancel_requested = BoundedSet()
+        self._cancelled_tasks = BoundedSet()
 
         if mode == MODE_DRIVER:
             self.job_id = job_id or JobID(self.gcs.call("get_next_job_id"))
@@ -758,6 +770,111 @@ class CoreWorker:
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs.kill_actor(actor_id, no_restart)
 
+    # ------------------------------------------------------------ cancel
+    def cancel_task(self, ref, force: bool = False) -> dict:
+        """cancel(ref): route to the ref's OWNER, who holds the submission
+        state (reference: CoreWorker::CancelTask / HandleCancelTask).
+        Self-owned refs take the same RPC loopback — owner-side state lives
+        on the IO loop and callers are arbitrary user threads. Accepts an
+        ObjectRef or an ObjectRefGenerator (streaming task)."""
+        from .generator import ObjectRefGenerator
+
+        if isinstance(ref, ObjectRefGenerator):
+            owner = self.server.address  # streams are owner-local
+            payload = {"task_id": ref.task_id.binary()}
+        else:
+            owner = tuple(ref.owner_address or self.server.address)
+            payload = {"object_id": ref.object_id.binary()}
+        client = RetryableRpcClient(owner, deadline_s=30.0)
+        try:
+            return client.call("cancel_task", force=force, **payload)
+        finally:
+            client.close()
+
+    async def h_cancel_task(self, object_id: bytes = None,
+                            force: bool = False, task_id: bytes = None):
+        """Owner side of cancel: remove a queued task (store
+        TaskCancelledError on its returns), or forward the interrupt to the
+        executor currently running it. force=True kills the executing
+        worker process; the push failure then resolves to
+        TaskCancelledError via the cancelled-id set."""
+        if task_id is not None:
+            tid_bin = task_id
+        else:
+            oid = ObjectID(object_id)
+            tid_bin = oid.task_id().binary()
+            if self.memory_store.get_if_ready(oid) is not None:
+                # finished tasks are unaffected — in particular their
+                # lineage stays reconstructible
+                return {"status": "already_done"}
+        self._cancelled_tasks.add(tid_bin)
+        # cancelled tasks must never be revived by lineage reconstruction
+        with self._lineage_lock:
+            for l_oid in [o for o in self.lineage
+                          if o.task_id().binary() == tid_bin]:
+                self.lineage.pop(l_oid, None)
+        state, addr = self.submitter.cancel(tid_bin)
+        if state is None:
+            with self._actor_sub_lock:
+                subs = list(self._actor_submitters.values())
+            for sub in subs:
+                state, addr = sub.cancel(tid_bin)
+                if state is not None:
+                    break
+        if state == "running" and addr is not None:
+            try:
+                c = RetryableRpcClient(tuple(addr), deadline_s=10.0)
+                try:
+                    await c.call_async("cancel_running_task",
+                                       task_id=tid_bin, force=force)
+                finally:
+                    c.close()
+            except Exception:  # noqa: BLE001 — worker may already be gone
+                pass
+        # streaming: unblock readers immediately (the producer also stops
+        # at its next report — the owner replies cancel to a failed stream)
+        st = self._generators.get(TaskID(tid_bin))
+        if st is not None and not st.done_or_failed():
+            st.fail(pickle.dumps(TaskCancelledError(
+                "the streaming task was cancelled")))
+        return {"status": state or "not_found"}
+
+    async def h_cancel_running_task(self, task_id: bytes,
+                                    force: bool = False):
+        """Executor side of cancel. Sync tasks get TaskCancelledError
+        raised asynchronously in their executor thread (lands at the next
+        bytecode boundary — blocking C calls are only interruptible via
+        force). Async actor calls get their asyncio task cancelled.
+        force=True exits the worker process; the owner converts the
+        resulting push failure into TaskCancelledError."""
+        rec = self._running_tasks.get(task_id)
+        if rec is None:
+            # push may be in flight: reject the task when it arrives
+            self._cancel_requested.add(task_id)
+            return {"status": "not_running"}
+        if force:
+            self._io.loop.call_later(0.05, os._exit, 1)
+            return {"status": "killed"}
+        fut = rec.get("future")
+        if fut is not None:
+            fut.cancel()
+        thread_ident = rec.get("thread")
+        if thread_ident is not None:
+            import ctypes
+
+            # TOCTOU guard: if the task finished between lookup and here,
+            # the thread may already be running something else — re-check
+            # the registry right before delivery. A residual race remains
+            # (inherent to async exceptions; the reference's SIGINT path
+            # has the same window) but this shrinks it to nanoseconds.
+            cur = self._running_tasks.get(task_id)
+            if cur is None or cur.get("thread") != thread_ident:
+                return {"status": "not_running"}
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(thread_ident),
+                ctypes.py_object(TaskCancelledError))
+        return {"status": "cancelled"}
+
     # -------------------------------------------------------- reply handling
     def store_task_reply(self, spec: TaskSpec, reply: dict, executor_addr):
         """Owner side: record results (values inline, or locations for large)."""
@@ -790,6 +907,8 @@ class CoreWorker:
 
     # ----------------------------------------------------------- lineage/GC
     def _try_reconstruct(self, object_id: ObjectID) -> bool:
+        if object_id.task_id().binary() in self._cancelled_tasks:
+            return False  # a cancelled task is never re-executed
         with self._lineage_lock:
             spec = self.lineage.get(object_id)
             now = time.monotonic()
@@ -1311,9 +1430,12 @@ class CoreWorker:
         # process IS the materialized env, so this is just spec plumbing.
         if task.runtime_env is not None:
             self.job_runtime_env = task.runtime_env
-        if task.job_id is not None:
-            # log-relay attribution: this worker now works for that job
+        if task.job_id is not None and not task.job_id.is_nil():
+            # log-relay attribution: this worker now works for that job —
+            # and child tasks submitted from inside the task must carry it
+            # (their leases are reclaimed when the job finishes)
             self.current_job_hex = task.job_id.hex()
+            self.job_id = task.job_id
         loop = asyncio.get_running_loop()
         if task.is_actor_task() and self._is_async_actor_call(task):
             # Async actor fast path: never parks a pool thread across the
@@ -1346,6 +1468,13 @@ class CoreWorker:
                                  method=task.actor_method_name)
         if cached is not None:
             return cached
+        tid_bin = task.task_id.binary()
+        if tid_bin in self._cancel_requested:
+            # cancel raced ahead of the push: never execute
+            self._cancel_requested.discard(tid_bin)
+            reply = self._error_reply(task, TaskCancelledError())
+            self._seq_finish(caller, seq, reply)
+            return reply
         sem = self._async_call_sem
         if sem is None:
             sem = self._async_call_sem = asyncio.Semaphore(
@@ -1373,15 +1502,23 @@ class CoreWorker:
                                             self.worker_id.hex()[:8]}):
                         return await method(*args, **kwargs)
 
-                result = await asyncio.wrap_future(
-                    asyncio.run_coroutine_threadsafe(
-                        run_with_ctx(), self._actor_async_loop()))
+                cf = asyncio.run_coroutine_threadsafe(
+                    run_with_ctx(), self._actor_async_loop())
+                self._running_tasks[task.task_id.binary()] = {"future": cf}
+                try:
+                    result = await asyncio.wrap_future(cf)
+                finally:
+                    self._running_tasks.pop(task.task_id.binary(), None)
                 tt = getattr(method, "__rt_method_opts__",
                              {}).get("tensor_transport")
                 reply = await loop.run_in_executor(
                     self._executor,
                     lambda: self._result_reply(task, result,
                                                tensor_transport=tt))
+            except asyncio.CancelledError:
+                # cancel_running_task cancelled the user coroutine
+                reply = self._error_reply(task, TaskCancelledError(
+                    "the actor call was cancelled while running"))
             except Exception as e:  # noqa: BLE001 - user method error
                 reply = self._error_reply(task, e)
         self._seq_finish(caller, seq, reply)
@@ -1391,8 +1528,9 @@ class CoreWorker:
         task: TaskSpec = pickle.loads(creation_spec)
         if task.runtime_env is not None:
             self.job_runtime_env = task.runtime_env  # children inherit
-        if task.job_id is not None:
+        if task.job_id is not None and not task.job_id.is_nil():
             self.current_job_hex = task.job_id.hex()
+            self.job_id = task.job_id  # children carry the job (see h_push_task)
         loop = asyncio.get_running_loop()
 
         def create():
@@ -1432,16 +1570,27 @@ class CoreWorker:
         from ray_tpu.util import tracing as _tracing
 
         start = time.time()
+        tid = task.task_id.binary()
+        if tid in self._cancel_requested:
+            # cancelled while the push was in flight: never execute
+            self._cancel_requested.discard(tid)
+            reply = self._error_reply(task, TaskCancelledError())
+            self._record_task_event(task, start, time.time(), reply)
+            return reply
+        self._running_tasks[tid] = {"thread": threading.get_ident()}
         ctx = getattr(task, "tracing", None)
-        with _tracing.span(
-                f"task::{task.actor_method_name or task.name or 'task'}",
-                parent_context=ctx,
-                attributes={"task_id": task.task_id.hex()[:16],
-                            "worker_id": self.worker_id.hex()[:8]}):
-            if task.is_actor_task():
-                reply = self._execute_actor_task(task)
-            else:
-                reply = self._execute_fn_task(task)
+        try:
+            with _tracing.span(
+                    f"task::{task.actor_method_name or task.name or 'task'}",
+                    parent_context=ctx,
+                    attributes={"task_id": task.task_id.hex()[:16],
+                                "worker_id": self.worker_id.hex()[:8]}):
+                if task.is_actor_task():
+                    reply = self._execute_actor_task(task)
+                else:
+                    reply = self._execute_fn_task(task)
+        finally:
+            self._running_tasks.pop(tid, None)
         self._record_task_event(task, start, time.time(), reply)
         return reply
 
@@ -1713,6 +1862,8 @@ class CoreWorker:
         """Owner side: store one streamed item (or finish/fail the stream)
         and apply consumer backpressure by delaying the reply."""
         tid = TaskID(task_id)
+        if task_id in self._cancelled_tasks:
+            return {"cancel": True}  # cancelled stream: stop producing
         st = self._generators.get(tid)
         if st is None:
             # Stream consumed+dropped, but a lineage reconstruct may be

@@ -96,6 +96,10 @@ _num = (int, float)
 RPC_SCHEMAS: Dict[str, Message] = {
     # ---- worker service (reference core_worker.proto) ----
     "push_task": _m("push_task", req("spec", bytes)),
+    "cancel_task": _m("cancel_task", opt("object_id", bytes),
+                      opt("task_id", bytes), opt("force", bool)),
+    "cancel_running_task": _m("cancel_running_task", req("task_id", bytes),
+                              opt("force", bool)),
     "create_actor": _m("create_actor", req("creation_spec", bytes),
                        req("node_id", bytes)),
     "get_object": _m("get_object", req("object_id", bytes),

@@ -1,0 +1,151 @@
+"""Task cancellation (reference: python/ray/tests/test_cancel.py;
+core path CoreWorker::HandleCancelTask): queued tasks, running sync tasks,
+async actor calls, streaming generators, and force-kill."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.common.status import TaskCancelledError
+
+
+@pytest.fixture
+def cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=1, resources={"TPU": 0})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_task(cluster):
+    """A task still waiting for resources is removed before it runs."""
+    @ray_tpu.remote
+    def hold():
+        time.sleep(5)
+        return "held"
+
+    @ray_tpu.remote
+    def never():
+        return "ran"
+
+    holder = hold.remote()          # occupies the only CPU
+    time.sleep(0.5)
+    queued = never.remote()
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    # the running task is unaffected
+    assert ray_tpu.get(holder, timeout=30) == "held"
+
+
+def test_cancel_running_sync_task(cluster):
+    """A running sync task gets TaskCancelledError raised in its thread."""
+    @ray_tpu.remote(max_retries=0)
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            time.sleep(0.01)   # frequent bytecode boundaries
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start
+    ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.time() - t0 < 20, "cancel did not interrupt the task"
+
+    # the worker survives a non-force cancel and runs new work
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get(ok.remote(), timeout=30) == 42
+
+
+def test_force_cancel_blocking_task(cluster):
+    """A task stuck in an uninterruptible C call needs force=True, which
+    kills the worker; the ref still resolves to TaskCancelledError (not a
+    crash/retry)."""
+    @ray_tpu.remote(max_retries=3)   # retries must NOT revive it
+    def block():
+        time.sleep(300)
+
+    ref = block.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_async_actor_call(cluster):
+    """A running ``async def`` actor method is asyncio-cancelled; the
+    actor itself stays alive."""
+    import asyncio
+
+    class A:
+        async def hang(self):
+            await asyncio.sleep(300)
+            return "done"
+
+        async def quick(self):
+            return "alive"
+
+    a = ray_tpu.remote(A).options(max_concurrency=4).remote()
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "alive"
+    ref = a.hang.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "alive"
+
+
+def test_cancel_queued_actor_call(cluster):
+    """Actor calls queued behind a long-running call are cancellable."""
+    class B:
+        def slow(self):
+            time.sleep(4)
+            return "slow-done"
+
+        def fast(self):
+            return "fast-done"
+
+    b = ray_tpu.remote(B).remote()
+    slow_ref = b.slow.remote()
+    time.sleep(0.5)
+    queued_ref = b.fast.remote()   # waits behind slow() (max_concurrency=1)
+    ray_tpu.cancel(queued_ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued_ref, timeout=30)
+    assert ray_tpu.get(slow_ref, timeout=30) == "slow-done"
+
+
+def test_cancel_streaming_generator(cluster):
+    """A streaming generator stops producing after cancel; pending reads
+    fail with TaskCancelledError."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(1000):
+            time.sleep(0.05)
+            yield i
+
+    it = gen.remote()
+    first = ray_tpu.get(next(it), timeout=30)
+    assert first == 0
+    ray_tpu.cancel(it)
+    with pytest.raises(TaskCancelledError):
+        for _ in range(1000):
+            ray_tpu.get(next(it), timeout=10)
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert ray_tpu.get(ref, timeout=30) == 7
+    ray_tpu.cancel(ref)            # no-op
+    assert ray_tpu.get(ref, timeout=30) == 7
