@@ -163,8 +163,10 @@ def main():
         os.environ["JAX_PLATFORMS"] = "cpu"
     # A 1B-param model fits one v5e chip with Adam state; fall back to
     # smaller shapes on memory pressure.
-    attempts = [("1b_bench", 8, 2048), ("1b_bench", 4, 2048),
-                ("1b_bench", 2, 2048), ("tiny", 8, 1024), ("debug", 4, 128)]
+    # batch 16 measured 48.33% MFU vs 47.83% at batch 8 (r4 sweep); both
+    # beat the 40% target — the ladder is an OOM fallback, not a search.
+    attempts = [("1b_bench", 16, 2048), ("1b_bench", 8, 2048),
+                ("1b_bench", 4, 2048), ("tiny", 8, 1024), ("debug", 4, 128)]
     from ray_tpu.models import llama
     # attn_block=1024 measured best on v5e (scripts/mfu_sweep.py: 48.0% MFU
     # at batch 8 vs 43.8% at the 512 default).

@@ -217,9 +217,10 @@ def main():
 
     timeit("put_gigabytes_per_s", put_large, gb, trials=2, trial_s=1.5,
            unit="GB/s")
-    big = last["ref"]
-    timeit("get_gigabytes_per_s", lambda: ray_tpu.get(big), gb,
-           trials=2, trial_s=1.5, unit="GB/s")
+    big = last.get("ref")  # unset when a name filter skipped the put row
+    if big is not None:
+        timeit("get_gigabytes_per_s", lambda: ray_tpu.get(big), gb,
+               trials=2, trial_s=1.5, unit="GB/s")
     del big, last
 
     # Actor creation rate (reference many_actors.json: trivial actors).

@@ -39,7 +39,7 @@ class BaseID:
     """Immutable fixed-width binary ID."""
 
     SIZE = 0
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -47,6 +47,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
             )
         self._bytes = bytes(binary)
+        self._hash = None
 
     @classmethod
     def nil(cls):
@@ -73,7 +74,12 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._bytes))
+        # IDs key every hot-path dict (refcounts, pending calls, dedup);
+        # an actor call hashes IDs ~18 times end-to-end, so cache it.
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._bytes.hex()})"

@@ -8,8 +8,12 @@ as::
 
     "RTB5" | u32 n_buffers | u64 meta_len |
     n x (u64 offset | u64 length)          # absolute, 64-byte aligned
-    meta (cloudpickle, protocol 5)
+    meta (pickle protocol 5; see _dumps_meta)
     padding + buffer bytes ...
+
+The meta segment is written by the C pickler when the value passes the
+exact-type whitelist in :func:`_plain_safe` (both picklers agree on
+those types), by cloudpickle otherwise; ``loads`` is pickler-agnostic.
 
 ``loads`` reconstructs with buffers ALIASING the input: from a bytes
 blob the arrays share the blob's memory; from a shared-memory view the
@@ -32,6 +36,84 @@ _ALIGN = 64  # numpy-friendly buffer alignment
 _HEADER = struct.Struct("<4sIQ")
 _SEG = struct.Struct("<QQ")
 
+# ---- dump fast path ---------------------------------------------------
+# cloudpickle's Python-level Pickler costs ~100µs+ per call even for an
+# int; the C pickler is ~1µs but serializes __main__-defined objects
+# by REFERENCE (broken in a different process) where cloudpickle goes
+# by value. Gate the C path behind an exact-type whitelist that cannot
+# contain user classes, so the two picklers agree on everything it lets
+# through. (Reference analog: python/ray/_private/serialization.py
+# always pays the cloudpickle cost; this is a deliberate improvement.)
+_SAFE_SCALARS = frozenset(
+    {int, float, bool, complex, bytes, bytearray, str, type(None)})
+_SAFE_CONTAINERS = frozenset({list, tuple, dict, set, frozenset})
+
+
+# Framework-owned wrapper types (importable in every worker, so pickle's
+# by-reference class encoding is correct) opt in here with a predicate
+# over their contents: type -> callable(v) -> bool.
+_SAFE_WRAPPERS: dict = {}
+
+
+def register_plain_safe(t, pred) -> None:
+    _SAFE_WRAPPERS[t] = pred
+
+
+def _plain_safe(v, depth: int = 4, budget: list = None) -> bool:
+    # budget bounds TOTAL nodes visited: aliased containers ([x]*256 three
+    # levels deep) would otherwise be re-walked multiplicatively where
+    # cloudpickle's memo table sees each object once.
+    if budget is None:
+        budget = [512]
+    budget[0] -= 1
+    if budget[0] < 0:
+        return False
+    t = type(v)
+    if t in _SAFE_SCALARS:
+        return True
+    w = _SAFE_WRAPPERS.get(t)
+    if w is not None:
+        return w(v, budget)
+    if t is _np_ndarray:
+        return v.dtype.hasobject is False
+    if isinstance(v, _np_generic):
+        # structured np.void scalars can carry object fields
+        return v.dtype.hasobject is False
+    if t in _SAFE_CONTAINERS:
+        if depth <= 0 or len(v) > 256:
+            return False
+        if t is dict:
+            return all(_plain_safe(k, depth - 1, budget)
+                       and _plain_safe(x, depth - 1, budget)
+                       for k, x in v.items())
+        return all(_plain_safe(x, depth - 1, budget) for x in v)
+    return False
+
+
+try:
+    import numpy as _np
+
+    _np_ndarray = _np.ndarray
+    _np_generic = _np.generic
+except Exception:  # pragma: no cover - numpy is in the base image
+    _np_ndarray = _np_generic = ()
+
+# the actor-call fast path wraps (args, kwargs) in one _FastArgs — the
+# single hottest serialized value in the runtime
+from ray_tpu.common.task_spec import _FastArgs as _FA
+
+register_plain_safe(
+    _FA, lambda v, budget: (_plain_safe(v.args, budget=budget)
+                            and _plain_safe(v.kwargs, budget=budget)))
+
+
+def _dumps_meta(value, buffer_callback):
+    if _plain_safe(value):
+        return pickle.dumps(value, protocol=5,
+                            buffer_callback=buffer_callback)
+    return cloudpickle.dumps(value, protocol=5,
+                             buffer_callback=buffer_callback)
+
 
 def plan(value: Any):
     """Layout pass WITHOUT copying buffer bytes: returns
@@ -41,8 +123,7 @@ def plan(value: Any):
     :func:`pack_into` for a single-copy write; ``dumps`` packs into a
     fresh bytearray. Call ``release_buffers`` when done."""
     buffers: List[pickle.PickleBuffer] = []
-    meta = cloudpickle.dumps(value, protocol=5,
-                             buffer_callback=buffers.append)
+    meta = _dumps_meta(value, buffers.append)
     if not buffers:
         return meta, [], [], [], len(meta)
     views = [b.raw() for b in buffers]

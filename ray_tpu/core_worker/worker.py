@@ -57,6 +57,9 @@ from ray_tpu.common.task_spec import (
 )
 from ray_tpu.gcs.client import GcsClient
 from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcServer
+from ray_tpu.common.resources import ResourceRequest
+from ray_tpu.util import tracing as _tracing
+from . import serialization as _serialization
 from .memory_store import MemoryStore
 from .reference import ObjectRef, install_borrow_sinks, install_release_sink
 from .submitter import ActorTaskSubmitter, NormalTaskSubmitter
@@ -306,15 +309,11 @@ class CoreWorker:
     def serialize(value: Any) -> bytes:
         # out-of-band pickle-5 framing for buffer-bearing values
         # (numpy etc.) — reads alias the blob / shm pages, zero-copy
-        from . import serialization
-
-        return serialization.dumps(value)
+        return _serialization.dumps(value)
 
     @staticmethod
     def deserialize(blob) -> Any:
-        from . import serialization
-
-        return serialization.loads(blob)
+        return _serialization.loads(blob)
 
     # ----------------------------------------------------------------- put/get
     def put(self, value: Any, tensor_transport: Optional[str] = None) -> ObjectRef:
@@ -335,7 +334,7 @@ class CoreWorker:
         one memcpy total instead of three (staging bytearray zero-fill +
         frame copy + shm copy) — on ~1 GB/s-memcpy hosts that is the
         difference between ~0.3 and ~1 GB/s put bandwidth."""
-        from . import serialization as _ser
+        _ser = _serialization
 
         shm = self.shm
         threshold = GLOBAL_CONFIG.get("shm_direct_put_threshold")
@@ -623,8 +622,6 @@ class CoreWorker:
         return self._register_and_submit(spec)
 
     def _register_and_submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        from ray_tpu.util import tracing as _tracing
-
         if _tracing.enabled():
             ctx = _tracing.current_context()
             if ctx is not None:
@@ -714,8 +711,6 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           *, num_returns: int = 1, name: str = "",
                           streaming: bool = False):
-        from ray_tpu.common.resources import ResourceRequest
-
         sub = self._actor_submitter(actor_id)
         seq = sub.next_seq()
         task_id = TaskID.for_actor_task(actor_id, self.current_task_id(), self.next_task_index())

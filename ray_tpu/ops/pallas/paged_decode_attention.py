@@ -1,0 +1,154 @@
+"""Paged flash-decoding attention (Pallas TPU): one new query token per
+slot against that slot's KV cache stored in non-contiguous fixed-size
+blocks (a vLLM-style paged KV pool, TPU-native).
+
+Capability bar: vLLM's paged attention, which the reference delegates to
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``).
+The TPU shape of the idea: the pool is one static (num_blocks, bs, KV, D)
+array; each slot's logical cache is the sequence of pool blocks named by
+its block-table row. Block tables ride as SCALAR-PREFETCH operands, so
+the kernel's BlockSpec index maps translate (slot, logical block) →
+physical pool block at grid-issue time — the gather never materializes a
+contiguous per-slot cache in HBM.
+
+Layout contract:
+    q        (B, 1, H, D)    new-token queries
+    k_pool   (NB, bs, KV, D) paged key pool (one layer)
+    v_pool   (NB, bs, KV, D)
+    tables   (B, MBS) int32  physical block id per logical block; entries
+                             past the valid prefix MUST name a real block
+                             (conventionally the reserved null block 0) —
+                             they are masked out, but are still prefetched
+    lengths  (B,) int32      valid tokens per slot (incl. the new token)
+
+Online-softmax recurrence identical to ``decode_attention.py``; GQA by
+loading one kv head's whole query group as the left matmul operand.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref,
+                  *, scale: float, block_s: int, num_blocks: int,
+                  num_kv: int):
+    ib = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    b = pl.program_id(0) // num_kv
+    length = len_ref[b]
+
+    @pl.when(ib * block_s < length)
+    def _compute():
+        q = q_ref[0]                       # (group, D)
+        k = k_ref[0, :, 0, :]              # (bs, D)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (group, bs)
+        col = ib * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ib == num_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths, *,
+                           scale: float, interpret: bool = False):
+    """q (B,1,H,D); k/v_pool (NB,bs,KV,D); tables (B,MBS) int32;
+    lengths (B,) int32. Returns (B, 1, H, D) in q.dtype."""
+    B, _, H, D = q.shape
+    bs, KV = k_pool.shape[1], k_pool.shape[2]
+    MBS = tables.shape[1]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    group = H // KV
+
+    qg = q.reshape(B, KV, group, D).reshape(B * KV, group, D)
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, block_s=bs, num_blocks=MBS, num_kv=KV)
+
+    def kv_ix(bk, ib, tables_ref, len_ref):
+        del len_ref
+        return (tables_ref[bk // KV, ib], 0, bk % KV, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * KV, MBS),
+        in_specs=[
+            pl.BlockSpec((1, group, D),
+                         lambda bk, ib, *_: (bk, 0, 0)),
+            pl.BlockSpec((1, bs, 1, D), kv_ix),
+            pl.BlockSpec((1, bs, 1, D), kv_ix),
+        ],
+        out_specs=pl.BlockSpec((1, group, D), lambda bk, ib, *_: (bk, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, D), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KV, group, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pool, v_pool)
+
+    return out.reshape(B, KV, group, D).reshape(B, 1, H, D)
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, lengths, *,
+                              scale: float):
+    """XLA path (and the kernel's correctness oracle): gather the per-slot
+    cache via the block table, then grouped-einsum attention. Used on CPU
+    and as the non-Pallas fallback in ``models.paged_cache``."""
+    B, _, H, D = q.shape
+    bs, KV = k_pool.shape[1], k_pool.shape[2]
+    MBS = tables.shape[1]
+    group = H // KV
+    S = MBS * bs
+    k = k_pool[tables].reshape(B, S, KV, D)      # (B, MBS, bs, KV, D) →
+    v = v_pool[tables].reshape(B, S, KV, D)
+    qg = q.astype(jnp.float32).reshape(B, KV, group, D)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg, k.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, v.astype(jnp.float32))
+    return out.reshape(B, 1, H, D).astype(q.dtype)

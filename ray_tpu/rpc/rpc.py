@@ -36,6 +36,10 @@ _HEADER = struct.Struct("<IB")  # payload length, frame type
 _FRAME_REQ = 1
 _FRAME_RESP = 2
 
+# schema.validate, bound on first validated dispatch (schema imports parts
+# of common/ that import this module — a boot-time cycle, not a real dep)
+_validate = None
+
 Address = Tuple[str, int]
 
 
@@ -163,8 +167,6 @@ def _schema_validation_enabled() -> bool:
     system_config propagation must be able to flip the knob at runtime
     (a process-global cache here would pin the boot-time value)."""
     try:
-        from ray_tpu.common.config import GLOBAL_CONFIG
-
         return bool(GLOBAL_CONFIG.get("rpc_schema_validation"))
     except Exception:  # noqa: BLE001
         return True
@@ -241,8 +243,9 @@ class RpcServer:
         else:
             try:
                 if self.validate_schemas and _schema_validation_enabled():
-                    from ray_tpu.rpc.schema import validate as _validate
-
+                    global _validate
+                    if _validate is None:
+                        from ray_tpu.rpc.schema import validate as _validate
                     kwargs = _validate(method, kwargs)
                 result = await handler(**kwargs)
                 reply = {"id": req_id, "result": result}

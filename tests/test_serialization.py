@@ -105,3 +105,71 @@ class TestShmPinnedRead:
             assert float(out[12345]) == pytest.approx(24690.0)
         finally:
             ray_tpu.shutdown()
+
+
+class TestDumpFastPath:
+    """The C-pickler fast path (_plain_safe whitelist) must agree with
+    cloudpickle on everything it admits, and refuse anything the C
+    pickler would encode by unresolvable reference."""
+
+    def test_plain_values_roundtrip(self):
+        from ray_tpu.core_worker import serialization as ser
+
+        for v in (0, 1.5, True, None, b"x", "s", [1, [2.0, "a"]],
+                  (1, 2), {"k": [1, 2]}, {1, 2}, np.arange(5),
+                  np.float32(3.0)):
+            assert ser._plain_safe(v), v
+            assert_roundtrip = ser.loads(ser.dumps(v))
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(assert_roundtrip, v)
+            else:
+                assert assert_roundtrip == v
+
+    def test_main_defined_class_takes_cloudpickle(self):
+        """Types outside the whitelist (user classes) must NOT take the
+        C-pickler path: pickle would encode __main__ classes by
+        reference, which a worker can't import."""
+        from ray_tpu.core_worker import serialization as ser
+
+        class Local:  # stand-in for a __main__-defined class
+            pass
+
+        assert not ser._plain_safe(Local())
+        assert not ser._plain_safe([Local()])
+        assert not ser._plain_safe({"k": Local()})
+
+    def test_object_dtype_rejected(self):
+        from ray_tpu.core_worker import serialization as ser
+
+        assert not ser._plain_safe(np.array([object()]))
+        void = np.zeros(1, dtype=[("f", "O")])[0]
+        assert not ser._plain_safe(void)
+
+    def test_aliased_containers_bounded(self):
+        """Shared references must not be re-walked multiplicatively."""
+        import time
+
+        from ray_tpu.core_worker import serialization as ser
+
+        x = [0] * 256
+        y = [x] * 256
+        z = [y] * 256
+        t0 = time.perf_counter()
+        ser._plain_safe(z)  # budget falls back to cloudpickle quickly
+        assert time.perf_counter() - t0 < 0.1
+        ser.loads(ser.dumps(z))  # and it still serializes correctly
+
+    def test_fast_args_wrapper(self):
+        from ray_tpu.common.task_spec import _FastArgs
+        from ray_tpu.core_worker import serialization as ser
+
+        fa = _FastArgs((1, "a", np.arange(3)), {"k": 2.0})
+        assert ser._plain_safe(fa)
+        out = ser.loads(ser.dumps(fa))
+        assert out.args[1] == "a"
+        np.testing.assert_array_equal(out.args[2], np.arange(3))
+
+        class Local:
+            pass
+
+        assert not ser._plain_safe(_FastArgs((Local(),), {}))
