@@ -20,12 +20,15 @@ import time
 
 
 def run_engine_bench(model: str, num_slots: int, n_requests: int,
-                     prompt_len: int, max_tokens: int) -> dict:
+                     prompt_len: int, max_tokens: int,
+                     max_seq: int = 2048) -> dict:
     import numpy as np
 
     from ray_tpu.serve.llm import LLMEngine
 
-    engine = LLMEngine(model=model, num_slots=num_slots)
+    # bound max_seq: the 1b config's native 8192 would size the KV pool
+    # (and the old slot cache alike) past one v5e's HBM at 8 slots
+    engine = LLMEngine(model=model, num_slots=num_slots, max_seq=max_seq)
     rng = np.random.default_rng(0)
     vocab = engine.config.vocab_size
 
@@ -91,6 +94,8 @@ def run_engine_bench(model: str, num_slots: int, n_requests: int,
         "slot_occupancy_mean": round(float(np.mean(occupancy_samples)), 3)
         if occupancy_samples else None,
         "engine_steps": stats["steps"],
+        "kv_cache": stats.get("kv_cache"),
+        "kv_preemptions": stats.get("preemptions"),
     }
 
 

@@ -1,0 +1,330 @@
+"""Paged KV cache: block-table paging for serving (vLLM-class memory
+efficiency, TPU-native shapes).
+
+Replaces the slot model's per-slot ``max_seq`` reservation
+(:mod:`ray_tpu.models.decoding` keeps a (layers, slots, max_seq, KV, D)
+ring) with a shared pool of fixed-size token blocks:
+
+    pool      (layers, num_blocks, block_size, KV, D)
+    tables    (slots, max_blocks_per_seq) int32   — host-owned
+    lengths   (slots,) int32                       — device-resident
+
+HBM held per request is proportional to tokens actually cached, not to
+``max_seq``; a prompt never needs a contiguous region (blocks are
+scattered), so fragmentation cannot reject an admissible request.
+
+Division of labor (TPU-first): every step is jitted with static shapes —
+the pool and tables never change shape. The BLOCK ALLOCATOR is pure
+host-side Python (free-list over block ids); tables are tiny int32
+arrays shipped per call. Block 0 is reserved as the null block: table
+entries past a slot's valid prefix point at it, and writes for inactive
+slots land in it, so no predication is needed on device.
+
+Reference parity: the reference's serving engine gets this from vLLM
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py``);
+here it is in-framework. The decode attention rides
+:mod:`ray_tpu.ops.pallas.paged_decode_attention` on TPU and its gather
+oracle elsewhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.llama import LlamaConfig, Params
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+PagedCache = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    """Static pool geometry. ``num_blocks`` includes the reserved null
+    block 0, so usable KV capacity is (num_blocks - 1) * block_size
+    tokens shared by all slots."""
+
+    num_blocks: int
+    block_size: int = 64          # (8, 128)-tile friendly for bf16
+    max_seq: int = 2048           # longest single sequence admitted
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_seq // self.block_size)
+
+    def tokens_capacity(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+
+def init_paged_cache(config: LlamaConfig, page: PagedConfig,
+                     num_slots: int, dtype=None) -> PagedCache:
+    c = config
+    dt = dtype or c.dtype
+    shape = (c.n_layers, page.num_blocks, page.block_size,
+             c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "length": jnp.zeros((num_slots,), jnp.int32),
+    }
+
+
+class BlockAllocator:
+    """Host-side free-list allocator + block tables. Not thread-safe:
+    owned by the single engine loop, like the rest of the engine state."""
+
+    def __init__(self, page: PagedConfig, num_slots: int):
+        self.page = page
+        self.num_slots = num_slots
+        self._free: List[int] = list(range(page.num_blocks - 1, 0, -1))
+        self.tables = np.zeros((num_slots, page.max_blocks_per_seq),
+                               np.int32)
+        self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        self._device_tables = None   # cache: re-upload only after changes
+
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-tokens // self.page.block_size)
+
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Grow ``slot``'s table to cover ``tokens`` cached tokens.
+        Returns False (allocating nothing) if the pool can't cover it."""
+        need = self.blocks_for(tokens) - len(self._owned[slot])
+        if need <= 0:
+            return True
+        if need > len(self._free) or self.blocks_for(tokens) > \
+                self.page.max_blocks_per_seq:
+            return False
+        for _ in range(need):
+            b = self._free.pop()
+            self.tables[slot, len(self._owned[slot])] = b
+            self._owned[slot].append(b)
+        self._device_tables = None
+        return True
+
+    def release(self, slot: int) -> None:
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self.tables[slot, :] = 0
+        self._device_tables = None
+
+    def device_tables(self) -> jax.Array:
+        """Device copy of the tables, re-uploaded only after an
+        ensure/release actually changed them — steady-state decode
+        (most steps) reuses the cached buffer instead of paying a
+        host→device transfer per generated token."""
+        if self._device_tables is None:
+            self._device_tables = jnp.asarray(self.tables)
+        return self._device_tables
+
+
+def _attend_paged(q, k_pool, v_pool, tables, lengths, scale):
+    """q (B,1,H,D); pools (NB,bs,KV,D); tables (B,MBS); lengths (B,)."""
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        on_tpu = False
+    if on_tpu:
+        from ray_tpu.ops.pallas.paged_decode_attention import (
+            paged_decode_attention)
+
+        return paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                                      scale=scale)
+    from ray_tpu.ops.pallas.paged_decode_attention import (
+        paged_attention_reference)
+
+    return paged_attention_reference(q, k_pool, v_pool, tables, lengths,
+                                     scale=scale)
+
+
+def make_paged_decode_step(params: Params, config: LlamaConfig,
+                           page: PagedConfig):
+    """step(cache, tables (B,MBS) i32, tokens (B,) i32, active (B,) bool)
+    → (cache, logits (B, vocab) f32). Each active slot's table must
+    already cover position ``length`` (the engine allocates between
+    steps); inactive slots write into the null block."""
+    c = config
+    bs = page.block_size
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def step(cache: PagedCache, tables, tokens, active):
+        lengths = cache["length"]
+        B = tokens.shape[0]
+        x = params["embed"].astype(c.dtype)[tokens][:, None, :]   # (B,1,E)
+        slot_rows = jnp.arange(B)
+        # physical write target of the new token per slot
+        blk = tables[slot_rows, lengths // bs]                     # (B,)
+        blk = jnp.where(active, blk, 0)                            # null
+        off = lengths % bs
+        positions = lengths[:, None]
+        att_len = lengths + 1
+
+        def body(x, scanned):
+            layer, kc, vc = scanned           # kc/vc (NB, bs, KV, D)
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            kc = kc.at[blk, off].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[blk, off].set(v[:, 0].astype(vc.dtype))
+            out = _attend_paged(q, kc, vc, tables, att_len,
+                                c.head_dim ** -0.5)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = jnp.einsum("be,ev->bv", x[:, 0].astype(jnp.float32),
+                            head.astype(jnp.float32))
+        new_len = jnp.where(active, lengths + 1, lengths)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_paged_prefill(params: Params, config: LlamaConfig,
+                       page: PagedConfig):
+    """prefill(cache, table_row (MBS,) i32, tokens (1,P) padded, true_len,
+    slot) → (cache, last_logits (vocab,) f32). P must be a multiple of
+    block_size (jitted per bucketed P); prompt KV lands in the blocks the
+    table row names, padding rows in the null block."""
+    c = config
+    bs = page.block_size
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def prefill(cache: PagedCache, table_row, tokens, true_len, slot,
+                pad_len: int):
+        nblk = pad_len // bs
+        x = params["embed"].astype(c.dtype)[tokens]           # (1, P, E)
+        positions = jnp.arange(pad_len)[None, :]
+        mask_valid = positions[0] < true_len                  # (P,)
+        # rows past true_len write into the null block
+        dest = jnp.where(jnp.arange(nblk) * bs < true_len,
+                         table_row[:nblk], 0)                  # (nblk,)
+
+        def body(x, scanned):
+            layer, kc, vc = scanned            # (NB, bs, KV, D)
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            from ray_tpu.ops.attention import mha_reference
+
+            out = mha_reference(q, k, v, causal=True)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            kb = jnp.where(mask_valid[:, None, None], k[0],
+                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
+            vb = jnp.where(mask_valid[:, None, None], v[0],
+                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
+            kc = kc.at[dest].set(kb.astype(kc.dtype))
+            vc = vc.at[dest].set(vb.astype(vc.dtype))
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        last = x[0, jnp.maximum(true_len - 1, 0)]
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = (last.astype(jnp.float32) @ head.astype(jnp.float32))
+        new_len = cache["length"].at[slot].set(true_len)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    def call(cache, table_row, tokens, true_len, slot):
+        pad_len = tokens.shape[1]
+        if pad_len % bs:
+            raise ValueError(f"padded prompt {pad_len} not a multiple of "
+                             f"block_size {bs}")
+        return prefill(cache, jnp.asarray(table_row, jnp.int32), tokens,
+                       jnp.asarray(true_len, jnp.int32),
+                       jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
+def make_paged_inject(config: LlamaConfig, page: PagedConfig):
+    """inject(cache, table_row (MBS,) i32, k, v, true_len, slot) → cache.
+    k/v are (layers, P, KV, D) with P a multiple of block_size; rows at
+    or beyond true_len must be zero. The KV-transfer half of PD
+    disaggregation and the prefix cache, over blocks."""
+    c = config
+    bs = page.block_size
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def inject(cache: PagedCache, table_row, k, v, true_len, slot,
+               pad_len: int):
+        nblk = pad_len // bs
+        dest = jnp.where(jnp.arange(nblk) * bs < true_len,
+                         table_row[:nblk], 0)
+        kb = k.reshape(c.n_layers, nblk, bs, c.n_kv_heads, c.head_dim)
+        vb = v.reshape(c.n_layers, nblk, bs, c.n_kv_heads, c.head_dim)
+        kc = cache["k"].at[:, dest].set(kb.astype(cache["k"].dtype))
+        vc = cache["v"].at[:, dest].set(vb.astype(cache["v"].dtype))
+        new_len = cache["length"].at[slot].set(true_len)
+        return {"k": kc, "v": vc, "length": new_len}
+
+    def call(cache, table_row, k, v, true_len, slot):
+        pad_len = k.shape[1]
+        if pad_len % bs:
+            raise ValueError(f"padded KV length {pad_len} not a multiple "
+                             f"of block_size {bs}")
+        return inject(cache, jnp.asarray(table_row, jnp.int32),
+                      jnp.asarray(k), jnp.asarray(v),
+                      jnp.asarray(true_len, jnp.int32),
+                      jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
+def extract_kv(cache: PagedCache, allocator: BlockAllocator, slot: int,
+               true_len: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Device→host copy of one slot's cached KV rows [0, true_len):
+    gathers the slot's blocks and trims. The PD/prefix-cache export."""
+    bs = allocator.page.block_size
+    nblk = allocator.blocks_for(true_len)
+    ids = allocator.tables[slot, :nblk]
+    k, v = jax.device_get((cache["k"][:, ids], cache["v"][:, ids]))
+    L, _, _, KV, D = k.shape
+    k = k.reshape(L, nblk * bs, KV, D)[:, :true_len]
+    v = v.reshape(L, nblk * bs, KV, D)[:, :true_len]
+    return np.asarray(k), np.asarray(v)
+
+
+def pad_to_block_bucket(n: int, block_size: int,
+                        buckets=(64, 128, 256, 512, 1024, 2048)) -> int:
+    """Prompt padding bucket that is always a block_size multiple."""
+    for b in buckets:
+        if n <= b and b % block_size == 0:
+            return b
+    m = max(block_size, buckets[-1])
+    return -(-n // m) * m

@@ -11,6 +11,11 @@ the kernel's BlockSpec index maps translate (slot, logical block) →
 physical pool block at grid-issue time — the gather never materializes a
 contiguous per-slot cache in HBM.
 
+GQA is an unrolled static loop over kv heads inside each program (same
+rationale as ``decode_attention.py``: the KV axis is too small/unaligned
+to be a grid dimension, and looping in-program reads each cache block
+exactly once).
+
 Layout contract:
     q        (B, 1, H, D)    new-token queries
     k_pool   (NB, bs, KV, D) paged key pool (one layer)
@@ -21,8 +26,7 @@ Layout contract:
                              they are masked out, but are still prefetched
     lengths  (B,) int32      valid tokens per slot (incl. the new token)
 
-Online-softmax recurrence identical to ``decode_attention.py``; GQA by
-loading one kv head's whole query group as the left matmul operand.
+Online-softmax recurrence identical to ``decode_attention.py``.
 """
 
 from __future__ import annotations
@@ -41,7 +45,8 @@ _LANES = 128
 def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref,
                   *, scale: float, block_s: int, num_blocks: int,
-                  num_kv: int):
+                  num_kv: int, group: int):
+    b = pl.program_id(0)
     ib = pl.program_id(1)
 
     @pl.when(ib == 0)
@@ -50,31 +55,35 @@ def _paged_kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    b = pl.program_id(0) // num_kv
     length = len_ref[b]
 
     @pl.when(ib * block_s < length)
     def _compute():
-        q = q_ref[0]                       # (group, D)
-        k = k_ref[0, :, 0, :]              # (bs, D)
-        v = v_ref[0, :, 0, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (group, bs)
-        col = ib * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col < length, s, NEG_INF)
+        for j in range(num_kv):          # static unroll over kv heads
+            lo, hi = j * group, (j + 1) * group
+            q = q_ref[0, lo:hi, :]       # (group, D)
+            k = k_ref[0, :, j, :]        # (bs, D)
+            v = v_ref[0, :, j, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (group, bs)
+            col = ib * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(col < length, s, NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = jnp.broadcast_to(
-            l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
-            l_ref.shape)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            m_prev = m_ref[lo:hi, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[lo:hi, :] = jnp.broadcast_to(
+                l_ref[lo:hi, :1] * alpha + jnp.sum(p, axis=1,
+                                                   keepdims=True),
+                (group, _LANES))
+            acc_ref[lo:hi, :] = acc_ref[lo:hi, :] * alpha + \
+                jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_ref[lo:hi, :] = jnp.broadcast_to(m_new, (group, _LANES))
 
     @pl.when(ib == num_blocks - 1)
     def _finalize():
@@ -94,43 +103,43 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths, *,
         raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
     group = H // KV
 
-    qg = q.reshape(B, KV, group, D).reshape(B * KV, group, D)
+    qh = q.reshape(B, H, D)
 
     kernel = functools.partial(
-        _paged_kernel, scale=scale, block_s=bs, num_blocks=MBS, num_kv=KV)
+        _paged_kernel, scale=scale, block_s=bs, num_blocks=MBS,
+        num_kv=KV, group=group)
 
-    def kv_ix(bk, ib, tables_ref, len_ref):
+    def kv_ix(b, ib, tables_ref, len_ref):
         del len_ref
-        return (tables_ref[bk // KV, ib], 0, bk % KV, 0)
+        return (tables_ref[b, ib], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B * KV, MBS),
+        grid=(B, MBS),
         in_specs=[
-            pl.BlockSpec((1, group, D),
-                         lambda bk, ib, *_: (bk, 0, 0)),
-            pl.BlockSpec((1, bs, 1, D), kv_ix),
-            pl.BlockSpec((1, bs, 1, D), kv_ix),
+            pl.BlockSpec((1, H, D), lambda b, ib, *_: (b, 0, 0)),
+            pl.BlockSpec((1, bs, KV, D), kv_ix),
+            pl.BlockSpec((1, bs, KV, D), kv_ix),
         ],
-        out_specs=pl.BlockSpec((1, group, D), lambda bk, ib, *_: (bk, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, D), lambda b, ib, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, D), jnp.float32),
-            pltpu.VMEM((group, _LANES), jnp.float32),
-            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
         ],
     )
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * KV, group, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      qg, k_pool, v_pool)
+      qh, k_pool, v_pool)
 
-    return out.reshape(B, KV, group, D).reshape(B, 1, H, D)
+    return out.reshape(B, 1, H, D)
 
 
 def paged_attention_reference(q, k_pool, v_pool, tables, lengths, *,

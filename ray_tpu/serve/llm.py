@@ -38,11 +38,23 @@ class _Request:
 
 
 class LLMEngine:
-    """Single-replica continuous-batching engine."""
+    """Single-replica continuous-batching engine.
+
+    ``kv_cache="paged"`` (default) backs the slots with the block-table
+    pool of :mod:`ray_tpu.models.paged_cache`: HBM per request tracks
+    tokens actually cached, ``kv_pool_tokens`` bounds the total, and a
+    request that outgrows the pool preempts the youngest other slot
+    (vLLM-style recompute preemption: its blocks are freed and it
+    re-queues with prompt+generated-so-far as the new prompt).
+    ``kv_cache="slot"`` keeps the flat per-slot ``max_seq`` reservation.
+    """
 
     def __init__(self, config=None, params=None, *, num_slots: int = 8,
                  max_seq: Optional[int] = None, model: str = "tiny",
-                 seed: int = 0, prefix_cache_size: int = 0):
+                 seed: int = 0, prefix_cache_size: int = 0,
+                 kv_cache: str = "paged",
+                 kv_pool_tokens: Optional[int] = None,
+                 kv_block_size: int = 64):
         import collections
 
         import jax
@@ -57,10 +69,39 @@ class LLMEngine:
         self.params = params
         self.num_slots = num_slots
         self.max_seq = max_seq or self.config.max_seq
-        self._cache = init_cache(self.config, num_slots, self.max_seq)
-        self._decode = make_decode_step(params, self.config)
-        self._prefill = make_prefill(params, self.config)
-        self._inject = make_inject(self.config)
+        if kv_cache not in ("paged", "slot"):
+            raise ValueError(f"kv_cache={kv_cache!r}: 'paged' or 'slot'")
+        if kv_cache == "paged" and (kv_block_size <= 0
+                                    or 2048 % kv_block_size):
+            # must divide the prompt padding buckets or _prompt_pad can
+            # return a non-multiple and crash every prefill
+            raise ValueError(
+                f"kv_block_size={kv_block_size} must divide 2048")
+        self.kv_cache = kv_cache
+        if kv_cache == "paged":
+            from ray_tpu.models.paged_cache import (
+                BlockAllocator, PagedConfig, init_paged_cache,
+                make_paged_decode_step, make_paged_inject,
+                make_paged_prefill)
+
+            pool_tokens = kv_pool_tokens or num_slots * self.max_seq
+            num_blocks = 1 + -(-pool_tokens // kv_block_size)  # +null
+            self._page = PagedConfig(num_blocks=num_blocks,
+                                     block_size=kv_block_size,
+                                     max_seq=self.max_seq)
+            self._alloc = BlockAllocator(self._page, num_slots)
+            self._cache = init_paged_cache(self.config, self._page,
+                                           num_slots)
+            self._decode = make_paged_decode_step(params, self.config,
+                                                  self._page)
+            self._prefill = make_paged_prefill(params, self.config,
+                                               self._page)
+            self._inject = make_paged_inject(self.config, self._page)
+        else:
+            self._cache = init_cache(self.config, num_slots, self.max_seq)
+            self._decode = make_decode_step(params, self.config)
+            self._prefill = make_prefill(params, self.config)
+            self._inject = make_inject(self.config)
         self._key = jax.random.key(seed)
         # Exact-prompt KV cache (host LRU), OFF by default: storing pays
         # a device->host copy of the prompt KV per admission, worth it
@@ -76,16 +117,22 @@ class LLMEngine:
         self._prefix_misses = 0
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._waiting: "collections.deque[_Request]" = collections.deque()
         self._pending: Dict[str, dict] = {}      # streaming submit/poll
         self._pending_lock = threading.Lock()
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._last_token = np.zeros(num_slots, np.int32)
+        # host mirror of cached tokens per slot (= device cache length)
+        self._slot_len = np.zeros(num_slots, np.int64)
+        self._admit_seq = np.zeros(num_slots, np.int64)  # preempt-victim age
+        self._admit_counter = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
         self._steps = 0
         self._tokens_generated = 0
+        self._preemptions = 0
 
     # ------------------------------------------------------------- public
     def generate(self, prompt: List[int], max_tokens: int = 64,
@@ -174,57 +221,123 @@ class LLMEngine:
             return {"chunks": chunks, "done": finished}
 
     def stats(self) -> Dict[str, Any]:
-        return {"steps": self._steps,
-                "tokens_generated": self._tokens_generated,
-                "active_slots": sum(s is not None for s in self._slots),
-                "queued": self._queue.qsize(),
-                "prefix_hits": self._prefix_hits,
-                "prefix_misses": self._prefix_misses}
+        out = {"steps": self._steps,
+               "tokens_generated": self._tokens_generated,
+               "active_slots": sum(s is not None for s in self._slots),
+               "queued": self._queue.qsize() + len(self._waiting),
+               "prefix_hits": self._prefix_hits,
+               "prefix_misses": self._prefix_misses,
+               "kv_cache": self.kv_cache}
+        if self.kv_cache == "paged":
+            out.update(
+                preemptions=self._preemptions,
+                kv_blocks_free=self._alloc.free_blocks(),
+                kv_blocks_total=self._page.num_blocks - 1,
+                kv_block_size=self._page.block_size)
+        return out
 
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=5)
 
     # ------------------------------------------------------------- engine
+    def _prompt_pad(self, plen: int) -> int:
+        """Bucketed padded prompt length (block-multiple when paged)."""
+        from ray_tpu.models.decoding import pad_to_bucket
+        from ray_tpu.models.paged_cache import pad_to_block_bucket
+
+        if self.kv_cache == "paged":
+            cap = self._page.max_blocks_per_seq * self._page.block_size
+            return min(pad_to_block_bucket(plen, self._page.block_size),
+                       cap)
+        return min(pad_to_bucket(plen), self.max_seq)
+
     def _inject_kv(self, slot: int, k: np.ndarray, v: np.ndarray,
                    true_len: int):
-        """Pad external KV rows to a bucket and write them into `slot`."""
+        """Pad external KV rows to a bucket and write them into `slot`.
+        Paged: the caller must have ensure()d blocks for ``true_len``."""
         import jax.numpy as jnp
 
-        from ray_tpu.models.decoding import pad_to_bucket
-
-        P = min(pad_to_bucket(true_len), self.max_seq)
+        P = self._prompt_pad(true_len)
         pad = P - k.shape[1]
         if pad > 0:
             widths = ((0, 0), (0, pad), (0, 0), (0, 0))
             k = np.pad(k, widths)
             v = np.pad(v, widths)
-        self._cache = self._inject(self._cache, jnp.asarray(k),
-                                   jnp.asarray(v), true_len, slot)
+        if self.kv_cache == "paged":
+            self._cache = self._inject(self._cache,
+                                       self._alloc.tables[slot],
+                                       jnp.asarray(k), jnp.asarray(v),
+                                       true_len, slot)
+        else:
+            self._cache = self._inject(self._cache, jnp.asarray(k),
+                                       jnp.asarray(v), true_len, slot)
 
     def _extract_kv(self, slot: int, true_len: int):
         """Device→host copy of one slot's prompt KV (rows [0, true_len))."""
         import jax
 
+        if self.kv_cache == "paged":
+            from ray_tpu.models.paged_cache import extract_kv
+
+            return extract_kv(self._cache, self._alloc, slot, true_len)
         k, v = jax.device_get((self._cache["k"][:, slot, :true_len],
                                self._cache["v"][:, slot, :true_len]))
         return np.asarray(k), np.asarray(v)
 
+    def _free_slot(self) -> Optional[int]:
+        for slot in range(self.num_slots):
+            if self._slots[slot] is None:
+                return slot
+        return None
+
     def _admit(self):
         import jax.numpy as jnp
 
-        from ray_tpu.models.decoding import pad_to_bucket
-
-        for slot in range(self.num_slots):
-            if self._slots[slot] is not None:
-                continue
+        # drain the thread-safe queue into the FIFO admission deque
+        while True:
             try:
-                req = self._queue.get_nowait()
+                self._waiting.append(self._queue.get_nowait())
             except queue.Empty:
+                break
+        while self._waiting:
+            slot = self._free_slot()
+            if slot is None:
                 return
-            plen = len(req.prompt)
-            key = tuple(req.prompt)
-            cached = None if req.preload else self._prefix_cache.get(key)
+            req = self._waiting[0]
+            # preempted requests resume by recomputing prompt+generated
+            full_prompt = req.prompt + req.output
+            plen = len(full_prompt)
+            # ensure plen + 1: this iteration's decode step writes the
+            # first generated token at position plen, which lives in a
+            # NEW block when the prompt is block-aligned — and
+            # _grow_active_slots already ran this iteration, so nothing
+            # else allocates it before the write (it would silently land
+            # in the null block). Watermark: beyond that, keep one growth
+            # block of headroom per already-active slot, or admission
+            # starves running requests into immediate preemption.
+            if self.kv_cache == "paged" and not (
+                    self._alloc.free_blocks() >=
+                    self._alloc.blocks_for(plen + 1)
+                    + sum(s is not None for s in self._slots)
+                    and self._alloc.ensure(slot, plen + 1)):
+                if self._alloc.blocks_for(plen + 1) > \
+                        min(self._page.num_blocks - 1,
+                            self._page.max_blocks_per_seq):
+                    # can never fit, even with the pool idle: fail it
+                    # rather than deadlock the FIFO head
+                    self._waiting.popleft()
+                    req.error = (f"prompt of {plen} tokens exceeds KV "
+                                 "pool capacity")
+                    req.done.set()
+                    continue
+                return  # head-of-line waits for blocks (FIFO, no bypass)
+            self._waiting.popleft()
+            resumed = bool(req.output)
+            key = tuple(full_prompt)
+            cached = None
+            if req.preload is None and not resumed:
+                cached = self._prefix_cache.get(key)
             if req.preload is not None:
                 # PD handoff: prompt KV computed by a prefill replica
                 self._inject_kv(slot, req.preload["k"], req.preload["v"],
@@ -238,13 +351,18 @@ class LLMEngine:
                 logits_np = cached["logits"]
             else:
                 # cap padding at max_seq: a prompt that fits must be admitted
-                P = min(pad_to_bucket(plen), self.max_seq)
+                P = self._prompt_pad(plen)
                 tokens = np.zeros((1, P), np.int32)
-                tokens[0, :plen] = req.prompt
-                self._cache, logits = self._prefill(
-                    self._cache, jnp.asarray(tokens), plen, slot)
+                tokens[0, :plen] = full_prompt
+                if self.kv_cache == "paged":
+                    self._cache, logits = self._prefill(
+                        self._cache, self._alloc.tables[slot],
+                        jnp.asarray(tokens), plen, slot)
+                else:
+                    self._cache, logits = self._prefill(
+                        self._cache, jnp.asarray(tokens), plen, slot)
                 logits_np = np.asarray(logits)
-                if self._prefix_cache_size > 0:
+                if self._prefix_cache_size > 0 and not resumed:
                     self._prefix_misses += 1
                     k, v = self._extract_kv(slot, plen)
                     self._prefix_cache[key] = {"k": k, "v": v,
@@ -255,6 +373,9 @@ class LLMEngine:
             req.output.append(int(tok))
             self._slots[slot] = req
             self._last_token[slot] = tok
+            self._slot_len[slot] = plen
+            self._admit_counter += 1
+            self._admit_seq[slot] = self._admit_counter
             self._maybe_finish(slot)
 
     def _sample(self, logits: np.ndarray, temperature: float) -> np.ndarray:
@@ -279,6 +400,36 @@ class LLMEngine:
         if done:
             req.done.set()
             self._slots[slot] = None
+            if self.kv_cache == "paged":
+                self._alloc.release(slot)
+
+    def _preempt(self, slot: int):
+        """Recompute preemption: free the slot's blocks and put the
+        request back at the HEAD of the admission queue; it resumes by
+        prefilling prompt+generated-so-far (vLLM's recompute mode)."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._alloc.release(slot)
+        self._waiting.appendleft(req)
+        self._preemptions += 1
+
+    def _grow_active_slots(self) -> None:
+        """Before a decode step each active slot needs its next token's
+        block. On pool exhaustion, preempt the youngest other active
+        slot; a slot alone in the pool preempts itself."""
+        for slot in range(self.num_slots):
+            if self._slots[slot] is None:
+                continue
+            while not self._alloc.ensure(slot, int(self._slot_len[slot]) + 1):
+                victims = [s for s in range(self.num_slots)
+                           if s != slot and self._slots[s] is not None]
+                if victims:
+                    victim = max(victims, key=lambda s: self._admit_seq[s])
+                else:
+                    victim = slot
+                self._preempt(victim)
+                if victim == slot:
+                    break
 
     def _loop(self):
         import logging
@@ -297,6 +448,10 @@ class LLMEngine:
                         req.error = f"engine step failed: {e!r}"
                         req.done.set()
                         self._slots[slot] = None
+                        if self.kv_cache == "paged":
+                            # blocks would otherwise leak for good: only
+                            # _maybe_finish/_preempt release them
+                            self._alloc.release(slot)
 
     _PENDING_TTL_S = 180.0
 
@@ -319,14 +474,24 @@ class LLMEngine:
         if self._steps_since_sweep >= 500:
             self._steps_since_sweep = 0
             self._sweep_pending()
+        # grow BEFORE admitting: otherwise a tight pool admits the queue
+        # head (paying its prefill), then immediately preempts it as the
+        # youngest slot to feed an older slot's growth — prefill thrash
+        if self.kv_cache == "paged":
+            self._grow_active_slots()
         self._admit()
         active = np.array([s is not None for s in self._slots])
         if not active.any():
             time.sleep(0.002)
             return
-        self._cache, logits = self._decode(
-            self._cache, jnp.asarray(self._last_token),
-            jnp.asarray(active))
+        if self.kv_cache == "paged":
+            self._cache, logits = self._decode(
+                self._cache, self._alloc.device_tables(),
+                jnp.asarray(self._last_token), jnp.asarray(active))
+        else:
+            self._cache, logits = self._decode(
+                self._cache, jnp.asarray(self._last_token),
+                jnp.asarray(active))
         logits_np = np.asarray(logits)
         self._steps += 1
         for slot in range(self.num_slots):
@@ -336,6 +501,7 @@ class LLMEngine:
             tok = self._sample(logits_np[slot][None], req.temperature)[0]
             req.output.append(int(tok))
             self._last_token[slot] = tok
+            self._slot_len[slot] += 1
             self._tokens_generated += 1
             self._maybe_finish(slot)
 
