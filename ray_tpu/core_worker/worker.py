@@ -589,7 +589,6 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         streaming: bool = False,
     ):
-        from ray_tpu.common.resources import ResourceRequest
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
         task_id = TaskID.for_normal_task(
@@ -676,7 +675,6 @@ class CoreWorker:
                      scheduling_strategy=None, max_restarts=0, max_concurrency=1,
                      name=None, namespace="default",
                      runtime_env=None) -> "ActorID":
-        from ray_tpu.common.resources import ResourceRequest
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
         actor_id = ActorID.of(self.job_id, self.current_task_id(), self._actor_counter.next())

@@ -49,6 +49,17 @@ from ray_tpu.rl.offline import (  # noqa: F401
     collect,
 )
 
+from ray_tpu.rl.sac import (  # noqa: F401
+    SAC,
+    SACConfig,
+    SACLearner,
+)
+from ray_tpu.rl.appo import (  # noqa: F401
+    APPO,
+    APPOConfig,
+    APPOLearner,
+)
+
 from ray_tpu.util.usage import record_library_usage as _record_usage
 _record_usage("rl")
 del _record_usage

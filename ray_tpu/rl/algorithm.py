@@ -166,6 +166,11 @@ class Algorithm:
                                            if runner_states else None),
             "learner_connector_state": self._learner_pipeline.get_state(),
         }
+        # learners with state beyond the policy weights (SAC: critics,
+        # targets, α, optimizer moments) checkpoint it all
+        learner = getattr(self, "learner", None)
+        if learner is not None and hasattr(learner, "get_state"):
+            state["learner_state"] = learner.get_state()
         fname = os.path.join(path, "algorithm_state.pkl")
         with open(fname, "wb") as f:
             pickle.dump(state, f)
@@ -179,6 +184,10 @@ class Algorithm:
                  else os.path.join(path, "algorithm_state.pkl"))
         with open(fname, "rb") as f:
             state = pickle.load(f)
+        learner = getattr(self, "learner", None)
+        if state.get("learner_state") is not None and learner is not None \
+                and hasattr(learner, "set_state"):
+            learner.set_state(state["learner_state"])
         self.set_weights(state["weights"])
         self.iteration = state["iteration"]
         self._weights_version = state["weights_version"]
@@ -275,10 +284,6 @@ def _probe_env(env_spec, connectors=()) -> Dict[str, int]:
 
     env = make_env(env_spec)
     obs, _ = env.reset(seed=0)
-    num_actions = getattr(env, "num_actions", None)
-    if num_actions is None:
-        space = getattr(env, "action_space", None)
-        num_actions = int(getattr(space, "n"))
     obs_size = int(np.asarray(obs).size)
     if connectors:
         from ray_tpu.rl.env_runner import _make_connector
@@ -289,4 +294,19 @@ def _probe_env(env_spec, connectors=()) -> Dict[str, int]:
         pipeline = ConnectorPipeline([_make_connector(c)
                                       for c in connectors])
         obs_size = pipeline.output_size(obs_size)
+    num_actions = getattr(env, "num_actions", None)
+    if num_actions is None:
+        space = getattr(env, "action_space", None)
+        num_actions = getattr(space, "n", None)
+    if num_actions is None:
+        # continuous env: action_dim (+ symmetric bound) instead of a
+        # discrete count (reference: Box vs Discrete action spaces)
+        action_dim = getattr(env, "action_dim", None)
+        if action_dim is None:
+            raise ValueError(
+                f"env {env_spec!r} exposes neither num_actions nor "
+                "action_dim")
+        return {"obs_size": obs_size, "continuous": True,
+                "action_dim": int(action_dim),
+                "action_scale": float(getattr(env, "action_high", 1.0))}
     return {"obs_size": obs_size, "num_actions": int(num_actions)}

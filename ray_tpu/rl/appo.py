@@ -1,0 +1,100 @@
+"""APPO: asynchronous PPO — IMPALA's decoupled sample/learn architecture
+with PPO's clipped surrogate objective on V-trace-corrected advantages.
+
+Reference: ``rllib/algorithms/appo/appo.py`` (APPO subclasses IMPALA)
+and ``appo_learner.py`` / ``default_appo_rl_module.py``: behavior-policy
+importance ratios feed both the V-trace value correction and the clip
+surrogate; an optional KL penalty toward the behavior policy stabilizes
+aggressively-async runs (reference default ``use_kl_loss=False``).
+
+Everything but the loss rides :mod:`ray_tpu.rl.impala`: aggregator
+actors, the never-blocking sample router, LearnerGroup sharding, and the
+broadcast cadence are shared code paths, exactly like the reference's
+subclassing structure. TPU framing: same single jitted fixed-shape
+update as IMPALA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rl.module import jax_forward
+
+
+class APPOLearner(IMPALALearner):
+    """IMPALA learner with the PPO clip surrogate (+ optional KL):
+    overrides ONLY the loss hook; v-trace and the jitted step/grad/apply
+    scaffolding are the shared IMPALA code paths."""
+
+    def __init__(self, params, *, clip: float = 0.2,
+                 kl_coeff: float = 0.0, **kwargs):
+        self._clip = clip
+        self._kl_coeff = kl_coeff
+        super().__init__(params, **kwargs)
+
+    def _make_loss_fn(self, gamma, vf_c, ent_c, rho_bar, c_bar):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.impala import vtrace_corrections
+
+        clip, kl_coeff = self._clip, self._kl_coeff
+
+        def loss_fn(params, batch):
+            logits, values = jax_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            rho = jax.lax.stop_gradient(ratio)
+            vs, adv = vtrace_corrections(
+                values, batch, rho, gamma=gamma, rho_bar=rho_bar,
+                c_bar=c_bar)
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            # PPO clip surrogate on the v-trace advantages (the APPO
+            # difference vs IMPALA's plain -logp * adv)
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+            pi_loss = -jnp.mean(surrogate)
+            vf_loss = jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            # KL(behavior || current) estimated from the sampled actions
+            kl = jnp.mean(batch["logp"] - logp)
+            total = (pi_loss + vf_c * vf_loss - ent_c * entropy
+                     + kl_coeff * kl)
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy, "kl": kl,
+                           "mean_ratio": jnp.mean(ratio)}
+
+        return loss_fn
+
+
+class APPO(IMPALA):
+    """Async PPO driver — IMPALA's training_step, APPO's loss."""
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    clip: float = 0.2
+    kl_coeff: float = 0.0            # reference default: use_kl_loss=False
+    lr: float = 3e-4
+    entropy_coeff: float = 0.01
+
+    @property
+    def algo_class(self):
+        return APPO
+
+    def learner_cls(self):
+        return APPOLearner
+
+    def learner_kwargs(self) -> dict:
+        kw = super().learner_kwargs()
+        kw.update(clip=self.clip, kl_coeff=self.kl_coeff)
+        return kw

@@ -95,13 +95,19 @@ class EnvRunner:
         return self._sample_single(num_steps)
 
     def _sample_vector(self, num_steps: int):
-        from ray_tpu.rl.module import np_forward, np_sample_actions_batch
+        from ray_tpu.rl.module import (
+            action_spec, is_continuous, np_forward,
+            np_sample_actions_batch, np_sample_continuous_batch)
 
         assert self._params is not None, "set_weights first"
         N = self.num_envs
+        cont = is_continuous(self._params)
+        a_shape, a_dtype = action_spec(self._params)
+        sampler = (np_sample_continuous_batch if cont
+                   else np_sample_actions_batch)
         obs_buf = np.empty((N, num_steps) + self._obs_vec.shape[1:],
                            np.float32)
-        act_buf = np.empty((N, num_steps), np.int32)
+        act_buf = np.empty((N, num_steps) + a_shape, a_dtype)
         rew_buf = np.empty((N, num_steps), np.float32)
         done_buf = np.empty((N, num_steps), np.bool_)
         logp_buf = np.empty((N, num_steps), np.float32)
@@ -109,7 +115,7 @@ class EnvRunner:
         episode_returns = [[] for _ in range(N)]
 
         for t in range(num_steps):
-            actions, logps, values = np_sample_actions_batch(
+            actions, logps, values = sampler(
                 self._params, self._obs_vec, self._rng)
             obs_buf[:, t] = self._obs_vec
             act_buf[:, t] = actions
@@ -117,7 +123,7 @@ class EnvRunner:
             val_buf[:, t] = values
             for i, env in enumerate(self.envs):
                 raw, reward, terminated, truncated, _ = env.step(
-                    self._m2e(int(actions[i])))
+                    self._m2e(actions[i] if cont else int(actions[i])))
                 self._obs_vec[i] = self._pipeline(raw)
                 rew_buf[i, t] = reward
                 done_buf[i, t] = terminated or truncated
@@ -129,7 +135,10 @@ class EnvRunner:
                     raw, _ = env.reset()
                     self._obs_vec[i] = self._pipeline(raw)
 
-        _, last_vals = np_forward(self._params, self._obs_vec)
+        if cont:     # off-policy consumers bootstrap from their critics
+            last_vals = np.zeros(N, np.float32)
+        else:
+            _, last_vals = np_forward(self._params, self._obs_vec)
         return [
             {"obs": obs_buf[i], "actions": act_buf[i],
              "rewards": rew_buf[i], "dones": done_buf[i],
@@ -141,9 +150,14 @@ class EnvRunner:
         ]
 
     def _sample_single(self, num_steps: int) -> Dict[str, Any]:
+        from ray_tpu.rl.module import (
+            action_spec, is_continuous, np_sample_continuous_batch)
+
         assert self._params is not None, "set_weights first"
+        cont = is_continuous(self._params)
+        a_shape, a_dtype = action_spec(self._params)
         obs_buf = np.empty((num_steps,) + self._obs.shape, np.float32)
-        act_buf = np.empty(num_steps, np.int32)
+        act_buf = np.empty((num_steps,) + a_shape, a_dtype)
         rew_buf = np.empty(num_steps, np.float32)
         done_buf = np.empty(num_steps, np.bool_)      # episode boundary
         logp_buf = np.empty(num_steps, np.float32)
@@ -151,8 +165,13 @@ class EnvRunner:
         episode_returns = []
 
         for t in range(num_steps):
-            action, logp, value = np_sample_action(
-                self._params, self._obs, self._rng)
+            if cont:
+                a_b, lp_b, v_b = np_sample_continuous_batch(
+                    self._params, self._obs[None], self._rng)
+                action, logp, value = a_b[0], float(lp_b[0]), float(v_b[0])
+            else:
+                action, logp, value = np_sample_action(
+                    self._params, self._obs, self._rng)
             obs_buf[t] = self._obs
             act_buf[t] = action
             logp_buf[t] = logp
@@ -176,7 +195,10 @@ class EnvRunner:
         # Bootstrap value for the (possibly mid-episode) final state.
         from ray_tpu.rl.module import np_forward
 
-        _, last_val = np_forward(self._params, self._obs[None])
+        if cont:     # off-policy consumers bootstrap from their critics
+            last_val = np.zeros(1, np.float32)
+        else:
+            _, last_val = np_forward(self._params, self._obs[None])
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
             "dones": done_buf, "logp": logp_buf, "values": val_buf,

@@ -68,6 +68,60 @@ class CartPoleEnv:
                 truncated, {})
 
 
+class PendulumEnv:
+    """Classic underactuated pendulum swing-up (gym Pendulum-v1
+    dynamics): obs (cosθ, sinθ, θ̇), one continuous torque in
+    [-2, 2], reward -(θ² + 0.1·θ̇² + 0.001·a²), 200-step episodes.
+    The stock continuous-control testbed for SAC-class algorithms
+    (reference: rllib/tuned_examples/sac/pendulum_sac.py)."""
+
+    observation_size = 3
+    action_dim = 1                    # continuous: no num_actions
+    action_low = -2.0
+    action_high = 2.0
+    max_episode_steps = 200
+
+    GRAVITY = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._theta = 0.0
+        self._theta_dot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._theta), np.sin(self._theta),
+                         self._theta_dot], np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._theta_dot = self._rng.uniform(-1.0, 1.0)
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          self.action_low, self.action_high))
+        th, thdot = self._theta, self._theta_dot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + self.DT * (
+            3 * self.GRAVITY / (2 * self.LENGTH) * np.sin(th)
+            + 3.0 / (self.MASS * self.LENGTH ** 2) * u)
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + self.DT * thdot
+        self._theta, self._theta_dot = th, thdot
+        self._steps += 1
+        truncated = self._steps >= self.max_episode_steps
+        return self._obs(), -float(cost), False, truncated, {}
+
+
 def _coordination_factory(seed=None):
     from ray_tpu.rl.multi_agent import CoordinationGameEnv
 
@@ -76,6 +130,7 @@ def _coordination_factory(seed=None):
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {
     "CartPole-v1": CartPoleEnv,
+    "Pendulum-v1": PendulumEnv,
     "coordination": _coordination_factory,
 }
 

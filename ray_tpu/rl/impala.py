@@ -79,6 +79,45 @@ class Aggregator:
         return batch
 
 
+def vtrace_corrections(values, batch, rho, *, gamma, rho_bar, c_bar):
+    """V-trace (Espeholt et al., 2018) value targets + pg advantages over
+    a batch of concatenated fragments. The reverse-scan carry zeroes at
+    fragment boundaries: concatenated fragments come from unrelated
+    trajectories, so corr_{t+1} of the NEXT fragment must not leak into
+    this fragment's targets. Returns (vs, pg_adv); callers stop-gradient
+    `rho` themselves. Shared by IMPALA and APPO losses."""
+    import jax
+    import jax.numpy as jnp
+
+    values_sg = jax.lax.stop_gradient(values)
+    nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+    # next-step values: train-time values shifted left; fragment tails
+    # use the runner's bootstrap value
+    next_values = jnp.where(batch["fragment_end"],
+                            batch["bootstrap_value"],
+                            jnp.roll(values_sg, -1))
+    frag_end = batch["fragment_end"].astype(jnp.float32)
+    rho_c = jnp.minimum(rho_bar, rho)
+    c = jnp.minimum(c_bar, rho)
+    delta = rho_c * (batch["rewards"] + gamma * nonterm * next_values
+                     - values_sg)
+
+    def body(acc, xs):
+        d, c_t, nt, fe = xs
+        acc = jnp.where(fe, 0.0, acc)   # cut across fragments
+        acc = d + gamma * nt * c_t * acc
+        return acc, acc
+
+    _, corr = jax.lax.scan(body, jnp.zeros(()),
+                           (delta, c, nonterm, frag_end), reverse=True)
+    vs = values_sg + corr
+    vs_next = jnp.where(batch["fragment_end"],
+                        batch["bootstrap_value"], jnp.roll(vs, -1))
+    pg_adv = rho_c * (batch["rewards"] + gamma * nonterm * vs_next
+                      - values_sg)
+    return vs, pg_adv
+
+
 class IMPALALearner:
     """Policy gradient with V-trace targets (reference: rllib vtrace)."""
 
@@ -98,32 +137,12 @@ class IMPALALearner:
                                       rho_bar, c_bar)
         self.updates = 0
 
-    def _build_step(self, gamma, vf_c, ent_c, rho_bar, c_bar):
+    def _make_loss_fn(self, gamma, vf_c, ent_c, rho_bar, c_bar):
+        """Loss hook: APPO overrides ONLY this (reference structure:
+        appo_learner.py subclasses the IMPALA learner, swapping the
+        surrogate while sharing v-trace and the update scaffolding)."""
         import jax
         import jax.numpy as jnp
-        import optax
-
-        optimizer = self._optimizer
-
-        def vtrace(values, rewards, nonterm, next_values, rho, frag_end):
-            """Reverse scan computing vs_t - V(x_t) corrections. The carry
-            zeroes at fragment boundaries: concatenated fragments come from
-            unrelated trajectories, so corr_{t+1} of the NEXT fragment must
-            not leak into this fragment's targets."""
-            rho_c = jnp.minimum(rho_bar, rho)
-            c = jnp.minimum(c_bar, rho)
-            delta = rho_c * (rewards + gamma * nonterm * next_values - values)
-
-            def body(acc, xs):
-                d, c_t, nt, fe = xs
-                acc = jnp.where(fe, 0.0, acc)   # cut across fragments
-                acc = d + gamma * nt * c_t * acc
-                return acc, acc
-
-            _, corr = jax.lax.scan(
-                body, jnp.zeros(()), (delta, c, nonterm, frag_end),
-                reverse=True)
-            return values + corr  # vs_t
 
         def loss_fn(params, batch):
             logits, values = jax_forward(params, batch["obs"])
@@ -134,21 +153,9 @@ class IMPALALearner:
             rho = jnp.exp(logp - batch["logp"])
             rho = jax.lax.stop_gradient(rho)
             nonterm = 1.0 - batch["dones"].astype(jnp.float32)
-            # next-step values: train-time values shifted left; fragment
-            # tails use the runner's bootstrap value
-            next_values = jnp.where(
-                batch["fragment_end"],
-                batch["bootstrap_value"],
-                jnp.roll(jax.lax.stop_gradient(values), -1))
-            vs = vtrace(jax.lax.stop_gradient(values), batch["rewards"],
-                        nonterm, next_values, rho,
-                        batch["fragment_end"].astype(jnp.float32))
-            vs_next = jnp.where(batch["fragment_end"],
-                                batch["bootstrap_value"],
-                                jnp.roll(vs, -1))
-            pg_adv = jnp.minimum(rho_bar, rho) * (
-                batch["rewards"] + gamma * nonterm * vs_next
-                - jax.lax.stop_gradient(values))
+            vs, pg_adv = vtrace_corrections(
+                values, batch, rho, gamma=gamma, rho_bar=rho_bar,
+                c_bar=c_bar)
             pi_loss = -jnp.mean(logp * pg_adv)
             vf_loss = jnp.mean((values - vs) ** 2)
             entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
@@ -156,6 +163,15 @@ class IMPALALearner:
             return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy,
                            "mean_rho": jnp.mean(rho)}
+
+        return loss_fn
+
+    def _build_step(self, gamma, vf_c, ent_c, rho_bar, c_bar):
+        import jax
+        import optax
+
+        optimizer = self._optimizer
+        loss_fn = self._make_loss_fn(gamma, vf_c, ent_c, rho_bar, c_bar)
 
         def step(params, opt_state, batch):
             (total, aux), grads = jax.value_and_grad(
@@ -234,6 +250,16 @@ class IMPALAConfig(AlgorithmConfig):
     def algo_class(self):
         return IMPALA
 
+    # learner construction hooks so APPO reuses the whole async driver
+    # with a different loss (reference: APPO subclasses IMPALA,
+    # rllib/algorithms/appo/appo.py:40)
+    def learner_cls(self):
+        return IMPALALearner
+
+    def learner_kwargs(self) -> dict:
+        return dict(lr=self.lr, gamma=self.gamma, vf_coeff=self.vf_coeff,
+                    entropy_coeff=self.entropy_coeff)
+
 
 class IMPALA(Algorithm):
     """Async IMPALA driver (reference impala.py:599 training_step)."""
@@ -248,24 +274,20 @@ class IMPALA(Algorithm):
         self.learner = None
         self.learner_group = None
         self._learner_updates = 0
+        learner_cls = config.learner_cls()
+        learner_kwargs = config.learner_kwargs()
         if config.num_learners > 1:
             from ray_tpu.rl.learner_group import LearnerGroup
 
-            lr, gamma = config.lr, config.gamma
-            vf_c, ent_c = config.vf_coeff, config.entropy_coeff
-
-            def factory(_p=params):
-                return IMPALALearner(_p, lr=lr, gamma=gamma,
-                                     vf_coeff=vf_c, entropy_coeff=ent_c)
+            def factory(_p=params, _cls=learner_cls, _kw=learner_kwargs):
+                return _cls(_p, **_kw)
 
             self.learner_group = LearnerGroup(
                 factory, num_learners=config.num_learners,
                 backend=config.learner_backend,
                 max_inflight_updates=config.max_inflight_updates)
         else:
-            self.learner = IMPALALearner(
-                params, lr=config.lr, gamma=config.gamma,
-                vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff)
+            self.learner = learner_cls(params, **learner_kwargs)
         agg_cls = ray_tpu.remote(Aggregator)
         self._aggregators = [
             agg_cls.options(max_concurrency=4).remote(config.train_batch_size)

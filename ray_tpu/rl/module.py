@@ -100,3 +100,79 @@ def np_sample_actions_batch(params: Params, obs: np.ndarray,
     logps = np.log(p[np.arange(len(p)), actions] + 1e-20)
     return actions.astype(np.int32), logps.astype(np.float32), \
         values.astype(np.float32)
+
+
+# ----------------------------------------------------------- continuous
+# Tanh-squashed Gaussian policy (SAC-style, reference
+# rllib/algorithms/sac/sac_learner.py + torch squashed-gaussian dist):
+# trunk "c{i}" -> heads "mu" and "ls" (state-dependent log-std), plus
+# "action_scale" bounds. Detected by `"mu_w" in params` — env runners
+# dispatch on it with no per-algorithm branching.
+
+LOGSTD_MIN, LOGSTD_MAX = -5.0, 2.0
+
+
+def init_continuous_policy_params(obs_size: int, action_dim: int,
+                                  hidden: Tuple[int, ...] = (64, 64),
+                                  seed: int = 0,
+                                  action_scale: float = 1.0) -> Params:
+    rng = np.random.default_rng(seed)
+    params: Params = {}
+    sizes = (obs_size,) + hidden
+
+    def dense(name, fan_in, fan_out, scale):
+        params[f"{name}_w"] = (rng.standard_normal((fan_in, fan_out))
+                               * scale).astype(np.float32)
+        params[f"{name}_b"] = np.zeros(fan_out, np.float32)
+
+    for i in range(len(hidden)):
+        dense(f"c{i}", sizes[i], sizes[i + 1], np.sqrt(2.0 / sizes[i]))
+    dense("mu", sizes[-1], action_dim, 0.01)
+    dense("ls", sizes[-1], action_dim, 0.01)
+    params["action_scale"] = np.asarray(action_scale, np.float32)
+    return params
+
+
+def _n_cont_hidden(params) -> int:
+    n = 0
+    while f"c{n}_w" in params:
+        n += 1
+    return n
+
+
+def np_continuous_dist(params: Params, obs: np.ndarray):
+    """(B, obs) → (mu (B, A), std (B, A)) of the pre-squash Gaussian."""
+    x = obs
+    for i in range(_n_cont_hidden(params)):
+        x = np.tanh(x @ params[f"c{i}_w"] + params[f"c{i}_b"])
+    mu = x @ params["mu_w"] + params["mu_b"]
+    logstd = np.clip(x @ params["ls_w"] + params["ls_b"],
+                     LOGSTD_MIN, LOGSTD_MAX)
+    return mu, np.exp(logstd)
+
+
+def np_sample_continuous_batch(params: Params, obs: np.ndarray,
+                               rng: np.random.Generator):
+    """(N, obs) → (actions (N, A) f32, logps (N,), values zeros (N,)).
+    Values are zeros: off-policy consumers (SAC) bootstrap from their own
+    critics, not runner-side value estimates."""
+    mu, std = np_continuous_dist(params, obs)
+    eps = rng.standard_normal(mu.shape)
+    pre = mu + std * eps
+    scale = float(params["action_scale"])
+    act = np.tanh(pre) * scale
+    logp = (-0.5 * (eps ** 2 + np.log(2 * np.pi)) - np.log(std)
+            - np.log(scale * (1 - np.tanh(pre) ** 2) + 1e-6)).sum(axis=1)
+    return (act.astype(np.float32), logp.astype(np.float32),
+            np.zeros(len(obs), np.float32))
+
+
+def is_continuous(params: Params) -> bool:
+    return "mu_w" in params
+
+
+def action_spec(params: Params):
+    """(trailing action shape, dtype) a runner should buffer for."""
+    if is_continuous(params):
+        return (params["mu_b"].shape[0],), np.float32
+    return (), np.int32

@@ -1,0 +1,329 @@
+"""SAC: off-policy maximum-entropy actor-critic for continuous control.
+
+Reference: ``rllib/algorithms/sac/sac.py`` (+ ``sac_learner.py`` /
+``default_sac_rl_module.py``): twin soft Q-functions with polyak-averaged
+targets, a tanh-squashed Gaussian actor, and learned entropy temperature
+α against a -|A| target entropy. TPU framing: the whole update (critic +
+actor + α + polyak) is ONE jitted function over a replayed minibatch —
+four small MLP towers batched on the MXU; replay sampling stays host-side
+numpy (same split as DQN).
+
+Runner side: the actor's weights are module.py continuous-policy params,
+so stock :class:`EnvRunner` actors sample exploration actions from the
+squashed Gaussian with no SAC-specific code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import ReplayBuffer
+from ray_tpu.rl.module import (
+    LOGSTD_MAX, LOGSTD_MIN, init_continuous_policy_params)
+
+
+def _init_q_params(obs_size: int, action_dim: int, hidden, seed: int,
+                   prefix: str) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    params = {}
+    sizes = (obs_size + action_dim,) + tuple(hidden)
+    for i in range(len(hidden)):
+        params[f"{prefix}{i}_w"] = (
+            rng.standard_normal((sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i])).astype(np.float32)
+        params[f"{prefix}{i}_b"] = np.zeros(sizes[i + 1], np.float32)
+    params[f"{prefix}h_w"] = (rng.standard_normal((sizes[-1], 1))
+                              * 0.01).astype(np.float32)
+    params[f"{prefix}h_b"] = np.zeros(1, np.float32)
+    return params
+
+
+class SACLearner:
+    """Jitted twin-Q + squashed-Gaussian-actor + α update."""
+
+    def __init__(self, obs_size: int, action_dim: int, *,
+                 hidden=(64, 64), actor_lr: float = 3e-4,
+                 critic_lr: float = 3e-4, alpha_lr: float = 3e-4,
+                 gamma: float = 0.99, tau: float = 0.005,
+                 action_scale: float = 1.0, seed: int = 0,
+                 target_entropy: float = None):
+        import optax
+
+        self.gamma = gamma
+        self.tau = tau
+        self.action_dim = action_dim
+        self.target_entropy = (-float(action_dim) if target_entropy is None
+                               else target_entropy)
+        self.actor = init_continuous_policy_params(
+            obs_size, action_dim, hidden=tuple(hidden), seed=seed,
+            action_scale=action_scale)
+        self.q1 = _init_q_params(obs_size, action_dim, hidden, seed + 1,
+                                 "q")
+        self.q2 = _init_q_params(obs_size, action_dim, hidden, seed + 2,
+                                 "q")
+        self.q1_target = {k: v.copy() for k, v in self.q1.items()}
+        self.q2_target = {k: v.copy() for k, v in self.q2.items()}
+        self.log_alpha = np.zeros((), np.float32)
+        self._opt_actor = optax.adam(actor_lr)
+        self._opt_critic = optax.adam(critic_lr)
+        self._opt_alpha = optax.adam(alpha_lr)
+        # action_scale is a bound, not a weight: freeze it
+        import jax
+
+        self._actor_opt_state = self._opt_actor.init(
+            {k: v for k, v in self.actor.items() if k != "action_scale"})
+        self._critic_opt_state = self._opt_critic.init((self.q1, self.q2))
+        self._alpha_opt_state = self._opt_alpha.init(self.log_alpha)
+        self._step = self._build_step()
+        self._key = jax.random.key(seed + 7)
+        self._n_updates = 0
+
+    @staticmethod
+    def _q_forward(params, obs, act):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, act], axis=1)
+        i = 0
+        while f"q{i}_w" in params:
+            x = jnp.tanh(x @ params[f"q{i}_w"] + params[f"q{i}_b"])
+            i += 1
+        return (x @ params["qh_w"] + params["qh_b"])[:, 0]
+
+    @staticmethod
+    def _actor_dist(actor, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        i = 0
+        while f"c{i}_w" in actor:
+            x = jnp.tanh(x @ actor[f"c{i}_w"] + actor[f"c{i}_b"])
+            i += 1
+        mu = x @ actor["mu_w"] + actor["mu_b"]
+        logstd = jnp.clip(x @ actor["ls_w"] + actor["ls_b"],
+                          LOGSTD_MIN, LOGSTD_MAX)
+        return mu, logstd
+
+    @classmethod
+    def _sample_squashed(cls, actor, obs, key):
+        """Reparameterized tanh-Gaussian sample → (action, logp)."""
+        import jax
+        import jax.numpy as jnp
+
+        mu, logstd = cls._actor_dist(actor, obs)
+        std = jnp.exp(logstd)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        scale = actor["action_scale"]
+        act = jnp.tanh(pre) * scale
+        logp = (-0.5 * (eps ** 2 + jnp.log(2 * jnp.pi)) - logstd
+                - jnp.log(scale * (1 - jnp.tanh(pre) ** 2) + 1e-6)
+                ).sum(axis=1)
+        return act, logp
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma, tau, tgt_ent = self.gamma, self.tau, self.target_entropy
+        opt_a, opt_c, opt_al = (self._opt_actor, self._opt_critic,
+                                self._opt_alpha)
+        qf, sample = self._q_forward, self._sample_squashed
+
+        def step(actor, q1, q2, q1_t, q2_t, log_alpha,
+                 a_opt, c_opt, al_opt, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # ---- critics: y = r + γ(1-d)(min Q'(s', a') - α logπ(a'|s'))
+            a_next, logp_next = sample(actor, batch["next_obs"], k1)
+            q_next = jnp.minimum(qf(q1_t, batch["next_obs"], a_next),
+                                 qf(q2_t, batch["next_obs"], a_next))
+            nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterm
+                * (q_next - alpha * logp_next))
+
+            def critic_loss(qs):
+                p1, p2 = qs
+                l1 = jnp.mean((qf(p1, batch["obs"], batch["actions"])
+                               - y) ** 2)
+                l2 = jnp.mean((qf(p2, batch["obs"], batch["actions"])
+                               - y) ** 2)
+                return l1 + l2, (l1, l2)
+
+            (closs, (l1, l2)), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)((q1, q2))
+            cupd, c_opt = opt_c.update(cgrads, c_opt, (q1, q2))
+            q1, q2 = optax.apply_updates((q1, q2), cupd)
+
+            # ---- actor: max E[min Q(s, a~π) - α logπ]
+            def actor_loss(a_train):
+                a_full = dict(a_train, action_scale=actor["action_scale"])
+                a_new, logp = sample(a_full, batch["obs"], k2)
+                q_new = jnp.minimum(qf(q1, batch["obs"], a_new),
+                                    qf(q2, batch["obs"], a_new))
+                return jnp.mean(alpha * logp - q_new), logp
+
+            a_train = {k: v for k, v in actor.items()
+                       if k != "action_scale"}
+            (aloss, logp_new), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(a_train)
+            aupd, a_opt = opt_a.update(agrads, a_opt, a_train)
+            a_train = optax.apply_updates(a_train, aupd)
+            actor = dict(a_train, action_scale=actor["action_scale"])
+
+            # ---- temperature: push E[logπ] toward -target_entropy
+            def alpha_loss(la):
+                return -jnp.mean(
+                    la * jax.lax.stop_gradient(logp_new + tgt_ent))
+
+            alloss, algrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            alupd, al_opt = opt_al.update(algrad, al_opt, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, alupd)
+
+            # ---- polyak targets
+            q1_t = jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                                q1_t, q1)
+            q2_t = jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                                q2_t, q2)
+            metrics = {"critic_loss": closs, "q1_loss": l1, "q2_loss": l2,
+                       "actor_loss": aloss, "alpha_loss": alloss,
+                       "alpha": alpha,
+                       "entropy": -jnp.mean(logp_new)}
+            return (actor, q1, q2, q1_t, q2_t, log_alpha,
+                    a_opt, c_opt, al_opt, metrics)
+
+        return jax.jit(step)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        (self.actor, self.q1, self.q2, self.q1_target, self.q2_target,
+         self.log_alpha, self._actor_opt_state, self._critic_opt_state,
+         self._alpha_opt_state, metrics) = self._step(
+            self.actor, self.q1, self.q2, self.q1_target, self.q2_target,
+            self.log_alpha, self._actor_opt_state, self._critic_opt_state,
+            self._alpha_opt_state, batch, sub)
+        self._n_updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        """Exploration-policy weights for env runners (actor only)."""
+        return {k: np.asarray(v) for k, v in self.actor.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        self.actor = {k: np.asarray(v) for k, v in weights.items()}
+
+    def get_state(self) -> Dict[str, Any]:
+        """FULL trainable state — critics, targets, α, optimizer states —
+        for checkpointing (get_weights alone would resume the restored
+        actor against fresh critics and destroy it within updates)."""
+        import jax
+
+        host = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        return {"actor": host(self.actor), "q1": host(self.q1),
+                "q2": host(self.q2), "q1_target": host(self.q1_target),
+                "q2_target": host(self.q2_target),
+                "log_alpha": np.asarray(self.log_alpha),
+                "actor_opt": host(self._actor_opt_state),
+                "critic_opt": host(self._critic_opt_state),
+                "alpha_opt": host(self._alpha_opt_state),
+                "n_updates": self._n_updates}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.actor = dict(state["actor"])
+        self.q1 = dict(state["q1"])
+        self.q2 = dict(state["q2"])
+        self.q1_target = dict(state["q1_target"])
+        self.q2_target = dict(state["q2_target"])
+        self.log_alpha = state["log_alpha"]
+        self._actor_opt_state = state["actor_opt"]
+        self._critic_opt_state = state["critic_opt"]
+        self._alpha_opt_state = state["alpha_opt"]
+        self._n_updates = state.get("n_updates", 0)
+
+
+class SAC(Algorithm):
+    def __init__(self, config: "SACConfig"):
+        super().__init__(config)
+        probe = self._env_probe
+        if not probe.get("continuous"):
+            raise ValueError("SAC requires a continuous-action env "
+                             "(action_dim attribute)")
+        self.learner = SACLearner(
+            probe["obs_size"], probe["action_dim"],
+            hidden=tuple(config.hidden), actor_lr=config.lr,
+            critic_lr=config.critic_lr, alpha_lr=config.alpha_lr,
+            gamma=config.gamma, tau=config.tau,
+            action_scale=probe.get("action_scale", 1.0),
+            seed=config.seed)
+        self.replay = ReplayBuffer(config.replay_capacity,
+                                   seed=config.seed)
+        self._env_steps = 0
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    @staticmethod
+    def _with_next_obs(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        obs = np.asarray(frag["obs"])
+        next_obs = np.empty_like(obs)
+        next_obs[:-1] = obs[1:]
+        next_obs[-1] = obs[-1]  # tail approximation (one step in 256)
+        return {"obs": obs, "actions": np.asarray(frag["actions"]),
+                "rewards": np.asarray(frag["rewards"]),
+                "next_obs": next_obs,
+                "dones": np.asarray(frag["dones"])}
+
+    def training_step(self) -> Dict[str, Any]:
+        fragments = self._sample_fragments()
+        if not fragments:
+            raise RuntimeError("no healthy env runners produced samples")
+        returns: List[float] = []
+        new_steps = 0
+        for f in fragments:
+            self.replay.add_fragment(self._with_next_obs(f))
+            returns.extend(f["episode_returns"])
+            new_steps += len(f["obs"])
+        self._env_steps += new_steps
+
+        metrics: Dict[str, float] = {}
+        if len(self.replay) >= self.config.learning_starts:
+            n_updates = max(1, int(new_steps
+                                   * self.config.updates_per_env_step))
+            for _ in range(n_updates):
+                metrics = self.learner.update(
+                    self.replay.sample(self.config.train_batch_size))
+        self._weights_version += 1
+        self._return_window = (self._return_window + returns)[-100:]
+        return {
+            "env_runners": {
+                "episode_return_mean": self.episode_return_mean(),
+                "num_episodes": len(returns),
+                "num_env_steps_sampled": self._env_steps,
+                "num_healthy_workers":
+                    self.env_runner_group.num_healthy_actors(),
+            },
+            "learners": {"default_policy": metrics},
+        }
+
+
+@dataclasses.dataclass
+class SACConfig(AlgorithmConfig):
+    env: Any = "Pendulum-v1"
+    lr: float = 3e-4                      # actor
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    tau: float = 0.005
+    replay_capacity: int = 100_000
+    train_batch_size: int = 256
+    learning_starts: int = 1_000
+    updates_per_env_step: float = 1.0
+    rollout_fragment_length: int = 128
+    algo_class = SAC

@@ -1,0 +1,169 @@
+"""SAC (continuous control) + APPO (async PPO) learning tests.
+
+Reward-threshold discipline mirrors the reference's tuned examples
+(``rllib/tuned_examples/sac/pendulum_sac.py``,
+``.../appo/cartpole_appo.py``): the algorithm must demonstrably LEARN in
+CI time, not just run. Thresholds are set for this 1-core box (a solved
+Pendulum is ~-150 over ~100k steps; unambiguous learning shows far
+earlier).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestPendulumEnv:
+    def test_dynamics_and_bounds(self):
+        from ray_tpu.rl.envs import PendulumEnv, make_env
+
+        env = make_env("Pendulum-v1", seed=3)
+        assert isinstance(env, PendulumEnv)
+        obs, _ = env.reset(seed=3)
+        assert obs.shape == (3,)
+        assert abs(float(np.hypot(obs[0], obs[1])) - 1.0) < 1e-5
+        total = 0.0
+        for _ in range(200):
+            obs, r, term, trunc, _ = env.step(np.array([0.5]))
+            assert r <= 0.0 and not term
+            total += r
+        assert trunc  # 200-step truncation
+        # cost is bounded below by the worst-case quadratic
+        assert total > -200 * (np.pi ** 2 + 0.1 * 64 + 0.001 * 4)
+
+    def test_continuous_runner_fragments(self, cluster):
+        from ray_tpu.rl.env_runner import EnvRunner
+        from ray_tpu.rl.module import init_continuous_policy_params
+
+        runner = EnvRunner("Pendulum-v1", seed=0)
+        params = init_continuous_policy_params(3, 1, action_scale=2.0)
+        runner.set_weights(params, 1)
+        frag = runner.sample(32)
+        assert frag["actions"].shape == (32, 1)
+        assert frag["actions"].dtype == np.float32
+        assert np.abs(frag["actions"]).max() <= 2.0
+        assert np.isfinite(frag["logp"]).all()
+
+
+class TestSACLearns:
+    def test_pendulum_reward_improves(self, cluster):
+        from ray_tpu.rl.sac import SACConfig
+
+        algo = (SACConfig().environment("Pendulum-v1").env_runners(2)
+                .training(rollout_fragment_length=128,
+                          learning_starts=500, seed=1).build())
+        try:
+            first = None
+            final = None
+            for i in range(45):
+                res = algo.train()
+                m = res["env_runners"]["episode_return_mean"]
+                if i == 6:
+                    first = m
+                final = m
+            # alpha must have annealed below its e^0 start
+            alpha = res["learners"]["default_policy"]["alpha"]
+            assert alpha < 0.7, alpha
+            assert first < -850, f"unexpectedly strong start: {first}"
+            assert final > -800, (
+                f"SAC failed to learn: start {first}, end {final}")
+            assert final - first > 150, (first, final)
+        finally:
+            algo.stop()
+
+
+class TestAPPOLearns:
+    def test_cartpole_reward_threshold(self, cluster):
+        from ray_tpu.rl.appo import APPOConfig
+
+        algo = (APPOConfig().environment("CartPole-v1").env_runners(2)
+                .training(rollout_fragment_length=128,
+                          train_batch_size=512, seed=2).build())
+        try:
+            best = 0.0
+            for _ in range(28):
+                res = algo.train()
+                m = res["env_runners"]["episode_return_mean"]
+                if np.isfinite(m):
+                    best = max(best, m)
+                if best >= 130.0:
+                    break
+            assert best >= 130.0, f"APPO plateaued at {best}"
+            lm = res["learners"]["default_policy"]
+            assert "mean_ratio" in lm and "kl" in lm
+        finally:
+            algo.stop()
+
+    def test_sac_checkpoint_restores_full_learner_state(self, cluster,
+                                                        tmp_path):
+        """SAC checkpoints must carry critics/targets/α/optimizer state,
+        not just the actor — restoring actor-only would train it against
+        fresh critics and destroy the policy."""
+        from ray_tpu.rl.sac import SACConfig
+
+        cfg = (SACConfig().environment("Pendulum-v1").env_runners(1)
+               .training(rollout_fragment_length=64, learning_starts=32))
+        algo = cfg.build()
+        try:
+            for _ in range(3):
+                algo.train()
+            path = algo.save_checkpoint(str(tmp_path / "sck"))
+            src = algo.learner
+            algo2 = (SACConfig().environment("Pendulum-v1")
+                     .env_runners(1)
+                     .training(rollout_fragment_length=64,
+                               learning_starts=32).build())
+            try:
+                algo2.restore_from_checkpoint(path)
+                dst = algo2.learner
+                np.testing.assert_array_equal(
+                    np.asarray(src.q1["qh_w"]), np.asarray(dst.q1["qh_w"]))
+                np.testing.assert_array_equal(
+                    np.asarray(src.q2_target["q0_w"]),
+                    np.asarray(dst.q2_target["q0_w"]))
+                assert float(src.log_alpha) == float(dst.log_alpha)
+                # critics differ from a fresh init (state actually moved)
+                fresh = cfg.build()
+                try:
+                    assert not np.array_equal(
+                        np.asarray(dst.q1["q0_w"]),
+                        np.asarray(fresh.learner.q1["q0_w"]))
+                finally:
+                    fresh.stop()
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()
+
+    def test_appo_checkpoint_roundtrip(self, cluster, tmp_path):
+        from ray_tpu.rl.appo import APPOConfig
+
+        algo = (APPOConfig().environment("CartPole-v1").env_runners(1)
+                .training(rollout_fragment_length=64,
+                          train_batch_size=128).build())
+        try:
+            algo.train()
+            path = algo.save_checkpoint(str(tmp_path / "ck"))
+            w = algo.get_weights()
+            algo2 = (APPOConfig().environment("CartPole-v1")
+                     .env_runners(1)
+                     .training(rollout_fragment_length=64,
+                               train_batch_size=128).build())
+            try:
+                algo2.restore_from_checkpoint(path)
+                w2 = algo2.get_weights()
+                for k in w:
+                    np.testing.assert_array_equal(
+                        np.asarray(w[k]), np.asarray(w2[k]))
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()
