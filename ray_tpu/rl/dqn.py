@@ -25,6 +25,34 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.module import init_policy_params, jax_forward
 
 
+def transitions_from_fragment(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Rollout fragment → replayable transitions for off-policy TD.
+
+    Runners record the TRUE successor state per step (``next_obs``,
+    pre-reset at episode boundaries) and a ``terminated`` flag distinct
+    from time-limit truncation — so the TD target bootstraps through
+    truncations from the real final state (gym distinction the reference
+    preserves; a truncated Pendulum episode still has future cost) and is
+    cut only at genuine terminations. Fallback for externally produced
+    fragments without those keys: shift obs for next_obs and drop the
+    fragment's (next-obs-less) tail — never fabricate a self-transition."""
+    obs = np.asarray(frag["obs"])
+    if "next_obs" in frag:
+        dones = np.asarray(frag.get("terminated", frag["dones"]),
+                           dtype=np.float32)
+        return {"obs": obs,
+                "actions": np.asarray(frag["actions"]),
+                "rewards": np.asarray(frag["rewards"], dtype=np.float32),
+                "next_obs": np.asarray(frag["next_obs"]),
+                "dones": dones}
+    dones = np.asarray(frag["dones"], dtype=np.float32)
+    return {"obs": obs[:-1],
+            "actions": np.asarray(frag["actions"])[:-1],
+            "rewards": np.asarray(frag["rewards"], dtype=np.float32)[:-1],
+            "next_obs": obs[1:],
+            "dones": dones[:-1]}
+
+
 class ReplayBuffer:
     """Uniform ring replay of transitions (numpy, host-side).
     Reference: ``rllib/utils/replay_buffers/``."""
@@ -148,17 +176,7 @@ class DQN(Algorithm):
 
     @staticmethod
     def _with_next_obs(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        """Fragments carry obs/rewards/dones; rebuild next_obs by shift,
-        dropping the fragment's final (next-obs-less) transition. At
-        episode boundaries the shifted obs is the reset state, which the
-        done-mask removes from the TD target."""
-        obs = np.asarray(frag["obs"])
-        return {"obs": obs[:-1],
-                "actions": np.asarray(frag["actions"])[:-1],
-                "rewards": np.asarray(frag["rewards"],
-                                      dtype=np.float32)[:-1],
-                "next_obs": obs[1:],
-                "dones": np.asarray(frag["dones"], dtype=np.float32)[:-1]}
+        return transitions_from_fragment(frag)
 
     def training_step(self) -> Dict[str, Any]:
         cfg: DQNConfig = self.config  # type: ignore[assignment]

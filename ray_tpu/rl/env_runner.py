@@ -110,6 +110,8 @@ class EnvRunner:
         act_buf = np.empty((N, num_steps) + a_shape, a_dtype)
         rew_buf = np.empty((N, num_steps), np.float32)
         done_buf = np.empty((N, num_steps), np.bool_)
+        term_buf = np.empty((N, num_steps), np.bool_)
+        next_obs_buf = np.empty_like(obs_buf)
         logp_buf = np.empty((N, num_steps), np.float32)
         val_buf = np.empty((N, num_steps), np.float32)
         episode_returns = [[] for _ in range(N)]
@@ -127,6 +129,10 @@ class EnvRunner:
                 self._obs_vec[i] = self._pipeline(raw)
                 rew_buf[i, t] = reward
                 done_buf[i, t] = terminated or truncated
+                # TD consumers need the TRUE successor state (pre-reset)
+                # and termination distinct from time-limit truncation
+                term_buf[i, t] = terminated
+                next_obs_buf[i, t] = self._obs_vec[i]
                 self._episode_returns_vec[i] += reward
                 if terminated or truncated:
                     episode_returns[i].append(
@@ -142,6 +148,7 @@ class EnvRunner:
         return [
             {"obs": obs_buf[i], "actions": act_buf[i],
              "rewards": rew_buf[i], "dones": done_buf[i],
+             "terminated": term_buf[i], "next_obs": next_obs_buf[i],
              "logp": logp_buf[i], "values": val_buf[i],
              "last_value": float(last_vals[i]),
              "episode_returns": episode_returns[i],
@@ -160,6 +167,8 @@ class EnvRunner:
         act_buf = np.empty((num_steps,) + a_shape, a_dtype)
         rew_buf = np.empty(num_steps, np.float32)
         done_buf = np.empty(num_steps, np.bool_)      # episode boundary
+        term_buf = np.empty(num_steps, np.bool_)      # true termination
+        next_obs_buf = np.empty_like(obs_buf)
         logp_buf = np.empty(num_steps, np.float32)
         val_buf = np.empty(num_steps, np.float32)
         episode_returns = []
@@ -181,8 +190,12 @@ class EnvRunner:
             self._obs = self._pipeline(raw)
             rew_buf[t] = reward
             # Truncation treated as termination for GAE (standard
-            # simplification: no next-state bootstrap at the cut).
+            # simplification: no next-state bootstrap at the cut); TD
+            # consumers get the distinct `terminated` flag + the TRUE
+            # (pre-reset) successor state instead.
             done_buf[t] = terminated or truncated
+            term_buf[t] = terminated
+            next_obs_buf[t] = self._obs
             self._episode_return += reward
             if terminated or truncated:
                 episode_returns.append(self._episode_return)
@@ -201,7 +214,8 @@ class EnvRunner:
             _, last_val = np_forward(self._params, self._obs[None])
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+            "dones": done_buf, "terminated": term_buf,
+            "next_obs": next_obs_buf, "logp": logp_buf, "values": val_buf,
             "last_value": float(last_val[0]),
             "episode_returns": episode_returns,
             "weights_version": self._weights_version,

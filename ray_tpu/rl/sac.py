@@ -270,25 +270,16 @@ class SAC(Algorithm):
     def get_weights(self):
         return self.learner.get_weights()
 
-    @staticmethod
-    def _with_next_obs(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        obs = np.asarray(frag["obs"])
-        next_obs = np.empty_like(obs)
-        next_obs[:-1] = obs[1:]
-        next_obs[-1] = obs[-1]  # tail approximation (one step in 256)
-        return {"obs": obs, "actions": np.asarray(frag["actions"]),
-                "rewards": np.asarray(frag["rewards"]),
-                "next_obs": next_obs,
-                "dones": np.asarray(frag["dones"])}
-
     def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rl.dqn import transitions_from_fragment
+
         fragments = self._sample_fragments()
         if not fragments:
             raise RuntimeError("no healthy env runners produced samples")
         returns: List[float] = []
         new_steps = 0
         for f in fragments:
-            self.replay.add_fragment(self._with_next_obs(f))
+            self.replay.add_fragment(transitions_from_fragment(f))
             returns.extend(f["episode_returns"])
             new_steps += len(f["obs"])
         self._env_steps += new_steps

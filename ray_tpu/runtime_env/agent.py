@@ -110,7 +110,11 @@ class RuntimeEnvAgent:
         job manager's agent sharing one session dir) race benignly on the
         rename, and live workers whose cwd is inside a staged dir never
         have it pulled out from under them."""
-        self._check_pip(env.get("pip") or [])
+        reqs = env.get("pip") or []
+        find_links = env.get("pip_find_links") or []
+        if reqs and not find_links:
+            # no package source (zero-egress image): gate on importability
+            self._check_pip(reqs)
         stage = os.path.join(self._root, key)
         ready = os.path.join(stage, ".ready")
         if not os.path.exists(ready):
@@ -122,6 +126,9 @@ class RuntimeEnvAgent:
                     self._stage_path(wd, os.path.join(tmp, "working_dir"))
                 for i, mod in enumerate(env.get("py_modules") or []):
                     self._stage_path(mod, os.path.join(tmp, f"py_module_{i}"))
+                if reqs and find_links:
+                    self._pip_install(env, reqs, find_links,
+                                      os.path.join(tmp, "pylibs"))
                 with open(os.path.join(tmp, ".ready"), "w") as f:
                     f.write(key)
                 try:
@@ -135,6 +142,10 @@ class RuntimeEnvAgent:
                 raise
         ctx = WorkerEnvContext(env_key=key,
                                env_vars=dict(env.get("env_vars") or {}))
+        if reqs and find_links:
+            # pylibs FIRST: installed requirement versions must shadow
+            # system site-packages (the version-isolation guarantee)
+            ctx.pythonpath_prepend.append(os.path.join(stage, "pylibs"))
         if env.get("working_dir") is not None:
             target = os.path.join(stage, "working_dir")
             ctx.cwd = target
@@ -159,6 +170,41 @@ class RuntimeEnvAgent:
         else:
             raise RuntimeEnvError(
                 f"runtime_env path must be a directory or .zip: {src}")
+
+    @staticmethod
+    def _pip_install(env: dict, reqs: List[str], find_links: List[str],
+                     target: str):
+        """Offline dependency isolation (reference plugin:
+        python/ray/_private/runtime_env/pip.py): install from LOCAL
+        wheel/sdist directories into a per-env --target tree that the
+        worker's PYTHONPATH prepends ahead of system site-packages.
+        Version conflicts between envs cannot collide — each env reads
+        its own tree. No venv on purpose (see runtime_env.py docstring)."""
+        import subprocess
+        import sys
+
+        for fl in find_links:
+            if not os.path.isdir(fl):
+                raise RuntimeEnvError(
+                    f"pip_find_links dir does not exist: {fl}")
+        timeout = float((env.get("config") or {})
+                        .get("setup_timeout_seconds", 300.0))
+        cmd = [sys.executable, "-m", "pip", "install", "--no-index",
+               "--disable-pip-version-check", "--quiet",
+               "--target", target]
+        for fl in find_links:
+            cmd += ["--find-links", fl]
+        cmd += list(reqs)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            raise RuntimeEnvError(
+                f"pip install timed out after {timeout:.0f}s") from e
+        if proc.returncode != 0:
+            raise RuntimeEnvError(
+                "pip install failed (offline --no-index install from "
+                f"{find_links}): {proc.stderr.strip()[-800:]}")
 
     @staticmethod
     def _check_pip(reqs: List[str]):

@@ -10,11 +10,22 @@ dict-like with known fields) and the plugin field semantics from
   can import modules shipped alongside the driver.
 - ``py_modules``: list of local module directories / zips, each staged and
   prepended to ``PYTHONPATH``.
-- ``pip``: list of requirement strings. This image has no network egress, so
-  installation is gated: requirements that are already importable are
-  accepted (validated at setup time), anything else raises
-  :class:`RuntimeEnvError` — matching the reference's behavior of failing
-  the task with a RuntimeEnvSetupError when env setup cannot complete.
+- ``pip``: list of requirement strings (reference plugin:
+  ``python/ray/_private/runtime_env/pip.py``). With ``pip_find_links``
+  set, requirements are REALLY installed — ``pip install --no-index
+  --find-links <dirs> --target <staged pylibs>`` — and the staged tree is
+  prepended to the worker's ``PYTHONPATH`` ahead of system site-packages,
+  so two jobs can run CONFLICTING versions of the same package
+  concurrently. Dependency isolation without a per-env virtualenv is a
+  deliberate redesign: a venv swaps the interpreter and forfeits the
+  forkserver warm boot; path-precedence isolation gives the same
+  version-conflict guarantee while env-keyed workers exec a fresh
+  interpreter anyway. Without ``pip_find_links`` (no package source — this
+  image has no network egress), requirements that are already importable
+  are accepted, anything else raises :class:`RuntimeEnvError` — matching
+  the reference's RuntimeEnvSetupError contract.
+- ``pip_find_links``: list of local directories holding wheels/sdists
+  (the offline package source for ``pip``).
 - ``config``: {"setup_timeout_seconds": float} (validation only).
 
 The env hash keys worker pools (reference: worker_pool.h keyed by runtime
@@ -37,7 +48,8 @@ class RuntimeEnvError(Exception):
     fail with this error rather than running in the wrong env."""
 
 
-_KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip", "config"}
+_KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip",
+                 "pip_find_links", "config"}
 
 
 class RuntimeEnv(dict):
@@ -47,6 +59,7 @@ class RuntimeEnv(dict):
                  working_dir: Optional[str] = None,
                  py_modules: Optional[List[str]] = None,
                  pip: Optional[List[str]] = None,
+                 pip_find_links: Optional[List[str]] = None,
                  config: Optional[Dict[str, Any]] = None):
         super().__init__()
         if env_vars:
@@ -57,6 +70,8 @@ class RuntimeEnv(dict):
             self["py_modules"] = list(py_modules)
         if pip:
             self["pip"] = list(pip)
+        if pip_find_links:
+            self["pip_find_links"] = list(pip_find_links)
         if config:
             self["config"] = dict(config)
         validate(self)
@@ -67,6 +82,7 @@ class RuntimeEnv(dict):
             return None
         return cls(env_vars=d.get("env_vars"), working_dir=d.get("working_dir"),
                    py_modules=d.get("py_modules"), pip=d.get("pip"),
+                   pip_find_links=d.get("pip_find_links"),
                    config=d.get("config"))
 
 
@@ -86,6 +102,11 @@ def validate(env: dict) -> None:
     for req in env.get("pip") or []:
         if not isinstance(req, str):
             raise RuntimeEnvError("pip entries must be requirement strings")
+    for fl in env.get("pip_find_links") or []:
+        if not isinstance(fl, str):
+            raise RuntimeEnvError("pip_find_links entries must be path strings")
+    if env.get("pip_find_links") and not env.get("pip"):
+        raise RuntimeEnvError("pip_find_links requires pip requirements")
 
 
 def merge(base: Optional[dict], override: Optional[dict]) -> Optional[dict]:
@@ -133,7 +154,8 @@ def env_hash(env: Optional[dict]) -> Optional[str]:
         v = env[k]
         if k in ("working_dir",) and isinstance(v, str):
             canon[k] = [v, _tree_signature(v)]
-        elif k == "py_modules":
+        elif k in ("py_modules", "pip_find_links"):
+            # a wheel dropped into a find-links dir must yield a fresh env
             canon[k] = [[m, _tree_signature(m)] for m in v]
         else:
             canon[k] = v

@@ -80,28 +80,6 @@ class TestSACLearns:
             algo.stop()
 
 
-class TestAPPOLearns:
-    def test_cartpole_reward_threshold(self, cluster):
-        from ray_tpu.rl.appo import APPOConfig
-
-        algo = (APPOConfig().environment("CartPole-v1").env_runners(2)
-                .training(rollout_fragment_length=128,
-                          train_batch_size=512, seed=2).build())
-        try:
-            best = 0.0
-            for _ in range(28):
-                res = algo.train()
-                m = res["env_runners"]["episode_return_mean"]
-                if np.isfinite(m):
-                    best = max(best, m)
-                if best >= 130.0:
-                    break
-            assert best >= 130.0, f"APPO plateaued at {best}"
-            lm = res["learners"]["default_policy"]
-            assert "mean_ratio" in lm and "kl" in lm
-        finally:
-            algo.stop()
-
     def test_sac_checkpoint_restores_full_learner_state(self, cluster,
                                                         tmp_path):
         """SAC checkpoints must carry critics/targets/α/optimizer state,
@@ -140,6 +118,29 @@ class TestAPPOLearns:
                     fresh.stop()
             finally:
                 algo2.stop()
+        finally:
+            algo.stop()
+
+
+class TestAPPOLearns:
+    def test_cartpole_reward_threshold(self, cluster):
+        from ray_tpu.rl.appo import APPOConfig
+
+        algo = (APPOConfig().environment("CartPole-v1").env_runners(2)
+                .training(rollout_fragment_length=128,
+                          train_batch_size=512, seed=2).build())
+        try:
+            best = 0.0
+            for _ in range(28):
+                res = algo.train()
+                m = res["env_runners"]["episode_return_mean"]
+                if np.isfinite(m):
+                    best = max(best, m)
+                if best >= 130.0:
+                    break
+            assert best >= 130.0, f"APPO plateaued at {best}"
+            lm = res["learners"]["default_policy"]
+            assert "mean_ratio" in lm and "kl" in lm
         finally:
             algo.stop()
 

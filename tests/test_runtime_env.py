@@ -212,3 +212,99 @@ def test_child_task_inherits_parent_env(rt):
         return rt2.get(child.remote(), timeout=60)
 
     assert ray_tpu.get(parent.remote(), timeout=120) == "inherited"
+
+
+def _build_wheel(out_dir, name, version):
+    """Minimal offline wheel: module + dist-info, RECORD included."""
+    import base64
+    import hashlib
+    import zipfile
+
+    tag = "py3-none-any"
+    whl = os.path.join(str(out_dir), f"{name}-{version}-{tag}.whl")
+    di = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": f"__version__ = {version!r}\n",
+        f"{di}/METADATA":
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        f"{di}/WHEEL": ("Wheel-Version: 1.0\nGenerator: rt-test\n"
+                        f"Root-Is-Purelib: true\nTag: {tag}\n"),
+    }
+    record = []
+    for path, content in files.items():
+        digest = base64.urlsafe_b64encode(hashlib.sha256(
+            content.encode()).digest()).rstrip(b"=").decode()
+        record.append(f"{path},sha256={digest},{len(content)}")
+    record.append(f"{di}/RECORD,,")
+    files[f"{di}/RECORD"] = "\n".join(record) + "\n"
+    os.makedirs(str(out_dir), exist_ok=True)
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            zf.writestr(path, content)
+    return whl
+
+
+def test_pip_conflicting_versions_concurrently(rt, tmp_path):
+    """The dependency-isolation capability (reference pip plugin,
+    python/ray/_private/runtime_env/pip.py): two actors whose runtime
+    envs pin CONFLICTING versions of the same package run side by side,
+    each importing its own copy — offline, from local wheel dirs."""
+    wh1 = tmp_path / "wheels_v1"
+    wh2 = tmp_path / "wheels_v2"
+    _build_wheel(wh1, "rtconflict", "1.0")
+    _build_wheel(wh2, "rtconflict", "2.0")
+
+    class VersionProbe:
+        def version(self):
+            import rtconflict
+
+            return rtconflict.__version__
+
+    A1 = ray_tpu.remote(runtime_env={
+        "pip": ["rtconflict==1.0"],
+        "pip_find_links": [str(wh1)]})(VersionProbe)
+    A2 = ray_tpu.remote(runtime_env={
+        "pip": ["rtconflict==2.0"],
+        "pip_find_links": [str(wh2)]})(VersionProbe)
+    a1, a2 = A1.remote(), A2.remote()
+    # both in flight at once: resolve the refs together
+    v1, v2 = ray_tpu.get([a1.version.remote(), a2.version.remote()],
+                         timeout=120)
+    assert (v1, v2) == ("1.0", "2.0")
+    # the envs stay isolated on repeat calls (no cross-pollution)
+    v1b, v2b = ray_tpu.get([a1.version.remote(), a2.version.remote()],
+                           timeout=60)
+    assert (v1b, v2b) == ("1.0", "2.0")
+
+
+def test_pip_offline_install_shadows_system_version(rt, tmp_path):
+    """An installed requirement must shadow the system copy: ship a fake
+    'einops' (a package the base image has) and assert the env's version
+    wins inside the worker."""
+    import einops as system_einops
+
+    wh = tmp_path / "wheels_shadow"
+    _build_wheel(wh, "einops", "0.0.999")
+
+    @ray_tpu.remote(runtime_env={"pip": ["einops==0.0.999"],
+                                 "pip_find_links": [str(wh)]})
+    def probe():
+        import einops
+
+        return einops.__version__
+
+    assert ray_tpu.get(probe.remote(), timeout=120) == "0.0.999"
+    assert getattr(system_einops, "__version__", "") != "0.0.999"
+
+
+def test_pip_missing_wheel_fails_setup(rt, tmp_path):
+    wh = tmp_path / "wheels_empty"
+    os.makedirs(str(wh), exist_ok=True)
+
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-absent==9.9"],
+                                 "pip_find_links": [str(wh)]})
+    def doomed():
+        return 1
+
+    with pytest.raises(Exception, match="pip install failed|RuntimeEnv"):
+        ray_tpu.get(doomed.remote(), timeout=120)
