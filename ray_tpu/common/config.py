@@ -182,6 +182,12 @@ _D("autoscaler_idle_timeout_s", float, 30.0, "idle node termination threshold")
 _D("autoscaler_launch_timeout_s", float, 120.0,
    "drop a launched node that never registers with the GCS within this time")
 
+# --- compiled graphs ---------------------------------------------------------
+_D("pipeline_overlap", bool, True,
+   "overlap channel transfer with stage compute in compiled pipelines:"
+   " prefetch reads one item ahead and write-behind outputs on a writer"
+   " thread (off = strictly sequential read/compute/write per item)")
+
 # --- chaos / testing ---------------------------------------------------------
 _D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep")
 _D("testing_rpc_failure_seed", int, 0, "deterministic chaos seed")

@@ -151,10 +151,19 @@ class DeviceBufferChannel:
                  num_readers: int = 1, _create: bool = True):
         self._ch = ShmChannel(name, capacity, num_readers, _create=_create)
 
+    def _handle(self) -> int:
+        return self._ch._handle()
+
     def write(self, array, timeout_s: float = 60.0) -> None:
         import jax
         import numpy as np
 
+        if not hasattr(array, "shape") or not hasattr(array, "dtype"):
+            # non-array payload (e.g. a pipeline _StageError marker):
+            # pickled fallback so compiled device pipelines can still
+            # shuttle control/error values through the same edge
+            self._ch.write({"pickled": pickle.dumps(array)}, timeout_s)
+            return
         host = np.asarray(jax.device_get(array))
         self._ch.write({"shape": host.shape, "dtype": str(host.dtype),
                         "data": host.tobytes()}, timeout_s)
@@ -164,6 +173,8 @@ class DeviceBufferChannel:
         import numpy as np
 
         msg = self._ch.read(timeout_s)
+        if "pickled" in msg:
+            return pickle.loads(msg["pickled"])
         host = np.frombuffer(
             msg["data"], dtype=msg["dtype"]).reshape(msg["shape"])
         return jax.device_put(host, device) if device is not None \

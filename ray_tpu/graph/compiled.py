@@ -54,11 +54,14 @@ class _PipelineStage:
         allreduces the result across the DAG's collective group
         (reference ``collective_node.py`` lowering), writes the output.
 
-        Input reads run on a PREFETCH thread one item ahead of compute
-        (reference ``ExecutableTask.prepare:579`` overlapped comm): while
-        the method runs on item i, item i+1's channel reads — deserialize
-        + memcpy — proceed concurrently, so per-item cost approaches
-        max(compute, transfer) instead of their sum.
+        Transfer/compute overlap (reference ``ExecutableTask.prepare:579``
+        overlapped comm; gated by config ``pipeline_overlap``): input reads
+        run on a PREFETCH thread one item ahead of compute, and outputs are
+        WRITTEN BEHIND on a writer thread — while the method runs on item
+        i, item i+1's channel reads (deserialize + memcpy) and item i-1's
+        write (serialize + memcpy + downstream wait) proceed concurrently,
+        so per-item cost approaches max(compute, read, write) instead of
+        their sum.
 
         ``in_specs``: ordered arg slots — ("ch", channel) | ("const", v).
         ``collective_spec``: None | (group_name, rank, world, op).
@@ -66,7 +69,10 @@ class _PipelineStage:
         import queue as _q
         import threading as _threading
 
+        from ray_tpu.common.config import GLOBAL_CONFIG
         from ray_tpu.graph.channels import ChannelClosed
+
+        overlap = GLOBAL_CONFIG.get("pipeline_overlap")
 
         fn = getattr(self._inner, method)
         if collective_spec is not None:
@@ -84,33 +90,91 @@ class _PipelineStage:
                 distinct.append(v)
 
         _END = object()
-        prefetch_q: "_q.Queue" = _q.Queue(maxsize=1)  # one item ahead
 
-        def prefetch():
-            while True:
+        def read_inputs():
+            return {id(ch): ch.read(timeout_s=3600.0) for ch in distinct}
+
+        if overlap:
+            prefetch_q: "_q.Queue" = _q.Queue(maxsize=1)  # one item ahead
+
+            def prefetch():
+                while True:
+                    try:
+                        item = read_inputs()
+                    except (ChannelClosed, TimeoutError):
+                        prefetch_q.put(_END)
+                        return
+                    prefetch_q.put(item)
+
+            _threading.Thread(target=prefetch, daemon=True,
+                              name="stage-prefetch").start()
+
+            def next_inputs():
+                return prefetch_q.get()
+        else:
+            def next_inputs():
                 try:
-                    item = {id(ch): ch.read(timeout_s=3600.0)
-                            for ch in distinct}
+                    return read_inputs()
                 except (ChannelClosed, TimeoutError):
-                    prefetch_q.put(_END)
-                    return
-                prefetch_q.put(item)
+                    return _END
 
-        _threading.Thread(target=prefetch, daemon=True,
-                          name="stage-prefetch").start()
+        # Write-behind: one item of output buffering so the downstream wait
+        # overlaps the next compute. On ANY write failure the writer keeps
+        # draining the queue until _END so the compute loop can never wedge
+        # against a dead reader mid-put; a non-close failure is re-raised
+        # from the loop so the loop ref still fails loudly (same surface
+        # as the sequential path).
+        downstream_closed = _threading.Event()
+        writer = None
+        writer_exc: List[BaseException] = []
+        if overlap and out_ch is not None:
+            write_q: "_q.Queue" = _q.Queue(maxsize=1)
+
+            def write_behind():
+                while True:
+                    item = write_q.get()
+                    if item is _END:
+                        return
+                    try:
+                        # long timeout to match the 3600s read side — a
+                        # slow (not dead) downstream must not kill the pipe
+                        out_ch.write(item, timeout_s=3600.0)
+                    except BaseException as e:  # noqa: BLE001
+                        if not isinstance(e, ChannelClosed):
+                            writer_exc.append(e)
+                        downstream_closed.set()
+                        while write_q.get() is not _END:
+                            pass
+                        return
+
+            writer = _threading.Thread(target=write_behind, daemon=True,
+                                       name="stage-writer")
+            writer.start()
+
+            def emit(value) -> bool:
+                if downstream_closed.is_set():
+                    return False
+                write_q.put(value)
+                return True
+        else:
+            def emit(value) -> bool:
+                try:
+                    out_ch.write(value)
+                except ChannelClosed:
+                    return False
+                return True
+
         while True:
-            by_ch = prefetch_q.get()
+            by_ch = next_inputs()
             if by_ch is _END:
                 break
             args = [by_ch[id(v)] if kind == "ch" else v
                     for kind, v in in_specs]
             err = next((a for a in args if isinstance(a, _StageError)), None)
             if err is not None:
-                try:  # propagate an upstream failure to the driver
-                    if out_ch is not None:
-                        out_ch.write(err)
-                except ChannelClosed:
-                    pass
+                # propagate an upstream failure to the driver
+                if out_ch is not None and not emit(err):
+                    break
                 continue
             try:
                 result = fn(*args)
@@ -131,15 +195,21 @@ class _PipelineStage:
             # group needs all ranks), then discards the result.
             if out_ch is None:
                 continue
-            try:
-                out_ch.write(result)
-            except ChannelClosed:
+            if not emit(result):
                 break
+        if writer is not None:
+            write_q.put(_END)
+            # unbounded join: the writer is itself bounded by its 3600s
+            # write timeout, and closing out_ch under an in-flight write
+            # would drop the final item / swallow a late writer exception
+            writer.join()
         try:
             if out_ch is not None:
                 out_ch.close()
         except Exception:  # noqa: BLE001
             pass
+        if writer_exc:
+            raise writer_exc[0]
         return True
 
     def call(self, method: str, *args, **kwargs):
@@ -177,7 +247,8 @@ class _ChannelResult:
 
 class CompiledDAG:
     def __init__(self, root: DAGNode, max_inflight: int = 64,
-                 channels: bool = False, channel_capacity: int = 4 << 20):
+                 channels: bool = False, channel_capacity: int = 4 << 20,
+                 channel_kind: str = "shm"):
         self._root = root
         self._schedule = root._topo()
         self._max_inflight = max_inflight
@@ -189,6 +260,9 @@ class CompiledDAG:
         self._write_seq = 0
         self._read_seq = 0
         self._result_buf: Dict[int, Any] = {}
+        if channel_kind not in ("shm", "device"):
+            raise ValueError(f"unknown channel_kind {channel_kind!r}")
+        self._channel_kind = channel_kind
         if channels:
             self._compile_channel_pipeline(channel_capacity)
         else:
@@ -211,8 +285,11 @@ class CompiledDAG:
         import cloudpickle
 
         import ray_tpu
-        from ray_tpu.graph.channels import ShmChannel
+        from ray_tpu.graph.channels import DeviceBufferChannel, ShmChannel
         from ray_tpu.graph.collective_node import CollectiveOutputNode
+
+        ch_cls = (DeviceBufferChannel if self._channel_kind == "device"
+                  else ShmChannel)
 
         input_node: Optional[InputNode] = None
         stage_nodes: List[ClassMethodNode] = []
@@ -308,8 +385,8 @@ class CompiledDAG:
                 if node is input_node:
                     raise ValueError("no stage consumes the DAG input")
                 continue  # dead stage output: skip the channel
-            ch = ShmChannel(f"/rtch_{tag}_{i}", capacity=capacity,
-                            num_readers=n_readers)
+            ch = ch_cls(f"/rtch_{tag}_{i}", capacity=capacity,
+                        num_readers=n_readers)
             ch._handle()  # create segments before actors open them
             chan_by_producer[id(node)] = ch
             all_channels.append(ch)

@@ -35,6 +35,10 @@ class LlamaPipelineStage:
         self._params = jax.tree.map(jax.numpy.asarray, spec["params"])
         self._first = spec["first"]
         self._last = spec["last"]
+        # device-channel pipelines keep activations as jax.Arrays end to
+        # end (the channel stages to host itself); shm pipelines hand the
+        # exec loop a pickle-friendly np array
+        self._device_out = spec.get("device_out", False)
         self._fn = jax.jit(self._apply)
 
     def _apply(self, params, x):
@@ -65,7 +69,7 @@ class LlamaPipelineStage:
         import jax.numpy as jnp
 
         out = self._fn(self._params, jnp.asarray(x))
-        return np.asarray(out)
+        return out if self._device_out else np.asarray(out)
 
 
 def split_params(params: dict, config, n_stages: int) -> List[dict]:
@@ -98,10 +102,14 @@ def split_params(params: dict, config, n_stages: int) -> List[dict]:
 def build_llama_pipeline(config, params, n_stages: int, *,
                          channels: bool = True,
                          channel_capacity: int = 64 << 20,
+                         channel_kind: str = "shm",
                          stage_options: Optional[dict] = None):
     """Compile an n-stage llama forward pipeline. Returns a CompiledDAG:
     ``dag.execute(tokens).get()`` → logits; in channel mode consecutive
-    ``execute`` calls pipeline across stages."""
+    ``execute`` calls pipeline across stages. ``channel_kind="device"``
+    carries activations as jax.Arrays over DeviceBufferChannels (stage-to-
+    host transfer handled by the channel, re-placed on the reader's
+    device) instead of pickled np arrays."""
     import cloudpickle
 
     import ray_tpu
@@ -115,8 +123,10 @@ def build_llama_pipeline(config, params, n_stages: int, *,
             blob = cloudpickle.dumps({
                 "config": config, "params": shards[s],
                 "first": s == 0, "last": s == n_stages - 1,
+                "device_out": channel_kind == "device",
             })
             opts = dict(stage_options or {})
             node = stage_cls.options(**opts).bind(blob).forward.bind(node)
     return node.experimental_compile(channels=channels,
-                                     channel_capacity=channel_capacity)
+                                     channel_capacity=channel_capacity,
+                                     channel_kind=channel_kind)

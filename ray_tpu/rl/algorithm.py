@@ -35,6 +35,9 @@ class AlgorithmConfig:
     # forward per step; sample() then returns N per-env fragments
     num_envs_per_env_runner: int = 1
     rollout_fragment_length: int = 256
+    # record the true successor state per step in fragments (doubles the
+    # obs payload; off-policy configs turn it on, on-policy never read it)
+    record_next_obs: bool = False
     gamma: float = 0.99
     lr: float = 3e-4
     seed: int = 0
@@ -83,7 +86,8 @@ class Algorithm:
                 connectors=list(config.connectors),
                 num_envs=getattr(config, "num_envs_per_env_runner", 1),
                 module_to_env_connectors=list(
-                    getattr(config, "module_to_env_connectors", ())))
+                    getattr(config, "module_to_env_connectors", ())),
+                record_next_obs=getattr(config, "record_next_obs", False))
             for i in range(config.num_env_runners)
         ]
         self.env_runner_group = FaultTolerantActorManager(actors)

@@ -31,10 +31,16 @@ class EnvRunner:
     def __init__(self, env_spec: Union[str, Any] = "CartPole-v1",
                  seed: int = 0, worker_index: int = 0,
                  connectors=None, num_envs: int = 1,
-                 module_to_env_connectors=None):
+                 module_to_env_connectors=None,
+                 record_next_obs: bool = False):
         from ray_tpu.rl.connectors import ConnectorPipeline
 
         self.num_envs = max(1, num_envs)
+        # Off-policy TD consumers (DQN/SAC replay) need the TRUE successor
+        # state per step; it doubles the fragment's obs payload, so it is
+        # recorded only when the algorithm asks (on-policy GAE/v-trace and
+        # the offline writer never read it).
+        self._record_next_obs = record_next_obs
         # Vectorization (reference rllib/env/vector/): N env copies stepped
         # in lockstep with ONE batched policy forward per step — sampling
         # throughput stops walling on per-env matmul overhead.
@@ -111,7 +117,8 @@ class EnvRunner:
         rew_buf = np.empty((N, num_steps), np.float32)
         done_buf = np.empty((N, num_steps), np.bool_)
         term_buf = np.empty((N, num_steps), np.bool_)
-        next_obs_buf = np.empty_like(obs_buf)
+        next_obs_buf = (np.empty_like(obs_buf) if self._record_next_obs
+                        else None)
         logp_buf = np.empty((N, num_steps), np.float32)
         val_buf = np.empty((N, num_steps), np.float32)
         episode_returns = [[] for _ in range(N)]
@@ -132,7 +139,8 @@ class EnvRunner:
                 # TD consumers need the TRUE successor state (pre-reset)
                 # and termination distinct from time-limit truncation
                 term_buf[i, t] = terminated
-                next_obs_buf[i, t] = self._obs_vec[i]
+                if next_obs_buf is not None:
+                    next_obs_buf[i, t] = self._obs_vec[i]
                 self._episode_returns_vec[i] += reward
                 if terminated or truncated:
                     episode_returns[i].append(
@@ -145,16 +153,19 @@ class EnvRunner:
             last_vals = np.zeros(N, np.float32)
         else:
             _, last_vals = np_forward(self._params, self._obs_vec)
-        return [
-            {"obs": obs_buf[i], "actions": act_buf[i],
-             "rewards": rew_buf[i], "dones": done_buf[i],
-             "terminated": term_buf[i], "next_obs": next_obs_buf[i],
-             "logp": logp_buf[i], "values": val_buf[i],
-             "last_value": float(last_vals[i]),
-             "episode_returns": episode_returns[i],
-             "weights_version": self._weights_version}
-            for i in range(N)
-        ]
+        out = []
+        for i in range(N):
+            frag = {"obs": obs_buf[i], "actions": act_buf[i],
+                    "rewards": rew_buf[i], "dones": done_buf[i],
+                    "terminated": term_buf[i],
+                    "logp": logp_buf[i], "values": val_buf[i],
+                    "last_value": float(last_vals[i]),
+                    "episode_returns": episode_returns[i],
+                    "weights_version": self._weights_version}
+            if next_obs_buf is not None:
+                frag["next_obs"] = next_obs_buf[i]
+            out.append(frag)
+        return out
 
     def _sample_single(self, num_steps: int) -> Dict[str, Any]:
         from ray_tpu.rl.module import (
@@ -168,7 +179,8 @@ class EnvRunner:
         rew_buf = np.empty(num_steps, np.float32)
         done_buf = np.empty(num_steps, np.bool_)      # episode boundary
         term_buf = np.empty(num_steps, np.bool_)      # true termination
-        next_obs_buf = np.empty_like(obs_buf)
+        next_obs_buf = (np.empty_like(obs_buf) if self._record_next_obs
+                        else None)
         logp_buf = np.empty(num_steps, np.float32)
         val_buf = np.empty(num_steps, np.float32)
         episode_returns = []
@@ -195,7 +207,8 @@ class EnvRunner:
             # (pre-reset) successor state instead.
             done_buf[t] = terminated or truncated
             term_buf[t] = terminated
-            next_obs_buf[t] = self._obs
+            if next_obs_buf is not None:
+                next_obs_buf[t] = self._obs
             self._episode_return += reward
             if terminated or truncated:
                 episode_returns.append(self._episode_return)
@@ -212,11 +225,14 @@ class EnvRunner:
             last_val = np.zeros(1, np.float32)
         else:
             _, last_val = np_forward(self._params, self._obs[None])
-        return {
+        frag = {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
             "dones": done_buf, "terminated": term_buf,
-            "next_obs": next_obs_buf, "logp": logp_buf, "values": val_buf,
+            "logp": logp_buf, "values": val_buf,
             "last_value": float(last_val[0]),
             "episode_returns": episode_returns,
             "weights_version": self._weights_version,
         }
+        if next_obs_buf is not None:
+            frag["next_obs"] = next_obs_buf
+        return frag

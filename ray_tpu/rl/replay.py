@@ -1,0 +1,75 @@
+"""Replay utilities shared by the off-policy algorithms (DQN, SAC).
+
+Reference: ``rllib/utils/replay_buffers/`` (buffer) and the
+episode-to-transition conversion the reference does in its off-policy
+learner connector pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def transitions_from_fragment(frag: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Rollout fragment → replayable transitions for off-policy TD.
+
+    Runners record the TRUE successor state per step (``next_obs``,
+    pre-reset at episode boundaries) and a ``terminated`` flag distinct
+    from time-limit truncation — so the TD target bootstraps through
+    truncations from the real final state (gym distinction the reference
+    preserves; a truncated Pendulum episode still has future cost) and is
+    cut only at genuine terminations. Fallback for externally produced
+    fragments without those keys: shift obs for next_obs and drop the
+    fragment's (next-obs-less) tail — never fabricate a self-transition."""
+    obs = np.asarray(frag["obs"])
+    if "next_obs" in frag:
+        dones = np.asarray(frag.get("terminated", frag["dones"]),
+                           dtype=np.float32)
+        return {"obs": obs,
+                "actions": np.asarray(frag["actions"]),
+                "rewards": np.asarray(frag["rewards"], dtype=np.float32),
+                "next_obs": np.asarray(frag["next_obs"]),
+                "dones": dones}
+    dones = np.asarray(frag["dones"], dtype=np.float32)
+    return {"obs": obs[:-1],
+            "actions": np.asarray(frag["actions"])[:-1],
+            "rewards": np.asarray(frag["rewards"], dtype=np.float32)[:-1],
+            "next_obs": obs[1:],
+            "dones": dones[:-1]}
+
+
+class ReplayBuffer:
+    """Uniform ring replay of transitions (numpy, host-side).
+    Reference: ``rllib/utils/replay_buffers/``."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._rng = np.random.default_rng(seed)
+        self._storage: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_fragment(self, frag: Dict[str, np.ndarray]) -> None:
+        """Append a rollout fragment of transitions (obs, actions,
+        rewards, next_obs, dones)."""
+        n = len(frag["obs"])
+        if not self._storage:
+            for k in ("obs", "actions", "rewards", "next_obs", "dones"):
+                v = np.asarray(frag[k])
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            dtype=v.dtype)
+        for k, buf in self._storage.items():
+            v = np.asarray(frag[k])
+            idx = (self._next + np.arange(n)) % self.capacity
+            buf[idx] = v
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self.capacity, self._size + n)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: buf[idx] for k, buf in self._storage.items()}

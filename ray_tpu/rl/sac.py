@@ -21,7 +21,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rl.dqn import ReplayBuffer
+from ray_tpu.rl.replay import ReplayBuffer, transitions_from_fragment
 from ray_tpu.rl.module import (
     LOGSTD_MAX, LOGSTD_MIN, init_continuous_policy_params)
 
@@ -271,8 +271,6 @@ class SAC(Algorithm):
         return self.learner.get_weights()
 
     def training_step(self) -> Dict[str, Any]:
-        from ray_tpu.rl.dqn import transitions_from_fragment
-
         fragments = self._sample_fragments()
         if not fragments:
             raise RuntimeError("no healthy env runners produced samples")
@@ -309,6 +307,7 @@ class SAC(Algorithm):
 class SACConfig(AlgorithmConfig):
     env: Any = "Pendulum-v1"
     lr: float = 3e-4                      # actor
+    record_next_obs: bool = True   # off-policy TD needs true successors
     critic_lr: float = 3e-4
     alpha_lr: float = 3e-4
     tau: float = 0.005

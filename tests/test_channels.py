@@ -178,6 +178,196 @@ def test_device_buffer_channel_two_actor_tp_graph(rt):
     ch.unlink()
 
 
+def test_device_channel_compiled_pipeline(rt):
+    """channel_kind="device": a compiled pipeline whose edges are
+    DeviceBufferChannels — activations travel as arrays (host-staged,
+    re-placed on the reader's device), and non-array control values
+    (errors) still traverse via the pickled fallback."""
+    from ray_tpu.graph.channels import DeviceBufferChannel
+
+    def make_scale():
+        class Scale:
+            def __init__(self, k):
+                self.k = k
+
+            def mul(self, x):
+                import jax.numpy as jnp
+
+                return jnp.asarray(x) * self.k
+
+        return Scale
+
+    Scale = make_scale()
+    nodes = [rt.remote(Scale).bind(2.0), rt.remote(Scale).bind(3.0)]
+    with InputNode() as inp:
+        x = inp
+        for node in nodes:
+            x = node.mul.bind(x)
+    dag = x.experimental_compile(channels=True, channel_kind="device",
+                                 channel_capacity=8 << 20)
+    try:
+        assert all(isinstance(c, DeviceBufferChannel)
+                   for c in dag._channels)
+        payload = np.arange(64, dtype=np.float32).reshape(8, 8)
+        futs = [dag.execute(payload + i) for i in range(3)]
+        for i, f in enumerate(futs):
+            out = np.asarray(f.get(timeout_s=60))
+            np.testing.assert_allclose(out, (payload + i) * 6.0, rtol=1e-6)
+    finally:
+        dag.teardown()
+
+
+def test_device_channel_pipeline_error_propagates(rt):
+    from ray_tpu.graph.compiled import PipelineStageError
+
+    def make_bad():
+        class Bad:
+            def __init__(self, _):
+                pass
+
+            def mul(self, x):
+                raise RuntimeError("device boom")
+
+        return Bad
+
+    nodes = [rt.remote(make_bad()).bind(0)]
+    with InputNode() as inp:
+        x = nodes[0].mul.bind(inp)
+    dag = x.experimental_compile(channels=True, channel_kind="device")
+    try:
+        with pytest.raises(PipelineStageError, match="device boom"):
+            dag.execute(np.ones(4, np.float32)).get(timeout_s=30)
+    finally:
+        dag.teardown()
+
+
+class _OverlapFlag:
+    """Set/restore the pipeline_overlap flag in THIS process (the stage
+    loop below runs in-process, not in a cluster worker)."""
+
+    def __init__(self, value: bool):
+        self.value = value
+
+    def __enter__(self):
+        import os
+
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        self._prev = os.environ.get("RT_pipeline_overlap")
+        os.environ["RT_pipeline_overlap"] = "1" if self.value else "0"
+        GLOBAL_CONFIG.reset_cache()
+
+    def __exit__(self, *exc):
+        import os
+
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        if self._prev is None:
+            os.environ.pop("RT_pipeline_overlap", None)
+        else:
+            os.environ["RT_pipeline_overlap"] = self._prev
+        GLOBAL_CONFIG.reset_cache()
+
+
+def _run_stage_loop(delay_s: float):
+    """Start a _PipelineStage exec loop (in a thread) around a slow
+    compute fn; returns (in_ch, out_ch, thread)."""
+    import threading
+    import uuid
+
+    import cloudpickle
+
+    from ray_tpu.graph.channels import ShmChannel
+    from ray_tpu.graph.compiled import _PipelineStage
+
+    class Slow:
+        def __init__(self, delay):
+            self.delay = delay
+
+        def work(self, x):
+            time.sleep(self.delay)
+            return x
+
+    tag = uuid.uuid4().hex[:8]
+    in_ch = ShmChannel(f"/rtov_i_{tag}", capacity=1 << 20, num_readers=1)
+    out_ch = ShmChannel(f"/rtov_o_{tag}", capacity=1 << 20, num_readers=1)
+    in_ch._handle()
+    out_ch._handle()
+    stage = _PipelineStage(cloudpickle.dumps(Slow), (delay_s,), {})
+    t = threading.Thread(
+        target=stage.run_graph_loop,
+        args=("work", [("ch", in_ch)], out_ch, None), daemon=True)
+    t.start()
+    return in_ch, out_ch, t
+
+
+def _drain_and_close(in_ch, out_ch, n_expected):
+    from ray_tpu.graph.channels import ChannelClosed
+
+    for _ in range(n_expected):
+        out_ch.read(timeout_s=30)
+    in_ch.close()
+    try:
+        out_ch.read(timeout_s=10)  # unblocks the loop's close
+    except (ChannelClosed, TimeoutError):
+        pass
+    for ch in (in_ch, out_ch):
+        ch.unlink()
+
+
+def test_prefetch_overlaps_reads_with_compute():
+    """Reference ``compiled_dag_node.py:579`` overlapped comm, tested
+    deterministically (wall-clock throughput on the 1-core CI box is
+    noise): while the stage computes item 0 (0.5s sleep), the PREFETCH
+    thread must keep consuming the depth-1 input channel — so three
+    writes complete well inside the first compute window. With overlap
+    off, the third write must still be parked behind the uncomsumed
+    second item when the window ends."""
+    with _OverlapFlag(True):
+        in_ch, out_ch, _t = _run_stage_loop(delay_s=0.5)
+        t0 = time.perf_counter()
+        for i in range(3):
+            in_ch.write(i, timeout_s=10.0)
+        took = time.perf_counter() - t0
+        assert took < 0.4, f"prefetch did not drain the channel ({took:.2f}s)"
+        _drain_and_close(in_ch, out_ch, 3)
+
+    with _OverlapFlag(False):
+        in_ch, out_ch, _t = _run_stage_loop(delay_s=0.5)
+        in_ch.write(0, timeout_s=10.0)   # consumed by the blocking read
+        in_ch.write(1, timeout_s=10.0)   # parks in the depth-1 channel
+        with pytest.raises(TimeoutError):
+            in_ch.write(2, timeout_s=0.2)  # nothing prefetches it
+        _drain_and_close(in_ch, out_ch, 2)
+
+
+def test_write_behind_overlaps_writes_with_compute():
+    """With overlap, a stage whose output is not yet consumed still
+    advances to the next compute (result parked with the writer thread);
+    sequentially it stays blocked in the output write."""
+    with _OverlapFlag(True):
+        in_ch, out_ch, _t = _run_stage_loop(delay_s=0.05)
+        for i in range(3):  # compute0 -> writer; compute1 -> write_q; ...
+            in_ch.write(i, timeout_s=10.0)
+        time.sleep(0.6)
+        # nobody has read out_ch, yet items 0 AND 1 are computed: 0 sits
+        # in the writer's pending write, 1 in write_q — so both input
+        # slots were freed and a 4th write succeeds
+        in_ch.write(3, timeout_s=2.0)
+        _drain_and_close(in_ch, out_ch, 4)
+
+    with _OverlapFlag(False):
+        in_ch, out_ch, _t = _run_stage_loop(delay_s=0.05)
+        for i in range(3):
+            in_ch.write(i, timeout_s=10.0)
+        # by 0.6s: out0 written (out channel was empty), loop blocked
+        # writing out1, item2 parked unread in the input channel
+        time.sleep(0.6)
+        with pytest.raises(TimeoutError):
+            in_ch.write(3, timeout_s=0.2)
+        _drain_and_close(in_ch, out_ch, 3)
+
+
 def test_stage_error_propagates_to_driver(rt):
     """A raising stage must surface the error on .get(), not wedge the
     pipeline."""

@@ -65,6 +65,27 @@ class TestPipelineForward:
         finally:
             dag.teardown()
 
+    def test_device_channel_pipeline_matches(self, rt, model):
+        """channel_kind="device": activations cross stages as jax.Arrays
+        over DeviceBufferChannels instead of pickled np arrays."""
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.models.pipeline import build_llama_pipeline
+
+        cfg, params = model
+        tokens = np.asarray(jax.random.randint(
+            jax.random.key(2), (2, 16), 0, cfg.vocab_size), np.int32)
+        want = np.asarray(llama.forward(params, tokens, cfg))
+
+        dag = build_llama_pipeline(cfg, params, n_stages=2,
+                                   channel_kind="device")
+        try:
+            got = np.asarray(dag.execute(tokens).get(timeout_s=180))
+            np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+        finally:
+            dag.teardown()
+
     def test_microbatches_pipeline_through(self, rt, model):
         import jax
 
