@@ -35,6 +35,7 @@ from . import chaos
 _HEADER = struct.Struct("<IB")  # payload length, frame type
 _FRAME_REQ = 1
 _FRAME_RESP = 2
+_FRAME_HELLO = 3  # version handshake (rpc/protocol.py)
 
 # schema.validate, bound on first validated dispatch (schema imports parts
 # of common/ that import this module — a boot-time cycle, not a real dep)
@@ -45,6 +46,11 @@ Address = Tuple[str, int]
 
 class RpcError(RtConnectionError):
     pass
+
+
+class RpcProtocolError(RpcError):
+    """Version negotiation failed — NOT retryable (a peer speaking an
+    incompatible protocol will not heal on reconnect)."""
 
 
 class RemoteMethodError(Exception):
@@ -219,12 +225,38 @@ class RpcServer:
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._conns.add(writer)
         write_lock = asyncio.Lock()
+        # a connection whose first frame is a REQ (no HELLO) is a legacy
+        # peer: served as protocol 1 (rpc/protocol.py rolling-upgrade path)
+        peer_protocol = 1
         try:
             while True:
                 ftype, msg = await _read_frame(reader)
+                if ftype == _FRAME_HELLO:
+                    from ray_tpu.rpc import protocol as _proto
+
+                    from ray_tpu.rpc.schema import SCHEMA_VERSION
+
+                    hello = {"protocol": _proto.PROTOCOL_VERSION,
+                             "min_protocol": _proto.MIN_SUPPORTED_PROTOCOL,
+                             "schema": SCHEMA_VERSION}
+                    try:
+                        peer_protocol = _proto.negotiate(
+                            int(msg.get("protocol", 1)),
+                            int(msg.get("min_protocol", 1)))
+                    except _proto.ProtocolError as e:
+                        hello["error"] = str(e)
+                        async with write_lock:
+                            _write_frame(writer, _FRAME_HELLO, hello)
+                            await writer.drain()
+                        return  # finally: close the incompatible peer
+                    async with write_lock:
+                        _write_frame(writer, _FRAME_HELLO, hello)
+                        await writer.drain()
+                    continue
                 if ftype != _FRAME_REQ:
                     continue
-                self._io.spawn(self._dispatch(msg, writer, write_lock))
+                self._io.spawn(
+                    self._dispatch(msg, writer, write_lock, peer_protocol))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -234,7 +266,8 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock):
+    async def _dispatch(self, msg: dict, writer: asyncio.StreamWriter,
+                        write_lock: asyncio.Lock, peer_protocol: int = 1):
         req_id, method, kwargs = msg["id"], msg["method"], msg["kwargs"]
         start = time.monotonic()
         handler = self._handlers.get(method)
@@ -246,7 +279,10 @@ class RpcServer:
                     global _validate
                     if _validate is None:
                         from ray_tpu.rpc.schema import validate as _validate
-                    kwargs = _validate(method, kwargs)
+                    # the request's own stamp (if any) can only lower the
+                    # connection-negotiated version, never raise it
+                    v = min(peer_protocol, int(msg.get("v", peer_protocol)))
+                    kwargs = _validate(method, kwargs, peer_protocol=v)
                 result = await handler(**kwargs)
                 reply = {"id": req_id, "result": result}
             except Exception as e:  # noqa: BLE001 - handler errors go to caller
@@ -298,6 +334,9 @@ class RpcClient:
         self._ids = itertools.count(1)
         self._conn_lock: Optional[asyncio.Lock] = None
         self._write_lock: Optional[asyncio.Lock] = None
+        self._hello_fut: Optional[asyncio.Future] = None
+        # what this connection speaks after negotiation (protocol.py)
+        self.negotiated_protocol: Optional[int] = None
 
     async def _ensure_connected(self):
         if self._conn_lock is None:
@@ -315,11 +354,53 @@ class RpcClient:
                 raise RpcError(f"connect to {self.address} failed: {e}") from e
             self._writer = writer
             self._io.spawn(self._read_loop(reader))
+            await self._handshake(writer)
+
+    async def _handshake(self, writer: asyncio.StreamWriter):
+        """First frames on the wire: HELLO out, HELLO back (protocol.py).
+        Completes before any request is written."""
+        from ray_tpu.rpc import protocol as _proto
+
+        self._hello_fut = asyncio.get_running_loop().create_future()
+        try:
+            from ray_tpu.rpc.schema import SCHEMA_VERSION
+
+            _write_frame(writer, _FRAME_HELLO,
+                         {"protocol": _proto.PROTOCOL_VERSION,
+                          "min_protocol": _proto.MIN_SUPPORTED_PROTOCOL,
+                          "schema": SCHEMA_VERSION})
+            await writer.drain()
+            hello = await asyncio.wait_for(
+                self._hello_fut, GLOBAL_CONFIG.get("rpc_connect_timeout_s"))
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            self._fail_all(RpcError(f"handshake with {self.address} failed"))
+            raise RpcError(
+                f"handshake with {self.address} failed: {e}") from e
+        finally:
+            self._hello_fut = None
+        if "error" in hello:
+            self._fail_all(RpcProtocolError(str(hello["error"])))
+            raise RpcProtocolError(
+                f"protocol negotiation with {self.address} failed: "
+                f"{hello['error']}")
+        try:
+            self.negotiated_protocol = _proto.negotiate(
+                int(hello.get("protocol", 1)),
+                int(hello.get("min_protocol", 1)))
+        except _proto.ProtocolError as e:
+            raise RpcProtocolError(
+                f"protocol negotiation with {self.address} failed: {e}"
+            ) from e
 
     async def _read_loop(self, reader: asyncio.StreamReader):
         try:
             while True:
-                _, msg = await _read_frame(reader)
+                ftype, msg = await _read_frame(reader)
+                if ftype == _FRAME_HELLO:
+                    fut = self._hello_fut
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                    continue
                 fut = self._pending.pop(msg["id"], None)
                 if fut is not None and not fut.done():
                     if "error" in msg:
@@ -339,6 +420,9 @@ class RpcClient:
 
     def _fail_all(self, exc: Exception):
         self._writer = None
+        hello = self._hello_fut
+        if hello is not None and not hello.done():
+            hello.set_exception(exc)
         pending, self._pending = self._pending, {}
         for fut in pending.values():
             if not fut.done():
@@ -358,7 +442,10 @@ class RpcClient:
                 self._pending.pop(req_id, None)
                 raise RpcError(f"connection to {self.address} lost before write")
             try:
-                _write_frame(writer, _FRAME_REQ, {"id": req_id, "method": method, "kwargs": kwargs})
+                _write_frame(writer, _FRAME_REQ,
+                             {"id": req_id, "method": method,
+                              "kwargs": kwargs,
+                              "v": self.negotiated_protocol or 1})
                 await writer.drain()
             except (ConnectionError, OSError) as e:
                 self._pending.pop(req_id, None)
@@ -414,6 +501,8 @@ class RetryableRpcClient:
         while True:
             try:
                 return await self._client.call_async(method, timeout=timeout, **kwargs)
+            except RpcProtocolError:
+                raise  # version mismatch will not heal on reconnect
             except (RpcError, chaos.RpcChaosError) as e:
                 attempt += 1
                 if attempt >= self._max_attempts:

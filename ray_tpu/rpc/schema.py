@@ -35,10 +35,15 @@ class Field:
     # a type, tuple of types, or None for "any pickled value"
     type: Union[Type, Tuple[Type, ...], None]
     required: bool = True
+    # protocol version (rpc/protocol.py) that introduced the field: a
+    # required field is only ENFORCED against peers new enough to know it
+    # — the rolling-upgrade contract protobuf gets from field numbers
+    since: int = 1
 
-    def check(self, method: str, kwargs: Dict[str, Any]) -> None:
+    def check(self, method: str, kwargs: Dict[str, Any],
+              peer_protocol: int = 1_000_000) -> None:
         if self.name not in kwargs:
-            if self.required:
+            if self.required and peer_protocol >= self.since:
                 raise SchemaError(
                     f"{method}: missing required field {self.name!r}")
             return
@@ -59,13 +64,16 @@ class Message:
     fields: Tuple[Field, ...]
     allow_unknown: bool = True
 
-    def validate(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    def validate(self, kwargs: Dict[str, Any],
+                 peer_protocol: int = 1_000_000) -> Dict[str, Any]:
         """Check and return the kwargs to dispatch. Unknown fields are
         STRIPPED (not just tolerated) when allowed: handlers don't take
         **kwargs, so passing a newer client's extra fields through would
-        crash the handler and void the rolling-upgrade guarantee."""
+        crash the handler and void the rolling-upgrade guarantee.
+        ``peer_protocol`` relaxes required fields newer than the peer
+        (``Field.since``)."""
         for f in self.fields:
-            f.check(self.name, kwargs)
+            f.check(self.name, kwargs, peer_protocol)
         known = {f.name for f in self.fields}
         unknown = set(kwargs) - known
         if not unknown:
@@ -161,11 +169,13 @@ RPC_SCHEMAS: Dict[str, Message] = {
 }
 
 
-def validate(method: str, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+def validate(method: str, kwargs: Dict[str, Any],
+             peer_protocol: int = 1_000_000) -> Dict[str, Any]:
     """Check a request against the wire contract and return the kwargs to
     dispatch (unknown fields stripped); pass-through for methods without
-    a declared schema."""
+    a declared schema. ``peer_protocol`` is the connection-negotiated
+    version of the requesting peer (rpc/protocol.py)."""
     schema = RPC_SCHEMAS.get(method)
     if schema is None:
         return kwargs
-    return schema.validate(kwargs)
+    return schema.validate(kwargs, peer_protocol)

@@ -1,0 +1,161 @@
+"""Versioned wire protocol (rpc/protocol.py): HELLO negotiation, legacy
+peers, per-field ``since`` gating, non-retryable mismatches.
+
+Reference: the reference pins its wire contract in
+``src/ray/protobuf/*.proto``; here the contract is the protocol version +
+handshake + schema table, and these tests are the cross-version suite."""
+
+import pickle
+import socket
+import struct
+
+import pytest
+
+from ray_tpu.rpc import protocol as proto
+from ray_tpu.rpc.rpc import (
+    RpcClient,
+    RpcProtocolError,
+    RpcServer,
+    RetryableRpcClient,
+)
+
+_HEADER = struct.Struct("<IB")
+
+
+@pytest.fixture()
+def server():
+    srv = RpcServer()
+
+    async def echo(**kwargs):
+        return kwargs
+
+    async def typed(task_id=None, force=None):
+        return {"task_id": task_id, "force": force}
+
+    srv.register("echo", echo)
+    srv.register("cancel_running_task", typed)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _raw_roundtrip(addr, frames, read_n=1, timeout=10.0):
+    """Minimal wire peer: send pre-built frames, read ``read_n`` back."""
+    s = socket.create_connection(addr, timeout=timeout)
+    try:
+        for ftype, msg in frames:
+            body = pickle.dumps(msg)
+            s.sendall(_HEADER.pack(len(body), ftype) + body)
+        out = []
+        buf = b""
+        while len(out) < read_n:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+            while len(buf) >= _HEADER.size:
+                length, ftype = _HEADER.unpack(buf[:_HEADER.size])
+                if len(buf) < _HEADER.size + length:
+                    break
+                body = buf[_HEADER.size:_HEADER.size + length]
+                buf = buf[_HEADER.size + length:]
+                out.append((ftype, pickle.loads(body)))
+        return out
+    finally:
+        s.close()
+
+
+class TestNegotiate:
+    def test_symmetric_min(self):
+        assert proto.negotiate(proto.PROTOCOL_VERSION, 1) == \
+            proto.PROTOCOL_VERSION
+        # an older (still-supported) peer pins the conversation down
+        assert proto.negotiate(1, 1) == 1
+
+    def test_peer_too_old(self, monkeypatch):
+        monkeypatch.setattr(proto, "MIN_SUPPORTED_PROTOCOL", 2)
+        with pytest.raises(proto.ProtocolError, match="below"):
+            proto.negotiate(1, 1)
+
+    def test_self_too_old_for_peer(self):
+        with pytest.raises(proto.ProtocolError, match="minimum"):
+            proto.negotiate(proto.PROTOCOL_VERSION + 5,
+                            proto.PROTOCOL_VERSION + 5)
+
+
+class TestHandshake:
+    def test_client_negotiates_current_version(self, server):
+        c = RpcClient(server.address)
+        assert c.call("echo", x=1) == {"x": 1}
+        assert c.negotiated_protocol == proto.PROTOCOL_VERSION
+        c.close()
+
+    def test_legacy_peer_without_hello_is_served(self, server):
+        """A peer predating the handshake opens with a bare REQ and must
+        still be answered (served at protocol 1)."""
+        frames = [(1, {"id": 7, "method": "echo", "kwargs": {"a": 2}})]
+        [(ftype, msg)] = _raw_roundtrip(server.address, frames)
+        assert ftype == 2 and msg == {"id": 7, "result": {"a": 2}}
+
+    def test_incompatible_hello_rejected_and_closed(self, server):
+        frames = [(3, {"protocol": 0, "min_protocol": 0})]
+        out = _raw_roundtrip(server.address, frames, read_n=1)
+        assert out and out[0][0] == 3 and "error" in out[0][1]
+        # the server reports its own versions so the peer can log them
+        assert out[0][1]["protocol"] == proto.PROTOCOL_VERSION
+
+    def test_hello_reply_carries_versions(self, server):
+        frames = [(3, {"protocol": proto.PROTOCOL_VERSION,
+                       "min_protocol": 1})]
+        [(ftype, msg)] = _raw_roundtrip(server.address, frames)
+        assert ftype == 3
+        assert msg["protocol"] == proto.PROTOCOL_VERSION
+        assert msg["min_protocol"] == proto.MIN_SUPPORTED_PROTOCOL
+        assert "schema" in msg
+
+    def test_protocol_error_not_retried(self, server, monkeypatch):
+        """RetryableRpcClient must fail a version mismatch immediately —
+        reconnecting cannot heal it."""
+        import time
+
+        monkeypatch.setattr(proto, "MIN_SUPPORTED_PROTOCOL", 99)
+        c = RetryableRpcClient(server.address, deadline_s=30.0)
+        t0 = time.monotonic()
+        with pytest.raises(RpcProtocolError, match="negotiation"):
+            c.call("echo", x=1)
+        assert time.monotonic() - t0 < 5.0, "mismatch was retried"
+        c.close()
+
+
+class TestSinceGating:
+    def test_new_required_field_relaxed_for_old_peer(self):
+        from ray_tpu.rpc.schema import Field, Message, SchemaError
+
+        msg = Message("m", (Field("a", int, required=True, since=1),
+                            Field("b", int, required=True, since=2)))
+        # v1 peer doesn't know "b": accepted without it
+        assert msg.validate({"a": 1}, peer_protocol=1) == {"a": 1}
+        # v2 peer must send it
+        with pytest.raises(SchemaError, match="'b'"):
+            msg.validate({"a": 1}, peer_protocol=2)
+        # when present it is still type-checked, whatever the peer
+        with pytest.raises(SchemaError, match="expects"):
+            msg.validate({"a": 1, "b": "no"}, peer_protocol=1)
+
+    def test_server_applies_peer_version_to_dispatch(self, server):
+        """cancel_running_task requires task_id; a LEGACY (no-hello) peer
+        omitting it is ... still rejected, because task_id is a since=1
+        field — but the same envelope with an unknown extra field is
+        stripped, not crashed, for any version."""
+        frames = [(1, {"id": 1, "method": "cancel_running_task",
+                       "kwargs": {"task_id": b"t", "later_field": 1}})]
+        [(_, msg)] = _raw_roundtrip(server.address, frames)
+        assert msg["result"] == {"task_id": b"t", "force": None}
+
+    def test_request_stamp_cannot_raise_version(self, server):
+        """A request claiming a NEWER "v" than the connection negotiated
+        must not unlock newer-field enforcement (min() in dispatch)."""
+        frames = [(1, {"id": 1, "method": "echo", "kwargs": {},
+                       "v": 999})]
+        [(_, msg)] = _raw_roundtrip(server.address, frames)
+        assert msg == {"id": 1, "result": {}}
