@@ -1,27 +1,91 @@
-"""GCS client — typed accessors (reference: gcs/gcs_client/gcs_client.h, accessor.h)."""
+"""GCS client — typed accessors (reference: gcs/gcs_client/gcs_client.h, accessor.h).
+
+Failover: pass ``standby_addresses`` (or set ``RT_GCS_STANDBY_ADDRS`` to a
+comma-separated ``host:port`` list — the env route is how raylets and
+workers inherit it without plumbing) and the client rotates to the next
+address when the current one stays dead past the per-address retry
+deadline. See :mod:`ray_tpu.gcs.failover`.
+"""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.rpc.pubsub import Subscriber
-from ray_tpu.rpc.rpc import RetryableRpcClient
+from ray_tpu.rpc.rpc import RetryableRpcClient, RpcError
+from ray_tpu.common.status import RtTimeoutError
+
+
+def _standby_addresses_from_env() -> List[Tuple[str, int]]:
+    raw = os.environ.get("RT_GCS_STANDBY_ADDRS", "")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host, int(port)))
+    return out
 
 
 class GcsClient:
-    def __init__(self, address: Tuple[str, int], client_id: Optional[str] = None):
+    def __init__(self, address: Tuple[str, int],
+                 client_id: Optional[str] = None,
+                 standby_addresses: Sequence[Tuple[str, int]] = ()):
         self.address = tuple(address)
-        self._rpc = RetryableRpcClient(self.address)
+        self.addresses = [self.address]
+        for a in list(standby_addresses) or _standby_addresses_from_env():
+            a = tuple(a)
+            if a not in self.addresses:
+                self.addresses.append(a)
+        self._addr_i = 0
+        # multi-address clients fail over instead of retrying one dead
+        # address for the full reconnect window
+        deadline = 15.0 if len(self.addresses) > 1 else None
+        self._deadline_s = deadline
+        self._rpc = RetryableRpcClient(self.address, deadline_s=deadline)
         self._subscriber: Optional[Subscriber] = None
         self._client_id = client_id or f"client-{id(self):x}"
 
+    def _rotate(self):
+        self._addr_i = (self._addr_i + 1) % len(self.addresses)
+        self.address = self.addresses[self._addr_i]
+        self._rpc.close()
+        self._rpc = RetryableRpcClient(self.address,
+                                       deadline_s=self._deadline_s)
+        if self._subscriber is not None:
+            try:
+                self._subscriber.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._subscriber = None
+
     # -- async passthrough for in-loop callers --
     async def call_async(self, method: str, **kwargs):
-        return await self._rpc.call_async(method, **kwargs)
+        last: Optional[Exception] = None
+        for _ in range(len(self.addresses)):
+            try:
+                return await self._rpc.call_async(method, **kwargs)
+            except (RtTimeoutError, RpcError) as e:
+                last = e
+                if len(self.addresses) == 1:
+                    raise
+                self._rotate()
+        raise last  # type: ignore[misc]
 
     def call(self, method: str, **kwargs):
-        return self._rpc.call(method, **kwargs)
+        last: Optional[Exception] = None
+        for _ in range(len(self.addresses)):
+            try:
+                return self._rpc.call(method, **kwargs)
+            except (RtTimeoutError, RpcError) as e:
+                last = e
+                if len(self.addresses) == 1:
+                    raise
+                self._rotate()
+        raise last  # type: ignore[misc]
 
     @property
     def subscriber(self) -> Subscriber:

@@ -1,0 +1,166 @@
+"""Warm-standby GCS failover.
+
+Reference: the reference keeps GCS state in Redis
+(``src/ray/gcs/store_client/redis_store_client.h``) so a restarted or
+replacement GCS process recovers from the external store, and raylets
+re-attach via ``NotifyGCSRestart``. This image has no Redis, so the same
+availability contract is built from the pieces we do have:
+
+- the primary's :class:`~ray_tpu.gcs.storage.GcsTableStorage` append log
+  is SHIPPED to the standby over the ``fetch_table_log`` RPC (pull-based,
+  generation-aware so compactions restart the stream);
+- the standby probes the primary; after ``failure_threshold`` missed
+  polls it PROMOTES: a full :class:`GcsServer` boots from the replicated
+  log on the standby's pre-announced address and runs the normal
+  restart-reconcile path (raylets re-register, actors re-claimed);
+- clients/raylets/workers reach the new leader because
+  :class:`~ray_tpu.gcs.client.GcsClient` rotates through
+  ``RT_GCS_STANDBY_ADDRS`` (comma-separated ``host:port``) when the
+  current address stays dead.
+
+Replication is asynchronous (like Redis async replication): mutations in
+the last unpolled window can be lost on failover. Everything the
+restart-reconcile path cannot re-derive is re-registered by the raylets
+themselves, exactly as after an in-place GCS restart.
+
+Before promotion the standby answers only ``standby_info`` /
+``health_check`` on its address; any real GCS method returns a loud
+"unknown method" error, which a rotating client treats as "not the
+leader yet" and moves on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+from ray_tpu.rpc.rpc import RetryableRpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class GcsStandby:
+    """Tail the primary's table log; promote to a full GcsServer when the
+    primary stops answering."""
+
+    def __init__(self, primary_address: Tuple[str, int], replica_dir: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 poll_interval_s: float = 0.5,
+                 failure_threshold: int = 4):
+        self.primary_address = tuple(primary_address)
+        self.replica_dir = replica_dir
+        os.makedirs(replica_dir, exist_ok=True)
+        self._log_path = os.path.join(replica_dir, "gcs_tables.log")
+        self._poll_interval_s = poll_interval_s
+        self._failure_threshold = failure_threshold
+        self._offset = 0
+        self._generation: Optional[int] = None
+        self._failures = 0
+        self._stop = threading.Event()
+        self.promoted = threading.Event()
+        self.server = None  # the promoted GcsServer
+        # placeholder server pins the standby's address pre-promotion
+        self._placeholder = RpcServer(host, port, validate_schemas=False)
+
+        async def standby_info():
+            return {"standby": True, "primary": self.primary_address,
+                    "replicated_bytes": self._offset}
+
+        async def health_check():
+            return True
+
+        self._placeholder.register("standby_info", standby_info)
+        self._placeholder.register("health_check", health_check)
+        self._placeholder.start()
+        self.address = self._placeholder.address
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gcs-standby")
+
+    def start(self) -> "GcsStandby":
+        self._thread.start()
+        return self
+
+    # ------------------------------------------------------------ replication
+    def _run(self):
+        # fresh replica: drop any stale log from a previous incarnation
+        if os.path.exists(self._log_path):
+            os.unlink(self._log_path)
+        log = open(self._log_path, "ab")
+        client = RetryableRpcClient(self.primary_address, deadline_s=2.0)
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = client.call("fetch_table_log", timeout=5.0,
+                                        offset=self._offset,
+                                        generation=self._generation)
+                    self._failures = 0
+                    if chunk.get("unsupported"):
+                        logger.warning(
+                            "primary GCS has no persistence; standby can "
+                            "only fail over to an empty control plane")
+                    elif chunk.get("restart"):
+                        # primary compacted: restart the stream
+                        log.close()
+                        log = open(self._log_path, "wb")
+                        self._offset = 0
+                        self._generation = chunk["generation"]
+                        continue  # refetch immediately from 0
+                    else:
+                        self._generation = chunk["generation"]
+                        data = chunk.get("data") or b""
+                        if data:
+                            log.write(data)
+                            log.flush()
+                            self._offset += len(data)
+                            if len(data) == (1 << 20):
+                                continue  # more buffered: drain fast
+                except Exception:  # noqa: BLE001 — probe failure
+                    self._failures += 1
+                    logger.info("standby: primary probe failed (%d/%d)",
+                                self._failures, self._failure_threshold)
+                    if self._failures >= self._failure_threshold:
+                        log.close()
+                        self._promote()
+                        return
+                self._stop.wait(self._poll_interval_s)
+        finally:
+            client.close()
+            if not log.closed:
+                log.close()
+
+    # -------------------------------------------------------------- promotion
+    def _promote(self):
+        from ray_tpu.gcs.server import GcsServer
+
+        host, port = self.address
+        logger.warning("standby promoting to GCS leader on %s:%d (replica "
+                       "log: %d bytes)", host, port, self._offset)
+        # free the pinned port, then boot the real control plane on it
+        self._placeholder.stop()
+        deadline = time.monotonic() + 30.0
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self.server = GcsServer(host, port,
+                                        persist_dir=self.replica_dir)
+                self.server.start()
+                break
+            except OSError as e:  # port not yet released
+                last = e
+                time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                f"standby could not bind {host}:{port}: {last}")
+        self.promoted.set()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+        if self.server is not None:
+            self.server.stop()
+        elif self._placeholder is not None:
+            self._placeholder.stop()

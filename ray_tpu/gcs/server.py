@@ -277,7 +277,7 @@ class GcsServer:
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
             "add_task_events", "get_task_events",
             "get_system_config", "health_check", "debug_state",
-            "publish_worker_log",
+            "publish_worker_log", "fetch_table_log",
         ):
             s.register(name, getattr(self, f"h_{name}"))
 
@@ -948,6 +948,16 @@ class GcsServer:
 
     async def h_health_check(self):
         return True
+
+    async def h_fetch_table_log(self, offset: int = 0,
+                                generation: Optional[int] = None,
+                                max_bytes: int = 1 << 20):
+        """Log-shipping endpoint for a warm standby (gcs/failover.py).
+        Reference role: Redis replication under the reference's
+        redis_store_client.h-backed GCS FT."""
+        if self.storage is None:
+            return {"unsupported": True}
+        return self.storage.read_chunk(offset, generation, max_bytes)
 
     def _kick_pending(self):
         """Retry pending actors/PGs (resources may have freed up)."""

@@ -36,6 +36,9 @@ class GcsTableStorage:
         self._lock = threading.Lock()
         self._tables: Dict[str, Dict[bytes, dict]] = {}
         self._ops = 0
+        # bumped on every compaction: a replica streaming the log by byte
+        # offset must restart from 0 when the file is rewritten under it
+        self._generation = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
             self._replay()
@@ -94,6 +97,7 @@ class GcsTableStorage:
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
         self._ops = sum(len(t) for t in self._tables.values())
+        self._generation += 1
 
     def put(self, table: str, key: bytes, value: dict) -> None:
         with self._lock:
@@ -120,6 +124,24 @@ class GcsTableStorage:
             self._log.close()
             self._compact_locked()
             self._log = open(self._path, "ab")
+
+    def read_chunk(self, offset: int = 0, generation: Optional[int] = None,
+                   max_bytes: int = 1 << 20) -> dict:
+        """Log-shipping read for a warm standby (gcs/failover.py): bytes
+        from ``offset``, or a restart marker when the log was compacted
+        since the replica's ``generation``. Every put/delete flushes, so
+        the file is frame-aligned at all times."""
+        with self._lock:
+            if generation is not None and generation != self._generation:
+                return {"generation": self._generation, "restart": True}
+            try:
+                with open(self._path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(max_bytes)
+            except OSError:
+                data = b""
+            return {"generation": self._generation, "offset": offset,
+                    "data": data}
 
     def get(self, table: str, key: bytes) -> Optional[dict]:
         with self._lock:

@@ -1,0 +1,202 @@
+"""Warm-standby GCS failover (gcs/failover.py): log shipping, promotion
+on primary death, client address rotation.
+
+Reference contract being matched: Redis-backed GCS FT
+(src/ray/gcs/store_client/redis_store_client.h) — losing the GCS process
+must not require a manual restart to get a control plane back."""
+
+import time
+
+import pytest
+
+from ray_tpu.gcs.client import GcsClient
+from ray_tpu.gcs.failover import GcsStandby
+from ray_tpu.gcs.server import GcsServer
+
+
+def _wait(cond, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def primary(tmp_path):
+    srv = GcsServer(persist_dir=str(tmp_path / "primary"))
+    srv.start()
+    yield srv
+    try:
+        srv.stop()
+    except Exception:  # may already be stopped by the test
+        pass
+
+
+def test_log_ships_to_standby(primary, tmp_path):
+    c = GcsClient(primary.address)
+    c.kv_put("ns", b"k1", b"v1")
+    c.kv_put("ns", b"k2", b"v2")
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1).start()
+    try:
+        import os
+        import shutil
+
+        primary_size = os.path.getsize(primary.storage._path)
+        _wait(lambda: sb._offset >= primary_size, msg="replication caught up")
+        # the replica log replays to the same state
+        from ray_tpu.gcs.storage import GcsTableStorage
+
+        shutil.copyfile(sb._log_path, sb._log_path + ".copy")
+        replayed = GcsTableStorage(sb._log_path + ".copy")
+        kv = replayed.all("kv")
+        assert any(b"k1" in k for k in kv), kv.keys()
+        assert any(b"k2" in k for k in kv), kv.keys()
+        replayed.close()
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_standby_promotes_on_primary_death(primary, tmp_path):
+    c = GcsClient(primary.address)
+    c.kv_put("ns", b"durable", b"yes")
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    try:
+        _wait(lambda: sb._offset > 0, msg="replication")
+        primary.stop()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        # the promoted server answers real GCS RPCs with replicated state
+        c2 = GcsClient(sb.address)
+        assert c2.kv_get("ns", b"durable") == b"yes"
+        c2.kv_put("ns", b"post", b"failover")
+        assert c2.kv_get("ns", b"post") == b"failover"
+        c2.close()
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_client_rotates_to_promoted_standby(primary, tmp_path):
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    c = GcsClient(primary.address, standby_addresses=[sb.address])
+    try:
+        c.kv_put("ns", b"k", b"v")
+        _wait(lambda: sb._offset > 0, msg="replication")
+        primary.stop()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        # same client object: the call fails over to the new leader
+        assert c.kv_get("ns", b"k") == b"v"
+        assert c.address == sb.address
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_env_var_standby_wiring(primary, tmp_path, monkeypatch):
+    """RT_GCS_STANDBY_ADDRS is how raylets/workers inherit failover
+    without constructor plumbing."""
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    host, port = sb.address
+    monkeypatch.setenv("RT_GCS_STANDBY_ADDRS", f"{host}:{port}")
+    c = GcsClient(primary.address)
+    try:
+        assert len(c.addresses) == 2
+        c.kv_put("ns", b"e", b"1")
+        _wait(lambda: sb._offset > 0, msg="replication")
+        primary.stop()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        assert c.kv_get("ns", b"e") == b"1"
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_unpromoted_standby_reports_state(primary, tmp_path):
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1).start()
+    try:
+        from ray_tpu.rpc.rpc import RetryableRpcClient
+
+        probe = RetryableRpcClient(sb.address, deadline_s=5.0)
+        info = probe.call("standby_info", timeout=10.0)
+        assert info["standby"] is True
+        assert tuple(info["primary"]) == primary.address
+        probe.close()
+    finally:
+        sb.stop()
+
+
+def test_raylet_rejoins_promoted_standby(tmp_path, monkeypatch):
+    """End to end: a raylet outlives its GCS, the standby promotes on the
+    standby's own (env-announced) address, and the raylet's rotating
+    GcsClient re-registers the node there — tasks run again with NO
+    manual restart (the availability bar the reference meets with
+    Redis-backed GCS + NotifyGCSRestart)."""
+    import socket
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.common.config import GLOBAL_CONFIG
+
+    # reserve a port for the standby BEFORE the cluster exists, so the
+    # raylet's GcsClient (built during Cluster()) can learn it from env
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    sb_port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("RT_GCS_STANDBY_ADDRS", f"127.0.0.1:{sb_port}")
+    GLOBAL_CONFIG.set_system_config_value("gcs_restart_reconcile_delay_s", 1.0)
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2},
+                persist_dir=str(tmp_path / "primary"))
+    sb = GcsStandby(c.gcs.address, str(tmp_path / "replica"),
+                    host="127.0.0.1", port=sb_port,
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    try:
+        assert c.wait_for_nodes(1)
+        _wait(lambda: sb._offset >= 0 and sb._failures == 0,
+              msg="standby attached")
+        c.kill_gcs()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        # raylet report loop rotates to the standby and re-registers
+        probe = GcsClient(sb.address)
+        _wait(lambda: any(n["alive"] for n in probe.get_all_nodes()),
+              timeout=30.0, msg="raylet re-registration")
+        probe.close()
+    finally:
+        sb.stop()
+        c.shutdown()
+        GLOBAL_CONFIG.set_system_config_value(
+            "gcs_restart_reconcile_delay_s", 2.0)
+
+
+def test_compaction_restarts_replication(primary, tmp_path):
+    """When the primary compacts its log, the standby restarts the
+    stream from offset 0 of the new generation instead of appending
+    garbage at a stale offset."""
+    c = GcsClient(primary.address)
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.05).start()
+    try:
+        c.kv_put("ns", b"a", b"1")
+        _wait(lambda: sb._offset > 0, msg="initial replication")
+        # force a compaction under the replica's feet
+        primary.storage._COMPACT_MIN_OPS = 1
+        for i in range(30):
+            c.kv_put("ns", b"hot", str(i).encode())
+        _wait(lambda: sb._generation is not None and sb._generation > 0,
+              msg="generation bump observed")
+        primary.stop()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        c2 = GcsClient(sb.address)
+        assert c2.kv_get("ns", b"a") == b"1"
+        assert c2.kv_get("ns", b"hot") is not None
+        c2.close()
+    finally:
+        sb.stop()
+        c.close()
