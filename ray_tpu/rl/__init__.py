@@ -41,6 +41,15 @@ from ray_tpu.rl.multi_agent import (  # noqa: F401
     MultiAgentPPO,
     MultiAgentPPOConfig,
 )
+from ray_tpu.rl.cql import (  # noqa: F401
+    CQL,
+    CQLConfig,
+    CQLLearner,
+)
+from ray_tpu.rl.marwil import (  # noqa: F401
+    MARWIL,
+    MARWILConfig,
+)
 from ray_tpu.rl.offline import (  # noqa: F401
     BC,
     BCConfig,

@@ -1,0 +1,158 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Reference: ``rllib/algorithms/cql/cql.py`` (CQL(H) on top of SAC: the
+twin critics additionally minimize a conservative regularizer
+``logsumexp_a Q(s, a) - Q(s, a_data)`` so out-of-distribution actions
+cannot carry inflated values — the failure mode of running plain SAC on
+a fixed dataset).
+
+TPU framing: :class:`CQLLearner` is :class:`SACLearner` with the
+``_conservative_penalty`` hook filled in — one jitted step; the OOD
+action fan-out (N uniform + policy + next-policy samples per state) is a
+single batched Q forward, so the penalty rides the MXU with the rest of
+the update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+from ray_tpu.rl.replay import ReplayBuffer, transitions_from_fragment
+from ray_tpu.rl.offline import JsonReader
+from ray_tpu.rl.sac import SACLearner
+
+
+class CQLLearner(SACLearner):
+    def __init__(self, *args, cql_alpha: float = 1.0,
+                 cql_n_actions: int = 4, **kwargs):
+        self.cql_alpha = cql_alpha
+        self.cql_n_actions = cql_n_actions
+        super().__init__(*args, **kwargs)
+
+    def _conservative_penalty(self, qs, actor, batch, key):
+        """logsumexp over {uniform, pi(s), pi(s')} actions minus the
+        dataset action's Q, per critic (CQL(H), ``cql.py`` cql_loss)."""
+        import jax
+        import jax.numpy as jnp
+
+        p1, p2 = qs
+        qf, sample = self._q_forward, self._sample_squashed
+        obs = batch["obs"]
+        n, d = obs.shape[0], self.action_dim
+        scale = actor["action_scale"]
+        k_rand, k_pi, k_pin = jax.random.split(key, 3)
+        samples = []  # each: (q1_vals, q2_vals) of shape (n,)
+        rand = jax.random.uniform(
+            k_rand, (self.cql_n_actions, n, d),
+            minval=-scale, maxval=scale)
+        for i in range(self.cql_n_actions):
+            samples.append((qf(p1, obs, rand[i]), qf(p2, obs, rand[i])))
+        a_pi, _ = sample(actor, obs, k_pi)
+        a_pi = jax.lax.stop_gradient(a_pi)
+        samples.append((qf(p1, obs, a_pi), qf(p2, obs, a_pi)))
+        a_pin, _ = sample(actor, batch["next_obs"], k_pin)
+        a_pin = jax.lax.stop_gradient(a_pin)
+        samples.append((qf(p1, obs, a_pin), qf(p2, obs, a_pin)))
+
+        q1_cat = jnp.stack([s[0] for s in samples])  # (k, n)
+        q2_cat = jnp.stack([s[1] for s in samples])
+        q1_data = qf(p1, obs, batch["actions"])
+        q2_data = qf(p2, obs, batch["actions"])
+        pen1 = jnp.mean(jax.scipy.special.logsumexp(q1_cat, axis=0)
+                        - q1_data)
+        pen2 = jnp.mean(jax.scipy.special.logsumexp(q2_cat, axis=0)
+                        - q2_data)
+        return self.cql_alpha * (pen1 + pen2)
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    input_path: str = ""
+    cql_alpha: float = 1.0
+    cql_n_actions: int = 4
+    lr: float = 3e-4                      # actor
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    train_batch_size: int = 256
+    updates_per_iteration: int = 100
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    env: Union[str, Any] = "Pendulum-v1"  # only needed for evaluate()
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    """Offline training loop: dataset -> replay minibatches -> jitted
+    conservative SAC updates. No environment interaction."""
+
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        frags: List[dict] = list(JsonReader(config.input_path))
+        if not frags:
+            raise ValueError(f"no data under {config.input_path}")
+        obs_dim = np.asarray(frags[0]["obs"], np.float32).shape[-1]
+        act = np.asarray(frags[0]["actions"])
+        if act.dtype.kind in "iub":
+            raise ValueError("CQL is continuous-control (got int actions)")
+        action_dim = 1 if act.ndim == 1 else act.shape[-1]
+        # action bound from the data (the env's scale isn't in the log)
+        a_max = max(float(np.abs(np.asarray(f["actions"])).max())
+                    for f in frags)
+        self.replay = ReplayBuffer(
+            capacity=sum(len(f["actions"]) for f in frags),
+            seed=config.seed)
+        for f in frags:
+            t = transitions_from_fragment(f)
+            if t["actions"].ndim == 1:
+                t["actions"] = t["actions"][:, None]
+            self.replay.add_fragment(t)
+        self.learner = CQLLearner(
+            obs_dim, action_dim, hidden=tuple(config.hidden),
+            actor_lr=config.lr, critic_lr=config.critic_lr,
+            alpha_lr=config.alpha_lr, gamma=config.gamma, tau=config.tau,
+            action_scale=max(a_max, 1e-3), seed=config.seed,
+            cql_alpha=config.cql_alpha,
+            cql_n_actions=config.cql_n_actions)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        metrics: Dict[str, float] = {}
+        agg: Dict[str, List[float]] = {}
+        for _ in range(self.config.updates_per_iteration):
+            m = self.learner.update(
+                self.replay.sample(self.config.train_batch_size))
+            for k, v in m.items():
+                agg.setdefault(k, []).append(v)
+        metrics = {k: float(np.mean(v)) for k, v in agg.items()}
+        metrics["training_iteration"] = self.iteration
+        metrics["dataset_size"] = len(self.replay)
+        return metrics
+
+    def evaluate(self, num_episodes: int = 5,
+                 seed: int = 100) -> Dict[str, float]:
+        """Deterministic (mean-action) rollouts of the learned actor."""
+        from ray_tpu.rl.envs import make_env
+        from ray_tpu.rl.module import np_continuous_dist
+
+        env = make_env(self.config.env, seed=seed)
+        actor = {k: np.asarray(v) for k, v in self.learner.actor.items()}
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                mu, _ = np_continuous_dist(actor, np.asarray(obs)[None])
+                a = np.tanh(mu[0]) * actor["action_scale"]
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}

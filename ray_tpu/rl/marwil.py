@@ -1,0 +1,166 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Reference: ``rllib/algorithms/marwil/marwil.py`` — offline learning that
+interpolates between behavior cloning (beta=0) and advantage-filtered
+imitation (beta>0): each logged action's log-likelihood is weighted by
+``exp(beta * A(s, a) / c)`` where A comes from a value function trained
+on the logged returns and ``c`` is a running advantage norm (the
+reference's moving-average normalizer, ``marwil.py`` vf/beta losses).
+
+TPU framing: one jitted update on the shared policy+value MLP
+(``rl/module.py`` — same net PPO uses, so the value head is free); the
+whole minibatch computes as a single fused forward/backward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+from ray_tpu.rl.offline import JsonReader
+
+
+def returns_to_go(rewards: np.ndarray, dones: np.ndarray,
+                  gamma: float) -> np.ndarray:
+    """Per-step discounted return to go, cut at episode boundaries;
+    fragment tails bootstrap 0 (standard offline simplification)."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = float(rewards[t]) + gamma * acc
+        out[t] = acc
+    return out
+
+
+@dataclasses.dataclass
+class MARWILConfig:
+    input_path: str = ""
+    beta: float = 1.0              # 0 = plain behavior cloning
+    lr: float = 1e-3
+    vf_coeff: float = 1.0
+    gamma: float = 0.99
+    num_epochs: int = 1
+    minibatch_size: int = 256
+    # running advantage normalizer momentum (reference moving-average)
+    norm_momentum: float = 1e-2
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    env: Union[str, Any] = "CartPole-v1"  # only needed for evaluate()
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL:
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.module import init_policy_params, jax_forward
+
+        self.config = config
+        obs_l: List[np.ndarray] = []
+        act_l: List[np.ndarray] = []
+        ret_l: List[np.ndarray] = []
+        for frag in JsonReader(config.input_path):
+            obs_l.append(np.asarray(frag["obs"], np.float32))
+            act_l.append(np.asarray(frag["actions"], np.int32))
+            ret_l.append(returns_to_go(
+                np.asarray(frag["rewards"], np.float32),
+                np.asarray(frag["dones"], np.bool_), config.gamma))
+        self._obs = np.concatenate(obs_l)
+        self._actions = np.concatenate(act_l)
+        self._returns = np.concatenate(ret_l)
+        self.params = init_policy_params(
+            self._obs.shape[-1], int(self._actions.max()) + 1,
+            hidden=tuple(config.hidden), seed=config.seed)
+        self._opt = optax.adam(config.lr)
+        self._opt_state = self._opt.init(self.params)
+        # running E[A^2] — the advantage scale c in exp(beta * A / c).
+        # Seeded from the return variance so the first minibatches don't
+        # see exp(beta * A / 1) blow-ups while the average warms up.
+        var0 = float(np.mean((self._returns - self._returns.mean()) ** 2))
+        self._ms_adv = np.float32(var0 if var0 > 0 else 1.0)
+        self.iteration = 0
+        beta, vf_c, mom = config.beta, config.vf_coeff, config.norm_momentum
+
+        def loss(params, obs, actions, rets, ms_adv):
+            logits, value = jax_forward(params, obs)
+            adv = rets - value
+            vf_loss = jnp.mean(adv ** 2)
+            ms_new = (1 - mom) * ms_adv + mom * jax.lax.stop_gradient(
+                jnp.mean(adv ** 2))
+            c = jnp.sqrt(ms_new) + 1e-8
+            w = jnp.exp(jnp.clip(
+                beta * jax.lax.stop_gradient(adv) / c, -10.0, 10.0))
+            logp = jax.nn.log_softmax(logits)
+            logp_a = jnp.take_along_axis(
+                logp, actions[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            pi_loss = -jnp.mean(w * logp_a)
+            return pi_loss + vf_c * vf_loss, (pi_loss, vf_loss, ms_new)
+
+        @jax.jit
+        def step(params, opt_state, obs, actions, rets, ms_adv):
+            (l, (pi_l, vf_l, ms_new)), g = jax.value_and_grad(
+                loss, has_aux=True)(params, obs, actions, rets, ms_adv)
+            updates, opt_state = self._opt.update(g, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    l, pi_l, vf_l, ms_new)
+
+        self._step = step
+        self._rng = np.random.default_rng(config.seed)
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        n = len(self._obs)
+        mb = min(self.config.minibatch_size, n)
+        tot, pi, vf = [], [], []
+        for _ in range(self.config.num_epochs):
+            order = self._rng.permutation(n)
+            for i in range(0, n - mb + 1, mb):
+                idx = order[i:i + mb]
+                (self.params, self._opt_state, l, pl, vl,
+                 self._ms_adv) = self._step(
+                    self.params, self._opt_state, self._obs[idx],
+                    self._actions[idx], self._returns[idx], self._ms_adv)
+                tot.append(float(l))
+                pi.append(float(pl))
+                vf.append(float(vl))
+        return {"training_iteration": self.iteration,
+                "total_loss": float(np.mean(tot)),
+                "policy_loss": float(np.mean(pi)),
+                "vf_loss": float(np.mean(vf)),
+                "advantage_norm": float(np.sqrt(self._ms_adv))}
+
+    def action_probs(self, obs: np.ndarray) -> np.ndarray:
+        from ray_tpu.rl.module import np_forward
+
+        logits, _ = np_forward(
+            {k: np.asarray(v) for k, v in self.params.items()},
+            np.asarray(obs, np.float32))
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def evaluate(self, num_episodes: int = 5,
+                 seed: int = 100) -> Dict[str, float]:
+        from ray_tpu.rl.envs import make_env
+        from ray_tpu.rl.module import np_forward
+
+        env = make_env(self.config.env, seed=seed)
+        params = {k: np.asarray(v) for k, v in self.params.items()}
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = np_forward(params, np.asarray(obs)[None])
+                obs, r, term, trunc, _ = env.step(int(logits[0].argmax()))
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}

@@ -52,8 +52,10 @@ class JsonWriter:
 class JsonReader:
     """Read fragments back as numpy column dicts."""
 
-    _ARRAY_DTYPES = {"obs": np.float32, "actions": np.int32,
+    # actions: None = infer (int32 for discrete logs, float32 continuous)
+    _ARRAY_DTYPES = {"obs": np.float32, "actions": None,
                      "rewards": np.float32, "dones": np.bool_,
+                     "terminated": np.bool_, "next_obs": np.float32,
                      "logp": np.float32, "values": np.float32}
 
     def __init__(self, path: str):
@@ -70,7 +72,14 @@ class JsonReader:
                         continue
                     row = json.loads(line)
                     for k, dt in self._ARRAY_DTYPES.items():
-                        if k in row:
+                        if k not in row:
+                            continue
+                        if dt is None:
+                            arr = np.asarray(row[k])
+                            dt = (np.int32 if arr.dtype.kind in "iub"
+                                  else np.float32)
+                            row[k] = arr.astype(dt)
+                        else:
                             row[k] = np.asarray(row[k], dt)
                     yield row
 
@@ -96,12 +105,14 @@ def to_dataset(path: str):
 
 
 def collect(env_spec, policy_params, path: str, *, num_steps: int = 2048,
-            seed: int = 0) -> str:
+            seed: int = 0, record_next_obs: bool = False) -> str:
     """Roll out a policy and persist the experience (reference
-    ``rllib ... output`` config): the offline-data entry point."""
+    ``rllib ... output`` config): the offline-data entry point.
+    ``record_next_obs`` persists true successors + the terminated flag —
+    what offline TD consumers (CQL) need."""
     from ray_tpu.rl.env_runner import EnvRunner
 
-    runner = EnvRunner(env_spec, seed=seed)
+    runner = EnvRunner(env_spec, seed=seed, record_next_obs=record_next_obs)
     runner.set_weights(policy_params)
     writer = JsonWriter(path)
     wrote = 0

@@ -124,6 +124,10 @@ class SACLearner:
                 ).sum(axis=1)
         return act, logp
 
+    def _conservative_penalty(self, qs, actor, batch, key):
+        """Critic-loss addend hook; CQLLearner overrides (sac stays 0)."""
+        return 0.0
+
     def _build_step(self):
         import jax
         import jax.numpy as jnp
@@ -133,10 +137,11 @@ class SACLearner:
         opt_a, opt_c, opt_al = (self._opt_actor, self._opt_critic,
                                 self._opt_alpha)
         qf, sample = self._q_forward, self._sample_squashed
+        penalty = self._conservative_penalty
 
         def step(actor, q1, q2, q1_t, q2_t, log_alpha,
                  a_opt, c_opt, al_opt, batch, key):
-            k1, k2 = jax.random.split(key)
+            k1, k2, k3 = jax.random.split(key, 3)
             alpha = jnp.exp(log_alpha)
 
             # ---- critics: y = r + γ(1-d)(min Q'(s', a') - α logπ(a'|s'))
@@ -154,9 +159,10 @@ class SACLearner:
                                - y) ** 2)
                 l2 = jnp.mean((qf(p2, batch["obs"], batch["actions"])
                                - y) ** 2)
-                return l1 + l2, (l1, l2)
+                pen = penalty(qs, actor, batch, k3)
+                return l1 + l2 + pen, (l1, l2, pen)
 
-            (closs, (l1, l2)), cgrads = jax.value_and_grad(
+            (closs, (l1, l2, pen)), cgrads = jax.value_and_grad(
                 critic_loss, has_aux=True)((q1, q2))
             cupd, c_opt = opt_c.update(cgrads, c_opt, (q1, q2))
             q1, q2 = optax.apply_updates((q1, q2), cupd)
@@ -193,7 +199,7 @@ class SACLearner:
                                 q2_t, q2)
             metrics = {"critic_loss": closs, "q1_loss": l1, "q2_loss": l2,
                        "actor_loss": aloss, "alpha_loss": alloss,
-                       "alpha": alpha,
+                       "alpha": alpha, "cql_penalty": pen,
                        "entropy": -jnp.mean(logp_new)}
             return (actor, q1, q2, q1_t, q2_t, log_alpha,
                     a_opt, c_opt, al_opt, metrics)

@@ -1,0 +1,153 @@
+"""MARWIL + CQL on the offline stack (reference:
+rllib/algorithms/marwil/, rllib/algorithms/cql/).
+
+The learning assertions are DISTRIBUTIONAL, not wall-clock reward
+thresholds: MARWIL must prefer high-advantage logged actions where plain
+BC imitates indiscriminately, and CQL must push out-of-distribution
+Q-values below dataset-action Q-values where plain SAC lets them
+inflate. Both are the defining property of the algorithm and determinist
+enough for a 1-core CI box."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import offline
+from ray_tpu.rl.marwil import MARWILConfig, returns_to_go
+
+
+def test_returns_to_go_cuts_at_dones():
+    r = np.array([1, 1, 1, 5], np.float32)
+    d = np.array([False, True, False, False])
+    out = returns_to_go(r, d, gamma=0.5)
+    assert out[3] == 5.0
+    assert out[2] == 1 + 0.5 * 5
+    assert out[1] == 1.0          # episode ends here
+    assert out[0] == 1 + 0.5 * 1.0
+
+
+_STATE_POOL = np.random.default_rng(1234).normal(
+    size=(64, 4)).astype(np.float32)
+
+
+def _write_mixed_quality_dataset(path, n_frag=8, steps=64, seed=0):
+    """THE SAME states appear under two behaviors: action 0 earning
+    reward 1 and action 1 earning reward 0. Per state, an
+    advantage-aware imitator must pick the rewarded action; a pure
+    imitator sees both equally often and splits. (A fresh-noise obs
+    design would let the net memorize rows instead of weighing them.)"""
+    rng = np.random.default_rng(seed)
+    w = offline.JsonWriter(path)
+    for i in range(n_frag):
+        good = i % 2 == 0
+        obs = _STATE_POOL[rng.integers(0, len(_STATE_POOL), size=steps)]
+        w.write({
+            "obs": obs,
+            "actions": np.full(steps, 0 if good else 1, np.int32),
+            "rewards": np.full(steps, 1.0 if good else 0.0, np.float32),
+            "dones": np.zeros(steps, np.bool_),
+        })
+    w.close()
+    return path
+
+
+class TestMARWIL:
+    def test_prefers_high_advantage_actions(self, tmp_path):
+        path = _write_mixed_quality_dataset(str(tmp_path / "mixed"))
+        marwil = MARWILConfig(input_path=path, beta=2.0, num_epochs=10,
+                              lr=3e-3, seed=0).build()
+        for _ in range(6):
+            res = marwil.train()
+        assert np.isfinite(res["total_loss"])
+        probs = marwil.action_probs(_STATE_POOL)
+        # advantage weighting tilts hard onto the rewarded behavior
+        # (per-state ceiling < 1.0: late-fragment good steps carry small
+        # weights, so a strict collapse to 1 is not the expectation)
+        assert probs[:, 0].mean() > 0.75, probs[:, 0].mean()
+
+    def test_beta_zero_reduces_to_bc(self, tmp_path):
+        path = _write_mixed_quality_dataset(str(tmp_path / "mixed0"))
+        bc_like = MARWILConfig(input_path=path, beta=0.0, num_epochs=10,
+                               lr=3e-3, seed=0).build()
+        for _ in range(6):
+            bc_like.train()
+        probs = bc_like.action_probs(_STATE_POOL)
+        # both actions equally frequent in the log -> near-uniform clone
+        assert 0.3 < probs[:, 0].mean() < 0.7, probs[:, 0].mean()
+
+    def test_loss_decreases(self, tmp_path):
+        path = _write_mixed_quality_dataset(str(tmp_path / "mixed2"))
+        m = MARWILConfig(input_path=path, beta=1.0, num_epochs=5).build()
+        first = m.train()["total_loss"]
+        for _ in range(4):
+            last = m.train()["total_loss"]
+        assert last < first
+
+
+@pytest.fixture(scope="module")
+def pendulum_dataset(tmp_path_factory):
+    """Random-policy Pendulum experience with true successors — the
+    canonical offline continuous-control setup."""
+    from ray_tpu.rl.module import init_continuous_policy_params
+
+    path = str(tmp_path_factory.mktemp("cql") / "pendulum")
+    params = init_continuous_policy_params(3, 1, hidden=(32, 32), seed=3,
+                                           action_scale=2.0)
+    offline.collect("Pendulum-v1", params, path, num_steps=1024, seed=1,
+                    record_next_obs=True)
+    return path
+
+
+class TestCQL:
+    def test_dataset_has_true_successors(self, pendulum_dataset):
+        frag = next(iter(offline.JsonReader(pendulum_dataset)))
+        assert "next_obs" in frag and "terminated" in frag
+        assert frag["actions"].dtype == np.float32  # continuous log
+
+    def test_conservative_q_gap(self, pendulum_dataset):
+        """The CQL property itself: after identical training, the
+        (OOD - dataset) Q gap must be materially lower with the
+        conservative penalty than without it."""
+        from ray_tpu.rl.cql import CQLConfig
+        from ray_tpu.rl.sac import SACLearner
+
+        def ood_gap(learner, batch, rng):
+            q_data = np.asarray(learner._q_forward(
+                learner.q1, batch["obs"], batch["actions"]))
+            a_rand = rng.uniform(-2.0, 2.0, size=batch["actions"].shape
+                                 ).astype(np.float32)
+            q_rand = np.asarray(learner._q_forward(
+                learner.q1, batch["obs"], a_rand))
+            return float(q_rand.mean() - q_data.mean())
+
+        # Fully seeded end to end (collect, replay sampling, jax keys), so
+        # the measured gaps are deterministic: ~-0.083 (CQL) vs ~-0.039
+        # (SAC) after 200 updates — the 0.02 margin is 2x headroom.
+        cql = CQLConfig(input_path=pendulum_dataset, cql_alpha=10.0,
+                        critic_lr=3e-3, updates_per_iteration=200,
+                        train_batch_size=128,
+                        hidden=(32, 32), seed=0).build()
+        res = cql.train()
+        assert np.isfinite(res["critic_loss"])
+        assert res["cql_penalty"] != 0.0
+
+        sac = SACLearner(3, 1, hidden=(32, 32), action_scale=2.0,
+                         critic_lr=3e-3, seed=0)
+        for _ in range(200):
+            sac.update(cql.replay.sample(128))
+
+        rng = np.random.default_rng(7)
+        batch = cql.replay.sample(512)
+        gap_cql = ood_gap(cql.learner, batch, rng)
+        gap_sac = ood_gap(sac, batch, rng)
+        assert gap_cql < 0, gap_cql
+        assert gap_cql < gap_sac - 0.02, (gap_cql, gap_sac)
+
+    def test_evaluate_runs(self, pendulum_dataset):
+        from ray_tpu.rl.cql import CQLConfig
+
+        cql = CQLConfig(input_path=pendulum_dataset,
+                        updates_per_iteration=10, train_batch_size=64,
+                        hidden=(32, 32)).build()
+        cql.train()
+        out = cql.evaluate(num_episodes=1)
+        assert np.isfinite(out["episode_return_mean"])
