@@ -42,6 +42,17 @@ class Dashboard:
         self._history = _collections.deque(maxlen=720)  # ~1h at 5s period
         self._history_period = 5.0
         self._history_stopped = False
+        # per-node system gauges (reference: per-node reporter agents —
+        # here the raylets ship stats with their resource reports and the
+        # dashboard re-exports them with a node_id label)
+        from ray_tpu.util.metrics import Gauge
+
+        self._node_gauges = {
+            k: Gauge(f"rt_node_{k}", f"per-node {k.replace('_', ' ')}",
+                     tag_keys=("node_id",))
+            for k in ("mem_used_bytes", "mem_total_bytes", "cpu_load_1m",
+                      "num_workers", "num_pending_leases")
+        }
         self._register_routes()
 
     @property
@@ -91,6 +102,12 @@ class Dashboard:
                         1 for a in actors if a["state"] == "ALIVE"),
                     "nodes_alive": sum(1 for n in nodes if n["alive"]),
                 })
+                for n in nodes:
+                    tags = {"node_id": n["node_id"].hex()}
+                    for k, g in self._node_gauges.items():
+                        v = (n.get("stats") or {}).get(k)
+                        if v is not None:
+                            g.set(float(v), tags=tags)
             except Exception:  # noqa: BLE001 — GCS restarting etc.
                 pass
             await asyncio.sleep(self._history_period)
@@ -108,6 +125,11 @@ class Dashboard:
         r("GET", "/api/task_events", self._task_events)
         r("GET", "/api/metrics", self._metrics)
         r("GET", "/api/metrics/history", self._metrics_history)
+        r("GET", "/api/serve", self._serve_status)
+        # Prometheus HTTP service discovery (reference:
+        # dashboard/modules/metrics prometheus config); point
+        # `http_sd_configs` here and every scrape target is enumerated
+        r("GET", "/api/prometheus_sd", self._prometheus_sd)
         # job REST surface (reference job_head.py)
         r("POST", "/api/jobs/", self._submit_job)
         r("GET", "/api/jobs/", self._list_jobs)
@@ -166,6 +188,24 @@ class Dashboard:
     async def _metrics_history(self, req: HttpRequest):
         limit = int(req.query.get("limit", "720"))
         return list(self._history)[-limit:]
+
+    async def _serve_status(self, _req: HttpRequest):
+        """Serve view: the controller drops its app table into GCS KV
+        every reconcile pass (serve/controller.py _publish_status)."""
+        import json as _json
+
+        raw = await self._gcs.call_async("kv_get", namespace="serve",
+                                         key=b"status")
+        if not raw:
+            return {"apps": {}, "updated_at": None}
+        return _json.loads(raw)
+
+    async def _prometheus_sd(self, _req: HttpRequest):
+        host, port = self._http.address
+        return [{
+            "targets": [f"{host}:{port}"],
+            "labels": {"job": "ray_tpu", "component": "dashboard"},
+        }]
 
     # job handlers ---------------------------------------------------------
     async def _submit_job(self, req: HttpRequest):
@@ -251,112 +291,6 @@ class Dashboard:
                             content_type="text/plain")
 
     async def _index(self, _req: HttpRequest):
-        return HttpResponse(_INDEX_HTML, content_type="text/html")
+        from ray_tpu.dashboard.ui import INDEX_HTML
 
-
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
- table { border-collapse: collapse; margin-top: .5rem; }
- td, th { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
- th { background: #f2f2f2; text-align: left; }
- code { background: #f6f6f6; padding: 0 .3rem; }
-</style></head>
-<body>
-<h1>ray_tpu dashboard</h1>
-<div id="summary">loading…</div>
-<h2>Nodes</h2><table id="nodes"></table>
-<h2>Actors</h2><table id="actors"></table>
-<h2>Jobs</h2><table id="jobs"></table>
-<h2>Cluster over time</h2>
-<div id="charts">
- <svg id="ch_cpu" width="360" height="70"></svg>
- <svg id="ch_actors" width="360" height="70"></svg>
-</div>
-<script>
-function sparkline(svgId, label, series, maxv) {
-  const svg = document.getElementById(svgId);
-  const W = 360, H = 70, pad = 14;
-  if (!series.length) { svg.innerHTML = ''; return; }
-  const mx = Math.max(maxv || 0, ...series, 1);
-  const pts = series.map((v, i) => {
-    const x = pad + (W - 2 * pad) * i / Math.max(series.length - 1, 1);
-    const y = H - pad - (H - 2 * pad) * v / mx;
-    return `${x.toFixed(1)},${y.toFixed(1)}`;
-  }).join(' ');
-  svg.innerHTML =
-    `<rect x="0" y="0" width="${W}" height="${H}" fill="#fafafa" ` +
-    `stroke="#ddd"/>` +
-    `<polyline points="${pts}" fill="none" stroke="#4a7" ` +
-    `stroke-width="1.5"/>` +
-    `<text x="${pad}" y="12" font-size="10" fill="#555">${label} ` +
-    `(now ${series[series.length-1]}, max ${mx})</text>`;
-}
-async function refreshCharts() {
-  const h = await (await fetch('/api/metrics/history?limit=240')).json();
-  sparkline('ch_cpu', 'CPU in use', h.map(s => s.cpu_used),
-            h.length ? h[h.length-1].cpu_total : 0);
-  sparkline('ch_actors', 'actors alive', h.map(s => s.actors_alive), 0);
-}
-setInterval(refreshCharts, 5000);
-refreshCharts();
-async function refresh() {
-  const o = await (await fetch('/api/overview')).json();
-  document.getElementById('summary').textContent =
-    `${o.nodes_alive}/${o.nodes_total} nodes alive - ` +
-    `${o.actors_alive}/${o.actors_total} actors alive - ` +
-    `resources: ${JSON.stringify(o.resources.available)} available of ` +
-    `${JSON.stringify(o.resources.total)}`;
-  const nodes = await (await fetch('/api/nodes')).json();
-  fill('nodes', ['node_id','alive','address'], nodes.map(n => ({
-    node_id: n.node_id.slice(0,12), alive: n.alive,
-    address: n.address.join(':')})));
-  const actors = await (await fetch('/api/actors')).json();
-  fill('actors', ['actor_id','name','state','num_restarts'], actors.map(a => ({
-    actor_id: a.actor_id.slice(0,12), name: a.name || '',
-    state: a.state, num_restarts: a.num_restarts})));
-  fill('jobs', ['submission_id','status','entrypoint','message'], o.jobs);
-}
-function esc(v) {
-  return String(v).replace(/[&<>"']/g, ch => ({'&':'&amp;','<':'&lt;',
-    '>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
-}
-function fill(id, cols, rows) {
-  const t = document.getElementById(id);
-  t.innerHTML = '<tr>' + cols.map(c => `<th>${esc(c)}</th>`).join('') +
-    '</tr>' + rows.map(r => '<tr>' +
-    cols.map(c => `<td>${esc(r[c])}</td>`).join('') + '</tr>').join('');
-}
-refresh(); setInterval(refresh, 3000);
-</script></body></html>
-"""
-
-
-def main():
-    import argparse
-
-    logging.basicConfig(level=logging.INFO)
-    p = argparse.ArgumentParser()
-    p.add_argument("--gcs", required=True, help="host:port of the GCS")
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=DEFAULT_DASHBOARD_PORT)
-    p.add_argument("--session-dir", default="/tmp/rt/dashboard")
-    args = p.parse_args()
-    import os
-
-    os.makedirs(args.session_dir, exist_ok=True)
-    host, _, port = args.gcs.partition(":")
-    dash = Dashboard((host, int(port)), args.session_dir, args.host, args.port)
-    dash.start()
-    print(f"DASHBOARD_READY {dash.url}", flush=True)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        dash.stop()
-
-
-if __name__ == "__main__":
-    main()
+        return HttpResponse(INDEX_HTML, content_type="text/html")

@@ -1,0 +1,216 @@
+"""Dashboard single-page UI (no build step).
+
+Reference: ``python/ray/dashboard/client/`` is a React app compiled by
+webpack; the capability it provides — live jobs/actors/tasks/serve/node
+views over the REST surface — is delivered here as one vanilla-JS page
+served by the dashboard process itself (scope decision recorded in
+README "Scope decisions"). Views poll the same /api endpoints external
+tooling uses, so the page is also living documentation of the API.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta charset="utf-8">
+<style>
+ :root { --bd:#d8d8d8; --bg:#fafafa; --acc:#2563eb; --bad:#dc2626;
+         --ok:#16a34a; }
+ body { font-family: system-ui, sans-serif; margin:0; color:#1f2328; }
+ header { display:flex; align-items:baseline; gap:1.2rem; padding:.7rem 1.2rem;
+          border-bottom:1px solid var(--bd); background:var(--bg); }
+ header h1 { font-size:1.05rem; margin:0; }
+ nav a { margin-right:.9rem; text-decoration:none; color:#555;
+         font-size:.9rem; padding:.15rem 0; }
+ nav a.active { color:var(--acc); border-bottom:2px solid var(--acc); }
+ main { padding:1rem 1.2rem; }
+ table { border-collapse:collapse; margin-top:.5rem; width:100%; }
+ td,th { border:1px solid var(--bd); padding:.3rem .55rem; font-size:.82rem;
+         text-align:left; vertical-align:top; }
+ th { background:var(--bg); }
+ .pill { display:inline-block; padding:0 .45rem; border-radius:.6rem;
+         font-size:.75rem; color:#fff; }
+ .ALIVE,.RUNNING,.SUCCEEDED,.FINISHED,.CREATED,.ok { background:var(--ok); }
+ .DEAD,.FAILED,.bad { background:var(--bad); }
+ .PENDING,.RESTARTING,.STOPPED,.warn { background:#d97706; }
+ .cards { display:flex; gap:1rem; flex-wrap:wrap; margin-bottom:1rem; }
+ .card { border:1px solid var(--bd); border-radius:.5rem; padding:.6rem 1rem;
+         min-width:8rem; background:var(--bg); }
+ .card .v { font-size:1.4rem; font-weight:600; }
+ .card .k { font-size:.75rem; color:#666; }
+ pre { background:#f6f6f6; padding: .6rem; overflow:auto; font-size:.78rem; }
+ svg { background:var(--bg); border:1px solid var(--bd); }
+ input,button,textarea { font:inherit; padding:.25rem .5rem; }
+ .muted { color:#777; font-size:.78rem; }
+</style></head>
+<body>
+<header>
+ <h1>ray_tpu</h1>
+ <nav id="nav"></nav>
+ <span id="uptime" class="muted"></span>
+</header>
+<main id="main">loading…</main>
+<script>
+const VIEWS = ["overview","nodes","actors","pgs","jobs","serve","tasks",
+               "metrics","logs"];
+const $ = (s) => document.querySelector(s);
+const esc = (s) => String(s).replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const pill = (s) => `<span class="pill ${esc(s)}">${esc(s)}</span>`;
+const fmtB = (b) => b > 1<<30 ? (b/(1<<30)).toFixed(1)+" GiB"
+  : b > 1<<20 ? (b/(1<<20)).toFixed(1)+" MiB" : b + " B";
+const api = async (p) => (await fetch("/api/"+p)).json();
+let timer = null;
+
+function nav() {
+  const cur = location.hash.slice(1) || "overview";
+  $("#nav").innerHTML = VIEWS.map(v =>
+    `<a href="#${v}" class="${v===cur?"active":""}">${v}</a>`).join("");
+  return cur;
+}
+
+function table(rows, cols) {
+  if (!rows.length) return "<p class='muted'>none</p>";
+  return "<table><tr>" + cols.map(c=>`<th>${c[0]}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c=>`<td>${c[1](r)}</td>`).join("")
+             + "</tr>").join("") + "</table>";
+}
+
+const R = {
+ async overview() {
+  const o = await api("overview"), v = await api("version");
+  $("#uptime").textContent = "up " + Math.round(v.uptime_s) + "s";
+  const res = o.resources.total || {}, avail = o.resources.available || {};
+  const card = (k, val) =>
+    `<div class="card"><div class="v">${val}</div><div class="k">${k}</div></div>`;
+  return `<div class="cards">` +
+    card("nodes", `${o.nodes_alive}/${o.nodes_total}`) +
+    card("actors alive", `${o.actors_alive}/${o.actors_total}`) +
+    card("CPU used", `${((res.CPU||0)-(avail.CPU||0)).toFixed(1)}/${res.CPU||0}`) +
+    card("TPU used", `${((res.TPU||0)-(avail.TPU||0)).toFixed(1)}/${res.TPU||0}`) +
+    card("jobs", o.jobs.length) + `</div>` +
+    "<h2>jobs</h2>" + table(o.jobs, [
+      ["id", j=>esc(j.submission_id)], ["status", j=>pill(j.status)],
+      ["entrypoint", j=>`<code>${esc(j.entrypoint||"")}</code>`]]);
+ },
+ async nodes() {
+  const ns = await api("nodes");
+  return table(ns, [
+    ["node", n=>`<code>${esc(n.node_id.slice(0,12))}</code>`],
+    ["addr", n=>esc(n.address.join(":"))],
+    ["state", n=>pill(n.alive?"ALIVE":"DEAD")],
+    ["resources", n=>esc(JSON.stringify(n.resources))],
+    ["mem", n=>n.stats&&n.stats.mem_total_bytes?
+       fmtB(n.stats.mem_used_bytes)+" / "+fmtB(n.stats.mem_total_bytes):""],
+    ["load1m", n=>n.stats&&n.stats.cpu_load_1m!=null?
+       n.stats.cpu_load_1m.toFixed(2):""],
+    ["workers", n=>n.stats?n.stats.num_workers:""],
+    ["pending leases", n=>n.stats?n.stats.num_pending_leases:""]]);
+ },
+ async actors() {
+  const as = await api("actors");
+  return table(as, [
+    ["actor", a=>`<code>${esc((a.actor_id||"").slice(0,12))}</code>`],
+    ["name", a=>esc(a.name||"")], ["state", a=>pill(a.state)],
+    ["node", a=>`<code>${esc((a.node_id||"").slice(0,12))}</code>`],
+    ["restarts", a=>a.num_restarts||0],
+    ["death", a=>esc(a.death_cause||"")]]);
+ },
+ async pgs() {
+  const ps = await api("placement_groups");
+  return table(ps, [
+    ["pg", p=>`<code>${esc((p.pg_id||"").slice(0,12))}</code>`],
+    ["name", p=>esc(p.name||"")], ["state", p=>pill(p.state)],
+    ["strategy", p=>esc(p.strategy)],
+    ["bundles", p=>esc(JSON.stringify(p.bundles))]]);
+ },
+ async jobs() {
+  const js = await api("jobs/");
+  window.showLogs = async (id) => {
+    const r = await fetch(`/api/jobs/${id}/logs`);
+    $("#joblog").textContent = await r.text();
+  };
+  return `<form onsubmit="event.preventDefault();
+      fetch('/api/jobs/',{method:'POST',
+        headers:{'content-type':'application/json'},
+        body:JSON.stringify({entrypoint:this.ep.value})})
+      .then(()=>render());">
+    <input name="ep" size="60" placeholder="python my_script.py">
+    <button>submit job</button></form>` +
+    table(js, [
+      ["id", j=>`<code>${esc(j.submission_id)}</code>`],
+      ["status", j=>pill(j.status)],
+      ["entrypoint", j=>`<code>${esc(j.entrypoint||"")}</code>`],
+      ["logs", j=>`<a href="javascript:showLogs('${esc(j.submission_id)}')">view</a>`]]) +
+    `<pre id="joblog"></pre>`;
+ },
+ async serve() {
+  const s = await api("serve");
+  const apps = Object.entries(s.apps||{}).map(([name, a]) =>
+    ({name, ...a}));
+  return (s.updated_at ?
+      `<p class="muted">controller heartbeat ${Math.round(Date.now()/1000 - s.updated_at)}s ago</p>`
+      : "<p class='muted'>no serve controller running</p>") +
+    table(apps, [
+      ["app", a=>esc(a.name)],
+      ["replicas", a=>`${a.running_replicas}/${a.target_replicas}`],
+      ["autoscaling", a=>a.autoscaling?"yes":"no"],
+      ["health", a=>pill(a.running_replicas>=a.target_replicas?"ok":"warn")]]);
+ },
+ async tasks() {
+  const evs = await api("task_events?limit=200");
+  return `<p class="muted">latest ${evs.length} task state events
+    (<a href="/api/task_events?limit=10000">raw</a>; chrome timeline via
+    <code>ray_tpu.timeline()</code>)</p>` +
+    table(evs.slice().reverse(), [
+      ["task", e=>`<code>${esc((e.task_id||"").slice(0,12))}</code>`],
+      ["name", e=>esc(e.name||"")], ["state", e=>pill(e.state||"")],
+      ["node", e=>`<code>${esc((e.node_id||"").slice(0,10))}</code>`],
+      ["duration", e=>e.end_ts?((e.end_ts-e.start_ts)*1000).toFixed(1)+" ms":""],
+      ["finished", e=>e.end_ts?new Date(e.end_ts*1000).toLocaleTimeString():""]]);
+ },
+ async metrics() {
+  const h = await api("metrics/history");
+  const chart = (key, color) => {
+    if (!h.length) return "";
+    const w=560, ht=120, max=Math.max(1, ...h.map(p=>p[key]||0));
+    const pts = h.map((p,i)=>`${(i/(h.length-1||1)*w).toFixed(1)},` +
+      `${(ht-(p[key]||0)/max*ht).toFixed(1)}`).join(" ");
+    return `<div><span class="muted">${key} (max ${max.toFixed(1)})</span><br>
+      <svg width="${w}" height="${ht}"><polyline fill="none"
+      stroke="${color}" stroke-width="1.5" points="${pts}"/></svg></div>`;
+  };
+  return chart("cpu_used","#2563eb") + chart("tpu_used","#dc2626") +
+    chart("actors_alive","#16a34a") + chart("nodes_alive","#d97706") +
+    `<p class="muted">Prometheus exposition at <a href="/api/metrics">
+     /api/metrics</a>; scrape discovery at
+     <a href="/api/prometheus_sd">/api/prometheus_sd</a>; generate
+     Prometheus + Grafana configs with
+     <code>python -m ray_tpu metrics-config</code></p>`;
+ },
+ async logs() {
+  const ls = await api("logs");
+  window.showLog = async (n) => {
+    const r = await fetch(`/api/logs/${n}?tail=500`);
+    $("#logview").textContent = await r.text();
+  };
+  return table(ls, [
+    ["file", l=>`<a href="javascript:showLog('${esc(l.name)}')">${esc(l.name)}</a>`],
+    ["size", l=>fmtB(l.size_bytes)]]) + `<pre id="logview"></pre>`;
+ },
+};
+
+async function render() {
+  const view = nav();
+  try { $("#main").innerHTML = await R[view](); }
+  catch (e) { $("#main").innerHTML = `<p class="bad pill">error</p>
+    <pre>${esc(e)}</pre>`; }
+}
+window.addEventListener("hashchange", render);
+render();
+timer = setInterval(() => {
+  const v = location.hash.slice(1) || "overview";
+  // don't clobber the log viewers mid-read
+  if (v !== "logs" && v !== "jobs") render();
+}, 4000);
+</script>
+</body></html>
+"""

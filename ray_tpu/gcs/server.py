@@ -195,6 +195,7 @@ class GcsServer:
         self._pending_actor_queue: List[ActorID] = []
         self._pending_pg_queue: List[PlacementGroupID] = []
         self._node_demands: Dict[NodeID, List[dict]] = {}  # autoscaler feed
+        self._node_stats: Dict[NodeID, dict] = {}  # per-node system stats
         # Actors persisted ALIVE whose hosting raylet hasn't re-registered yet
         # after a GCS restart (reference: gcs_actor_manager.cc restart path —
         # wait for raylet reports, then fail over the unclaimed).
@@ -421,12 +422,18 @@ class GcsServer:
         return True
 
     async def h_report_resources(self, node_id: bytes, snapshot: dict, seq: int,
-                                 pending: Optional[List[dict]] = None):
+                                 pending: Optional[List[dict]] = None,
+                                 stats: Optional[dict] = None):
         nid = NodeID(node_id)
         entry = self.view.get(nid)
         if entry is None:
             return {"ok": False, "unknown": True}  # raylet should re-register
         self._node_demands[nid] = list(pending or [])
+        if stats is not None:
+            # per-node system stats (mem/load/workers) for the dashboard's
+            # node view + per-node Prometheus gauges (reference: per-node
+            # metrics agents, dashboard/modules/reporter)
+            self._node_stats[nid] = stats
         self.view.update_resources(nid, snapshot, seq)
         self.publisher.publish("resources", nid.hex(), {"snapshot": snapshot, "seq": seq})
         self._kick_pending()
@@ -464,6 +471,7 @@ class GcsServer:
                 "alive": e.alive,
                 "resources": e.resources.snapshot(),
                 "object_store_address": e.object_store_address,
+                "stats": self._node_stats.get(e.node_id, {}),
             }
             for e in self.view.all_nodes()
         ]

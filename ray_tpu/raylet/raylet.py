@@ -387,6 +387,28 @@ class Raylet:
                               resources=NodeResources({}))
                 )
 
+    def _system_stats(self) -> dict:
+        """Per-node system stats shipped with every resource report —
+        the dashboard's node view + per-node Prometheus gauges come from
+        here (reference: per-node reporter agents,
+        ``dashboard/modules/reporter/reporter_agent.py``)."""
+        import os as _os
+
+        from ray_tpu.raylet.memory_monitor import system_memory
+
+        used, total = system_memory()
+        try:
+            load1 = _os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        return {
+            "mem_used_bytes": used,
+            "mem_total_bytes": total,
+            "cpu_load_1m": load1,
+            "num_workers": len(self._workers),
+            "num_pending_leases": len(self._pending_leases),
+        }
+
     async def _report_loop(self):
         period = GLOBAL_CONFIG.get("raylet_report_resources_period_ms") / 1000.0
         while not self._stopped:
@@ -403,6 +425,7 @@ class Raylet:
                     pending=[item["request"].to_dict()
                              for item in self._pending_leases
                              if not item["future"].done()],
+                    stats=self._system_stats(),
                 )
                 if isinstance(reply, dict) and reply.get("unknown"):
                     # GCS restarted and lost us: re-register with live state

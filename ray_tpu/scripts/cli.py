@@ -137,6 +137,19 @@ def cmd_stop(_args) -> int:
     return 0
 
 
+def cmd_metrics_config(args) -> int:
+    from ray_tpu.dashboard.metrics_config import generate
+
+    written = generate(args.out_dir, dashboard_url=args.dashboard_url,
+                       prometheus_url=args.prometheus_url)
+    for name, path in written.items():
+        print(f"{name}: {path}")
+    print("run: prometheus --config.file="
+          f"{written['prometheus']}  (and point Grafana's provisioning "
+          "dir at the generated grafana/provisioning)")
+    return 0
+
+
 def cmd_status(args) -> int:
     from ray_tpu.gcs.client import GcsClient
 
@@ -263,6 +276,14 @@ def main(argv=None) -> int:
         "debug", help="event-loop / handler timing dump per daemon")
     pdbg.add_argument("--address", required=True, help="GCS host:port")
     pdbg.set_defaults(fn=cmd_debug)
+
+    pm = sub.add_parser(
+        "metrics-config",
+        help="write Prometheus + Grafana provisioning configs")
+    pm.add_argument("--out-dir", default="./metrics")
+    pm.add_argument("--dashboard-url", default="http://127.0.0.1:8265")
+    pm.add_argument("--prometheus-url", default="http://127.0.0.1:9090")
+    pm.set_defaults(fn=cmd_metrics_config)
 
     pj = sub.add_parser("job", help="job submission commands")
     pj.add_argument("job_cmd",

@@ -100,6 +100,12 @@ class ServeController:
         self._route_version = 0
         self._draining: List[dict] = []  # {"replica", "since"}
         self._ping_failures: Dict[str, int] = {}
+        from ray_tpu.util.metrics import Gauge
+
+        self._ongoing_gauge = Gauge(
+            "rt_serve_ongoing_requests",
+            "in-flight requests summed over an app's replicas",
+            tag_keys=("app",))
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
@@ -208,9 +214,27 @@ class ServeController:
         while not self._stop.is_set():
             try:
                 self._reconcile_once()
+                self._publish_status()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 logger.error("reconcile error:\n%s", traceback.format_exc())
             self._stop.wait(self.RECONCILE_INTERVAL_S)
+
+    def _publish_status(self):
+        """Drop the app table into GCS KV so the dashboard's Serve view
+        reads controller state without a handle to this actor
+        (reference: the Serve dashboard module reads controller
+        checkpoints from the GCS KV)."""
+        import json
+
+        try:
+            from ray_tpu.core_worker.worker import CoreWorker
+
+            gcs = CoreWorker.current_or_raise().gcs
+            payload = {"apps": self.status(), "updated_at": time.time()}
+            gcs.kv_put("serve", b"status",
+                       json.dumps(payload).encode(), overwrite=True)
+        except Exception:  # noqa: BLE001 — dashboarding must never
+            pass           # interfere with reconciliation
 
     DRAIN_TIMEOUT_S = 10.0
 
@@ -323,6 +347,7 @@ class ServeController:
         except Exception:  # noqa: BLE001 — skip this round
             return current
         ongoing = sum(m["ongoing"] for m in metrics)
+        self._ongoing_gauge.set(ongoing, tags={"app": dep.name})
         per_replica = ongoing / max(len(replicas), 1)
         if per_replica > cfg.target_ongoing_requests * cfg.upscale_threshold:
             return min(current + 1, cfg.max_replicas)

@@ -157,6 +157,23 @@ def prometheus_text() -> str:
         else:
             for tagv, v in m.get("values", {}).items():
                 lines.append(f"{name}{{{_labels(m['tag_keys'], tagv)}}} {v}")
+    # RPC handler loop timings (IoContext.record) as cumulative counters —
+    # rate(rt_rpc_handler_seconds_sum[1m]) is per-handler loop load
+    try:
+        from ray_tpu.rpc.rpc import IoContext
+
+        io = IoContext._singleton
+        stats = dict(io.stats) if io is not None else {}
+    except Exception:  # noqa: BLE001
+        stats = {}
+    if stats:
+        lines.append("# TYPE rt_rpc_handler_seconds summary")
+        for handler, (count, total) in sorted(stats.items()):
+            h = handler.replace('"', "")
+            lines.append(
+                f'rt_rpc_handler_seconds_count{{handler="{h}"}} {count}')
+            lines.append(
+                f'rt_rpc_handler_seconds_sum{{handler="{h}"}} {total:.6f}')
     return "\n".join(lines) + "\n"
 
 

@@ -152,3 +152,77 @@ class TestJobSubmission:
         chunks = "".join(client.tail_job_logs(sid))
         assert "line 0" in chunks and "line 4" in chunks
         assert client.get_job_status(sid) == JobStatus.SUCCEEDED
+
+
+class TestDashboardDepth:
+    """Round-4 dashboard depth (VERDICT missing #3): multi-view SPA,
+    per-node stats + Prometheus gauges, serve view, scrape discovery,
+    Grafana/Prometheus config generation."""
+
+    def test_spa_has_all_views(self, dash):
+        _, body = _get(dash + "/")
+        for view in (b'"overview"', b'"nodes"', b'"actors"', b'"jobs"',
+                     b'"serve"', b'"tasks"', b'"metrics"', b'"logs"',
+                     b'"pgs"'):
+            assert view in body, view
+
+    def test_nodes_carry_system_stats(self, dash):
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            _, body = _get(dash + "/api/nodes")
+            stats = json.loads(body)[0].get("stats") or {}
+            if stats.get("mem_total_bytes"):
+                break
+            time.sleep(0.3)
+        assert stats["mem_total_bytes"] > 0
+        assert stats["mem_used_bytes"] > 0
+        assert "cpu_load_1m" in stats and "num_workers" in stats
+
+    def test_per_node_gauges_exported(self, dash):
+        # the history loop (5s period) re-exports raylet stats as
+        # node_id-labelled gauges
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            _, body = _get(dash + "/api/metrics")
+            if b"rt_node_mem_used_bytes{" in body:
+                break
+            time.sleep(0.5)
+        assert b"rt_node_mem_used_bytes{" in body
+        assert b'node_id="' in body
+
+    def test_serve_view_reads_controller_kv(self, dash):
+        _, body = _get(dash + "/api/serve")
+        assert json.loads(body) == {"apps": {}, "updated_at": None}
+        # the controller publishes via GCS KV; emulate one heartbeat
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        gcs = CoreWorker.current_or_raise().gcs
+        gcs.kv_put("serve", b"status", json.dumps(
+            {"apps": {"demo": {"target_replicas": 2,
+                               "running_replicas": 2,
+                               "autoscaling": False}},
+             "updated_at": time.time()}).encode())
+        _, body = _get(dash + "/api/serve")
+        out = json.loads(body)
+        assert out["apps"]["demo"]["running_replicas"] == 2
+        gcs.kv_del("serve", b"status")
+
+    def test_prometheus_service_discovery(self, dash):
+        _, body = _get(dash + "/api/prometheus_sd")
+        sd = json.loads(body)
+        assert sd[0]["labels"]["job"] == "ray_tpu"
+        host_port = sd[0]["targets"][0]
+        assert dash.endswith(host_port)
+
+    def test_metrics_config_generation(self, tmp_path, dash):
+        from ray_tpu.dashboard.metrics_config import generate
+
+        written = generate(str(tmp_path / "metrics"), dashboard_url=dash)
+        prom = open(written["prometheus"]).read()
+        assert f"{dash}/api/prometheus_sd" in prom
+        assert "metrics_path: /api/metrics" in prom
+        db = json.load(open(written["grafana_dashboard"]))
+        assert any("rt_node_mem_used_bytes" in t["expr"]
+                   for p in db["panels"] for t in p["targets"])
+        ds = open(written["grafana_datasource"]).read()
+        assert "prometheus" in ds
