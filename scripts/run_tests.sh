@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Per-file test runner: the documented way to get a full green run on a
+# small (1-core) box. Each test file runs in its OWN pytest process —
+# cluster daemons, shm segments, and asyncio loops never leak across
+# files, and one hung file cannot take the whole suite down (it is
+# killed at PER_FILE_TIMEOUT and reported).
+#
+# Usage:
+#   bash scripts/run_tests.sh            # everything under tests/
+#   bash scripts/run_tests.sh test_rl    # only files matching a substring
+#   PER_FILE_TIMEOUT=900 bash scripts/run_tests.sh
+set -u
+cd "$(dirname "$0")/.."
+
+PER_FILE_TIMEOUT="${PER_FILE_TIMEOUT:-600}"
+FILTER="${1:-}"
+
+pass=0; fail=0; failed_files=()
+for f in tests/test_*.py; do
+  if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
+  start=$(date +%s)
+  if timeout "$PER_FILE_TIMEOUT" python -m pytest "$f" -q -x \
+      > "/tmp/rt_test_$(basename "$f").log" 2>&1; then
+    status=ok; pass=$((pass+1))
+  else
+    status=FAIL; fail=$((fail+1)); failed_files+=("$f")
+  fi
+  printf '%-40s %-5s %3ds\n' "$f" "$status" "$(( $(date +%s) - start ))"
+done
+
+echo "----------------------------------------"
+echo "files passed: $pass   files failed: $fail"
+for f in "${failed_files[@]:-}"; do
+  [[ -n "$f" ]] && echo "  FAILED: $f  (log: /tmp/rt_test_$(basename "$f").log)"
+done
+[[ $fail -eq 0 ]]
