@@ -35,6 +35,12 @@ from ray_tpu.data.datasource import (  # noqa: F401
     write_parquet,
     write_tfrecords,
 )
+from ray_tpu.data.connectors import (  # noqa: F401
+    read_bigquery,
+    read_iceberg,
+    read_lance,
+    read_mongo,
+)
 
 from ray_tpu.util.usage import record_library_usage as _record_usage
 _record_usage("data")

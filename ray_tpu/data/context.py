@@ -27,6 +27,10 @@ from typing import Optional
 class DataContext:
     max_inflight_blocks: int = 16
     op_concurrency_cap: Optional[int] = None
+    # reads split files bigger than this into multiple blocks (parquet:
+    # one read task per row-group chunk — reference dynamic block
+    # splitting / ParquetDatasource row-group planning)
+    target_max_block_size: int = 16 * 1024 * 1024
     default_batch_size: int = 256
     actor_pool_size: int = 2
     max_tasks_in_flight_per_actor: int = 2

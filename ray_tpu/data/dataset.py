@@ -672,34 +672,117 @@ class DataIterator:
 
 
 class GroupedDataset:
-    """Hash-free groupby: sort-merge per key (reference
-    ``grouped_data.py``); aggregations run on the driver over batches."""
+    """Distributed hash groupby (reference
+    ``data/_internal/execution/operators/hash_shuffle.py`` aggregations):
+    blocks are hash-partitioned on the key — every key lands in exactly
+    one partition — then ONE aggregation task per partition computes its
+    keys' results. Only the final (small) aggregate rows reach the
+    driver; ``map_groups`` output stays distributed as blocks."""
+
+    _AGG_FNS = {"sum": np.sum, "mean": np.mean, "min": np.min,
+                "max": np.max, "std": np.std}
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _groups(self) -> Dict[Any, List[Dict]]:
-        groups: Dict[Any, List[Dict]] = {}
-        for row in self._ds.iter_rows():
-            groups.setdefault(row[self._key], []).append(row)
-        return groups
+    def _partitions(self) -> List[Any]:
+        from ray_tpu.data.execution import shuffle_blocks
+
+        refs = self._ds._execute()
+        if not refs:
+            return []
+        n = builtins.max(1, builtins.min(len(refs), 8))
+        return shuffle_blocks(refs, n, mode="hash", key=self._key)
+
+    def _agg(self, aggs: List[tuple]) -> Dataset:
+        """aggs: [(out_col, in_col_or_None, kind)] — one pass over each
+        hash partition computes every requested aggregate per key."""
+        import ray_tpu
+
+        key = self._key
+        fns = self._AGG_FNS
+
+        @ray_tpu.remote
+        def _agg_partition(block):
+            batch = B.block_to_batch(block)
+            if not batch or key not in batch or \
+                    len(next(iter(batch.values()))) == 0:
+                return B.block_from_rows([])
+            keys = np.asarray(batch[key])
+            uniq, inv = np.unique(keys, return_inverse=True)
+            rows = []
+            for i, k in enumerate(uniq):
+                sel = inv == i
+                row = {key: k.item() if hasattr(k, "item") else k}
+                for out, col, kind in aggs:
+                    if kind == "count":
+                        row[out] = int(sel.sum())
+                    else:
+                        v = fns[kind](np.asarray(batch[col])[sel])
+                        row[out] = v.item() if hasattr(v, "item") else v
+                rows.append(row)
+            return B.block_from_rows(rows)
+
+        out = []
+        for blk in ray_tpu.get(
+                [_agg_partition.remote(p) for p in self._partitions()]):
+            out.extend(B.block_to_rows(blk))
+        out.sort(key=lambda r: r[self._key])
+        return from_items_rows(out)
 
     def count(self) -> Dataset:
-        rows = [{self._key: k, "count()": len(v)}
-                for k, v in sorted(self._groups().items())]
-        return from_items_rows(rows)
+        return self._agg([("count()", None, "count")])
 
     def sum(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"sum({on})": builtins.sum(r[on] for r in v)}
-                for k, v in sorted(self._groups().items())]
-        return from_items_rows(rows)
+        return self._agg([(f"sum({on})", on, "sum")])
 
     def mean(self, on: str) -> Dataset:
-        rows = [{self._key: k,
-                 f"mean({on})": builtins.sum(r[on] for r in v) / len(v)}
-                for k, v in sorted(self._groups().items())]
-        return from_items_rows(rows)
+        return self._agg([(f"mean({on})", on, "mean")])
+
+    def min(self, on: str) -> Dataset:
+        return self._agg([(f"min({on})", on, "min")])
+
+    def max(self, on: str) -> Dataset:
+        return self._agg([(f"max({on})", on, "max")])
+
+    def std(self, on: str) -> Dataset:
+        return self._agg([(f"std({on})", on, "std")])
+
+    def aggregate(self, **named) -> Dataset:
+        """Multiple aggregates in one shuffle+pass:
+        ``ds.groupby("k").aggregate(total=("v", "sum"), n=(None, "count"))``
+        """
+        aggs = []
+        for out, (col, kind) in named.items():
+            if kind != "count" and kind not in self._AGG_FNS:
+                raise ValueError(f"unknown aggregation {kind!r}")
+            aggs.append((out, col, kind))
+        return self._agg(aggs)
+
+    def map_groups(self, fn) -> Dataset:
+        """Apply ``fn(rows: List[dict]) -> List[dict]`` to each key group
+        (reference ``GroupedData.map_groups``). Runs one task per hash
+        partition; output blocks stay distributed."""
+        import ray_tpu
+
+        key = self._key
+
+        @ray_tpu.remote
+        def _map_partition(block):
+            groups: Dict[Any, List[Dict]] = {}
+            for row in B.block_to_rows(block):
+                groups.setdefault(row[key], []).append(row)
+            out: List[Dict] = []
+            for k in sorted(groups):
+                res = fn(groups[k])
+                if isinstance(res, dict):
+                    res = [res]
+                out.extend(res)
+            return B.block_from_rows(out)
+
+        refs = [_map_partition.remote(p) for p in self._partitions()]
+        return Dataset([_FromRefs(refs)])
 
 
 def _is_ready(ref) -> bool:

@@ -1,0 +1,222 @@
+"""Round-4 Data depth (VERDICT missing #8): distributed groupby
+aggregations, parquet row-group planning + pushdown, external-store
+connectors (stub clients — the libs aren't in this image)."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data.context import DataContext
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestDistributedGroupby:
+    def _ds(self):
+        rows = [{"k": i % 5, "v": float(i)} for i in range(100)]
+        return data.from_items(rows, num_blocks=8)
+
+    def test_count_sum_mean(self, rt):
+        out = {r["k"]: r for r in self._ds().groupby("k").count().take_all()}
+        assert all(out[k]["count()"] == 20 for k in range(5))
+        out = {r["k"]: r["sum(v)"]
+               for r in self._ds().groupby("k").sum("v").take_all()}
+        assert out[0] == sum(float(i) for i in range(0, 100, 5))
+
+    def test_min_max_std(self, rt):
+        g = self._ds().groupby("k")
+        assert {r["k"]: r["min(v)"] for r in g.min("v").take_all()}[3] == 3.0
+        assert {r["k"]: r["max(v)"] for r in g.max("v").take_all()}[3] == 98.0
+        stds = {r["k"]: r["std(v)"] for r in g.std("v").take_all()}
+        want = np.std(np.arange(3, 100, 5, dtype=float))
+        assert abs(stds[3] - want) < 1e-9
+
+    def test_multi_aggregate_single_pass(self, rt):
+        out = self._ds().groupby("k").aggregate(
+            total=("v", "sum"), n=(None, "count"),
+            hi=("v", "max")).take_all()
+        row = {r["k"]: r for r in out}[2]
+        assert row["n"] == 20 and row["hi"] == 97.0
+        assert row["total"] == sum(float(i) for i in range(2, 100, 5))
+
+    def test_map_groups_stays_distributed(self, rt):
+        def summarize(rows):
+            return {"k": rows[0]["k"],
+                    "spread": max(r["v"] for r in rows)
+                    - min(r["v"] for r in rows)}
+
+        ds = self._ds().groupby("k").map_groups(summarize)
+        out = sorted(ds.take_all(), key=lambda r: r["k"])
+        assert len(out) == 5 and all(r["spread"] == 95.0 for r in out)
+
+    def test_string_keys(self, rt):
+        rows = [{"name": n, "x": i} for i, n in
+                enumerate(["a", "b", "a", "c", "b", "a"])]
+        out = {r["name"]: r["count()"] for r in
+               data.from_items(rows, num_blocks=3)
+               .groupby("name").count().take_all()}
+        assert out == {"a": 3, "b": 2, "c": 1}
+
+
+class TestParquetPlanning:
+    def _write(self, tmp_path, rows=2000, row_group_size=200):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({"x": np.arange(rows),
+                      "y": np.random.default_rng(0).normal(size=rows),
+                      "s": [f"r{i}" for i in range(rows)]})
+        path = str(tmp_path / "data.parquet")
+        pq.write_table(t, path, row_group_size=row_group_size)
+        return path, t
+
+    def test_row_group_splitting(self, rt, tmp_path):
+        path, t = self._write(tmp_path)
+        prev = DataContext.get_current().target_max_block_size
+        DataContext.get_current().target_max_block_size = 4096
+        try:
+            ds = data.read_parquet(path)
+            rows = ds.take_all()
+            assert len(rows) == 2000
+            assert sorted(r["x"] for r in rows) == list(range(2000))
+            # 10 row groups, tiny target -> many read tasks, not one
+            assert ds.num_blocks() > 1
+        finally:
+            DataContext.get_current().target_max_block_size = prev
+
+    def test_column_projection_pushdown(self, rt, tmp_path):
+        path, _ = self._write(tmp_path)
+        rows = data.read_parquet(path, columns=["x"]).take(3)
+        assert all(set(r) == {"x"} for r in rows)
+
+    def test_filter_pushdown(self, rt, tmp_path):
+        path, _ = self._write(tmp_path)
+        rows = data.read_parquet(
+            path, filter=[("x", ">=", 1990)]).take_all()
+        assert sorted(r["x"] for r in rows) == list(range(1990, 2000))
+
+
+class TestConnectors:
+    def test_missing_dependency_errors_name_the_lib(self):
+        for fn, lib, modname, kwargs in [
+            (data.read_mongo, "pymongo", "pymongo",
+             dict(uri="mongodb://x", database="d", collection="c")),
+            (data.read_bigquery, "google-cloud-bigquery",
+             "google.cloud.bigquery",
+             dict(project_id="p", query="select 1")),
+            (data.read_lance, "pylance", "lance",
+             dict(uri="/tmp/x.lance")),
+            (data.read_iceberg, "pyiceberg", "pyiceberg",
+             dict(table_identifier="db.t")),
+        ]:
+            try:
+                __import__(modname)
+            except ImportError:
+                with pytest.raises(ImportError, match=lib):
+                    fn(**kwargs)
+            # lib present in this image (e.g. bigquery): the gate is
+            # exercised by the others; nothing to assert here
+
+    def test_bigquery_arg_validation(self):
+        pytest.importorskip("google.cloud.bigquery")
+        with pytest.raises(ValueError, match="exactly one"):
+            data.read_bigquery("proj")
+        with pytest.raises(ValueError, match="exactly one"):
+            data.read_bigquery("proj", query="q", dataset="d")
+
+    def test_mongo_partitioned_read_with_stub(self, rt, monkeypatch):
+        """Planning + conversion against a stub pymongo: parallelism
+        skip/limit ranges sorted by _id, _id stripped by default."""
+        docs = [{"_id": i, "a": i, "b": f"v{i}"} for i in range(10)]
+
+        class _Coll:
+            def count_documents(self, q):
+                return len(docs)
+
+            def find(self, q, proj):
+                class _Cur:
+                    def __init__(self):
+                        self._d = list(docs)
+
+                    def sort(self, k, d):
+                        self._d.sort(key=lambda r: r[k],
+                                     reverse=d < 0)
+                        return self
+
+                    def skip(self, n):
+                        self._d = self._d[n:]
+                        return self
+
+                    def limit(self, n):
+                        self._d = self._d[:n]
+                        return self
+
+                    def __iter__(self):
+                        return iter([dict(r) for r in self._d])
+
+                return _Cur()
+
+        class _Client:
+            def __init__(self, uri):
+                pass
+
+            def __getitem__(self, name):
+                return {"c": _Coll()}
+
+            def close(self):
+                pass
+
+        fake = types.ModuleType("pymongo")
+        fake.MongoClient = _Client
+        monkeypatch.setitem(sys.modules, "pymongo", fake)
+
+        ds = data.read_mongo("mongodb://stub", "db", "c", parallelism=3)
+        tasks = ds._ops[0].read_tasks
+        assert len(tasks) == 3                  # skip/limit ranges
+        # stub client lives only in THIS process: run the planned read
+        # tasks in-process (workers don't have the lib either way)
+        from ray_tpu.data import block as B
+
+        rows = []
+        for t in tasks:
+            rows.extend(B.block_to_rows(t()))
+        rows.sort(key=lambda r: r["a"])
+        assert len(rows) == 10
+        assert rows[4] == {"a": 4, "b": "v4"}  # _id stripped
+
+    def test_lance_fragment_read_with_stub(self, rt, monkeypatch):
+        import pyarrow as pa
+
+        class _Frag:
+            def __init__(self, fid, lo, hi):
+                self.fragment_id = fid
+                self._lo, self._hi = lo, hi
+
+            def to_table(self, columns=None, filter=None):
+                t = pa.table({"x": list(range(self._lo, self._hi))})
+                return t.select(columns) if columns else t
+
+        class _DS:
+            def get_fragments(self):
+                return [_Frag(0, 0, 5), _Frag(1, 5, 9)]
+
+        fake = types.ModuleType("lance")
+        fake.dataset = lambda uri: _DS()
+        monkeypatch.setitem(sys.modules, "lance", fake)
+
+        ds = data.read_lance("/stub.lance")
+        tasks = ds._ops[0].read_tasks
+        assert len(tasks) == 2                  # one per fragment
+        from ray_tpu.data import block as B
+
+        xs = [r["x"] for t in tasks for r in B.block_to_rows(t())]
+        assert sorted(xs) == list(range(9))
