@@ -133,6 +133,9 @@ def init(
                         LABEL_SLICE_WORKER_INDEX, str(found["worker_id"]))
             raylet = Raylet(gcs.address, resources=node_resources,
                             labels=node_labels)
+            # before start(): the node's own ALIVE registration must land
+            # in the export log too
+            gcs.attach_export_logger(raylet.session_dir)
             raylet.start()
             _head = {"gcs": gcs, "raylet": raylet}
             gcs_address = gcs.address

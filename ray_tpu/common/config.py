@@ -182,6 +182,11 @@ _D("autoscaler_idle_timeout_s", float, 30.0, "idle node termination threshold")
 _D("autoscaler_launch_timeout_s", float, 120.0,
    "drop a launched node that never registers with the GCS within this time")
 
+# --- observability -----------------------------------------------------------
+_D("enable_export_api", bool, False,
+   "write versioned JSONL export events (actor/node/job/PG transitions)"
+   " under <session>/export_events/ for external tooling")
+
 # --- compiled graphs ---------------------------------------------------------
 _D("pipeline_overlap", bool, True,
    "overlap channel transfer with stage compute in compiled pipelines:"

@@ -51,7 +51,10 @@ class Dashboard:
             k: Gauge(f"rt_node_{k}", f"per-node {k.replace('_', ' ')}",
                      tag_keys=("node_id",))
             for k in ("mem_used_bytes", "mem_total_bytes", "cpu_load_1m",
-                      "num_workers", "num_pending_leases")
+                      "num_workers", "num_pending_leases",
+                      "object_store_capacity_bytes",
+                      "object_store_used_bytes",
+                      "object_store_num_objects")
         }
         self._register_routes()
 

@@ -196,6 +196,9 @@ class GcsServer:
         self._pending_pg_queue: List[PlacementGroupID] = []
         self._node_demands: Dict[NodeID, List[dict]] = {}  # autoscaler feed
         self._node_stats: Dict[NodeID, dict] = {}  # per-node system stats
+        # export API (util/export_events.py): attached post-boot by the
+        # session owner when enable_export_api is set
+        self._export_logger = None
         # Actors persisted ALIVE whose hosting raylet hasn't re-registered yet
         # after a GCS restart (reference: gcs_actor_manager.cc restart path —
         # wait for raylet reports, then fail over the unclaimed).
@@ -282,6 +285,30 @@ class GcsServer:
         ):
             s.register(name, getattr(self, f"h_{name}"))
 
+    def attach_export_logger(self, session_dir: str) -> None:
+        """Start writing structured export events (actor/node/job/PG
+        state transitions) under ``session_dir`` when the
+        ``enable_export_api`` flag is set (reference: export API,
+        src/ray/util/event.cc)."""
+        if GLOBAL_CONFIG.get("enable_export_api"):
+            from ray_tpu.util.export_events import ExportEventLogger
+
+            self._export_logger = ExportEventLogger(session_dir)
+
+    def _export(self, source_type: str, **event_data) -> None:
+        if self._export_logger is not None:
+            self._export_logger.emit(source_type, event_data)
+
+    def _publish_actor(self, rec: ActorRecord) -> None:
+        """Chokepoint for actor state changes: pubsub + export event."""
+        self.publisher.publish("actor", rec.actor_id.hex(),
+                               rec.public_view())
+        self._export("EXPORT_ACTOR", **rec.public_view())
+
+    def _publish_pg(self, rec: PgRecord) -> None:
+        self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
+        self._export("EXPORT_PLACEMENT_GROUP", **rec.public_view())
+
     def start(self):
         self.server.start()
         self._io.spawn_threadsafe(self._health_loop())
@@ -291,6 +318,8 @@ class GcsServer:
 
     def stop(self):
         self._stopped = True
+        if self._export_logger is not None:
+            self._export_logger.close()
         for h in self._raylets.values():
             h.close()
         self.server.stop()
@@ -317,6 +346,8 @@ class GcsServer:
         self.view.upsert(entry)
         self._raylets[nid] = RayletHandle(tuple(address))
         self.publisher.publish("node", nid.hex(), {"state": "ALIVE", "address": tuple(address)})
+        self._export("EXPORT_NODE", node_id=nid.hex(), state="ALIVE",
+                     address=list(address), resources=dict(resources))
         logger.info("node %s registered at %s", nid.hex()[:8], address)
         # Re-registration after a GCS restart: the raylet reports what it
         # still hosts so replayed records can be re-confirmed instead of
@@ -340,7 +371,7 @@ class GcsServer:
             rec.address = info["address"] and tuple(info["address"])
             self._unconfirmed_actors.discard(rec.actor_id)
             self._persist_actor(rec)
-            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+            self._publish_actor(rec)
         stale_pgs = []
         for info in held_bundles or []:
             rec = self._pgs.get(PlacementGroupID(info["pg_id"]))
@@ -519,6 +550,8 @@ class GcsServer:
         if handle:
             handle.close()
         self.publisher.publish("node", nid.hex(), {"state": "DEAD", "reason": reason})
+        self._export("EXPORT_NODE", node_id=nid.hex(), state="DEAD",
+                     reason=reason)
         # fail over actors that lived there
         for rec in list(self._actors.values()):
             if rec.node_id == nid and rec.state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
@@ -529,7 +562,7 @@ class GcsServer:
                 pg.state = PG_RESCHEDULING
                 pg.bundle_nodes = [None if b == nid else b for b in pg.bundle_nodes]
                 self._persist_pg(pg)
-                self.publisher.publish("pg", pg.pg_id.hex(), pg.public_view())
+                self._publish_pg(pg)
                 self._pending_pg_queue.append(pg.pg_id)
         self._kick_pending()
 
@@ -545,6 +578,8 @@ class GcsServer:
         self._jobs[jid] = JobRecord(jid, driver_address and tuple(driver_address), time.time(), entrypoint=entrypoint)
         self._persist_job(self._jobs[jid])
         self.publisher.publish("job", jid.hex(), {"state": "RUNNING"})
+        self._export("EXPORT_JOB", job_id=jid.hex(), state="RUNNING",
+                     entrypoint=entrypoint)
         return True
 
     async def h_finish_job(self, job_id: bytes):
@@ -554,6 +589,7 @@ class GcsServer:
             rec.state = "FINISHED"
             self._persist_job(rec)
             self.publisher.publish("job", jid.hex(), {"state": "FINISHED"})
+            self._export("EXPORT_JOB", job_id=jid.hex(), state="FINISHED")
         # tear down the job's detached=False actors
         for actor in list(self._actors.values()):
             if actor.job_id == jid and actor.state not in (ACTOR_DEAD,):
@@ -708,7 +744,7 @@ class GcsServer:
                 rec.handled_deaths.add(wid)
             await self._on_actor_failure(rec, death_cause or "worker died")
             return True
-        self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+        self._publish_actor(rec)
         return True
 
     async def _on_actor_failure(self, rec: ActorRecord, cause: str):
@@ -720,13 +756,13 @@ class GcsServer:
             rec.address = None
             rec.worker_id = None
             self._persist_actor(rec)
-            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+            self._publish_actor(rec)
             await self._schedule_actor(rec)
         else:
             rec.state = ACTOR_DEAD
             rec.death_cause = cause
             self._persist_actor(rec)
-            self.publisher.publish("actor", rec.actor_id.hex(), rec.public_view())
+            self._publish_actor(rec)
 
     async def h_get_actor(self, actor_id: bytes):
         rec = self._actors.get(ActorID(actor_id))
@@ -854,7 +890,7 @@ class GcsServer:
         rec.bundle_nodes = list(placement)
         rec.state = PG_CREATED
         self._persist_pg(rec)
-        self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
+        self._publish_pg(rec)
 
     async def h_remove_placement_group(self, pg_id: bytes):
         rec = self._pgs.get(PlacementGroupID(pg_id))
@@ -871,7 +907,7 @@ class GcsServer:
                     pass
         rec.state = PG_REMOVED
         self._persist_pg(rec)
-        self.publisher.publish("pg", rec.pg_id.hex(), rec.public_view())
+        self._publish_pg(rec)
         return True
 
     async def h_get_placement_group(self, pg_id: bytes):

@@ -401,13 +401,31 @@ class Raylet:
             load1 = _os.getloadavg()[0]
         except OSError:
             load1 = 0.0
-        return {
+        out = {
             "mem_used_bytes": used,
             "mem_total_bytes": total,
             "cpu_load_1m": load1,
             "num_workers": len(self._workers),
             "num_pending_leases": len(self._pending_leases),
         }
+        # native shm object-store occupancy (rts_stats) — the node-local
+        # plasma equivalent's capacity/used/object-count. Handle opened
+        # once and cached (the report loop runs every 100ms).
+        try:
+            store = getattr(self, "_shm_stats_store", None)
+            if store is None:
+                from ray_tpu.object_store.shm import ShmObjectStore
+
+                store = ShmObjectStore(
+                    f"/rtshm_{self.node_id.hex()[:12]}", create=False)
+                self._shm_stats_store = store
+            cap, used_b, n_obj = store.stats()
+            out["object_store_capacity_bytes"] = cap
+            out["object_store_used_bytes"] = used_b
+            out["object_store_num_objects"] = n_obj
+        except Exception:  # noqa: BLE001 — store may be disabled
+            pass
+        return out
 
     async def _report_loop(self):
         period = GLOBAL_CONFIG.get("raylet_report_resources_period_ms") / 1000.0

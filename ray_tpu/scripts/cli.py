@@ -55,6 +55,7 @@ def cmd_start(args) -> int:
         gcs.start()
         raylet = Raylet(gcs.address, resources=resources or None,
                         labels=labels or None)
+        gcs.attach_export_logger(raylet.session_dir)
         raylet.start()
         dash = None
         if args.dashboard:

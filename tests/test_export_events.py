@@ -1,0 +1,123 @@
+"""Export-event system (reference: export API, src/ray/util/event.cc +
+export_api protos) and native object-store stats surfacing."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.util.export_events import (SCHEMA_VERSION, ExportEventLogger,
+                                        read_export_events)
+
+
+def test_logger_envelope(tmp_path):
+    log = ExportEventLogger(str(tmp_path))
+    log.emit("EXPORT_ACTOR", {"actor_id": "a1", "state": "ALIVE"})
+    log.emit("EXPORT_JOB", {"job_id": "j1", "state": "RUNNING"})
+    log.close()
+    evs = read_export_events(str(tmp_path))
+    assert len(evs) == 2
+    for ev in evs:
+        assert ev["schema_version"] == SCHEMA_VERSION
+        assert ev["event_id"] and ev["timestamp"] > 0
+    actor = read_export_events(str(tmp_path), "EXPORT_ACTOR")[0]
+    assert actor["event_data"]["state"] == "ALIVE"
+    # one file per source type
+    files = os.listdir(str(tmp_path / "export_events"))
+    assert sorted(files) == ["event_EXPORT_ACTOR.log",
+                             "event_EXPORT_JOB.log"]
+
+
+def test_unknown_source_type_rejected(tmp_path):
+    log = ExportEventLogger(str(tmp_path))
+    with pytest.raises(ValueError, match="unknown export source"):
+        log.emit("EXPORT_BOGUS", {})
+
+
+class TestClusterExport:
+    @pytest.fixture(scope="class")
+    def rt(self):
+        GLOBAL_CONFIG.set_system_config_value("enable_export_api", True)
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        yield ray_tpu
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.set_system_config_value("enable_export_api", False)
+
+    def _session_dir(self):
+        from ray_tpu.api import _head
+
+        return _head["raylet"].session_dir
+
+    def test_node_and_actor_transitions_exported(self, rt):
+        class A:
+            def ping(self):
+                return 1
+
+        a = rt.remote(A).options(name="exp-actor").remote()
+        assert rt.get(a.ping.remote(), timeout=60) == 1
+        rt.kill(a)
+
+        sd = self._session_dir()
+        nodes = read_export_events(sd, "EXPORT_NODE")
+        assert any(e["event_data"]["state"] == "ALIVE" for e in nodes)
+        import time
+
+        deadline = time.time() + 20
+        states = set()
+        while time.time() < deadline:
+            states = {e["event_data"]["state"]
+                      for e in read_export_events(sd, "EXPORT_ACTOR")}
+            if "DEAD" in states:
+                break
+            time.sleep(0.2)
+        assert "ALIVE" in states and "DEAD" in states, states
+
+    def test_pg_lifecycle_exported(self, rt):
+        pg = rt.placement_group([{"CPU": 1}])
+        assert pg.ready(timeout=60)
+        rt.remove_placement_group(pg)
+        import time
+
+        deadline = time.time() + 20
+        states = set()
+        while time.time() < deadline:
+            states = {e["event_data"]["state"] for e in read_export_events(
+                self._session_dir(), "EXPORT_PLACEMENT_GROUP")}
+            if "REMOVED" in states:
+                break
+            time.sleep(0.2)
+        assert "CREATED" in states and "REMOVED" in states, states
+
+    def test_events_are_valid_jsonl(self, rt):
+        d = os.path.join(self._session_dir(), "export_events")
+        for fname in os.listdir(d):
+            with open(os.path.join(d, fname)) as f:
+                for line in f:
+                    if line.strip():
+                        json.loads(line)
+
+    def test_object_store_stats_reported(self, rt):
+        """Native shm store occupancy flows raylet -> GCS node stats."""
+        import numpy as np
+        import time
+
+        from ray_tpu.gcs.client import GcsClient
+        from ray_tpu.api import _head
+
+        ref = rt.put(np.zeros(2 << 20, np.uint8))  # lands in shm
+        c = GcsClient(_head["gcs"].address)
+        try:
+            deadline = time.time() + 15
+            stats = {}
+            while time.time() < deadline:
+                nodes = c.get_all_nodes()
+                stats = nodes[0].get("stats") or {}
+                if stats.get("object_store_capacity_bytes"):
+                    break
+                time.sleep(0.3)
+            assert stats.get("object_store_capacity_bytes", 0) > 0
+        finally:
+            c.close()
+        del ref
