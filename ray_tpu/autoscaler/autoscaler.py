@@ -30,6 +30,9 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu.common.config import GLOBAL_CONFIG
 from ray_tpu.gcs.client import GcsClient
 
+from .instance_manager import (ALLOCATED, ALLOCATION_FAILED, QUEUED,
+                               RAY_RUNNING, REQUESTED, TERMINATED,
+                               TERMINATING, InstanceManager)
 from .provider import NodeProvider
 
 logger = logging.getLogger(__name__)
@@ -79,8 +82,10 @@ class Autoscaler:
         self._idle_timeout = (
             idle_timeout_s if idle_timeout_s is not None
             else GLOBAL_CONFIG.get("autoscaler_idle_timeout_s"))
-        self._launched: Dict[str, str] = {}       # node handle -> type name
-        self._launch_time: Dict[str, float] = {}  # node handle -> monotonic
+        # v2 instance-manager model: every launch is an Instance moving
+        # through an explicit FSM (instance_manager.py); the flat views
+        # below are DERIVED from it
+        self.instance_manager = InstanceManager()
         self._idle_since: Dict[str, float] = {}
         # a launched node that never registers (crashed boot, dead cloud
         # instance) must not count as capacity forever
@@ -107,18 +112,31 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(timeout=10)
         if terminate_nodes:
-            for handle in list(self._launched):
-                self._provider.terminate_node(handle)
-                self._forget(handle)
+            for inst in self.instance_manager.active():
+                if inst.handle is not None:
+                    self._provider.terminate_node(inst.handle)
+                self._terminate_instance(inst, "autoscaler stop")
         self._gcs.close()
 
-    def _forget(self, handle: str) -> None:
-        self._launched.pop(handle, None)
-        self._launch_time.pop(handle, None)
-        self._idle_since.pop(handle, None)
+    @property
+    def _launched(self) -> Dict[str, str]:
+        """Derived view: live launch handle -> node type."""
+        return {i.handle: i.node_type
+                for i in self.instance_manager.active()
+                if i.handle is not None}
+
+    def _terminate_instance(self, inst, details: str) -> None:
+        if inst.status not in (TERMINATING,):
+            self.instance_manager.transition(inst.instance_id, TERMINATING,
+                                             details)
+        self.instance_manager.transition(inst.instance_id, TERMINATED,
+                                         details)
+        self._idle_since.pop(inst.handle, None)
 
     def status(self) -> Dict[str, object]:
         return {"launched": dict(self._launched),
+                "instances": [i.view()
+                              for i in self.instance_manager.all()],
                 "types": {n: t.max_workers for n, t in self._types.items()}}
 
     # ------------------------------------------------------------------- loop
@@ -159,33 +177,57 @@ class Autoscaler:
         # (launch→registration latency is seconds on a real provider).
         capacities = [dict((n.get("resources") or {}).get("available") or {})
                       for n in alive]
-        now = time.monotonic()
-        for handle, type_name in list(self._launched.items()):
-            if handle in alive_ids:
-                self._launch_time.pop(handle, None)  # registered
+        now = time.time()
+        # retry terminations that failed on a previous tick
+        for inst in self.instance_manager.by_status(TERMINATING):
+            try:
+                if inst.handle is not None:
+                    self._provider.terminate_node(inst.handle)
+            except Exception:  # noqa: BLE001 — retried next tick
+                logger.exception("terminate of %s failed; will retry",
+                                 inst.instance_id)
+            else:
+                self.instance_manager.transition(
+                    inst.instance_id, TERMINATED, inst.details)
+        for inst in self.instance_manager.active():
+            handle = inst.handle
+            if handle is None:
                 continue
-            started = self._launch_time.get(handle)
-            timed_out = (started is not None
-                         and now - started > self._launch_timeout)
-            if handle in dead_ids or timed_out:
+            if handle in alive_ids:
+                if inst.status == ALLOCATED:
+                    self.instance_manager.transition(
+                        inst.instance_id, RAY_RUNNING, "node registered")
+                continue
+            timed_out = (inst.status in (REQUESTED, ALLOCATED)
+                         and now - inst.status_since > self._launch_timeout)
+            # a dead-table hit proves the node registered then died, even
+            # if no tick ever observed it alive (register->die can fit
+            # entirely between two reconcile passes)
+            died = (handle in dead_ids
+                    and inst.status in (ALLOCATED, RAY_RUNNING))
+            if died or timed_out:
                 # registered-then-died, or never registered in time: the
                 # node must stop counting as capacity and stop occupying a
-                # max_workers slot. On terminate failure keep the entry so
-                # the terminate is retried next tick (never silently leak
-                # a running instance).
-                logger.warning(
-                    "dropping node %s (%s)", handle[:8],
-                    "died" if handle in dead_ids else
-                    f"never registered within {self._launch_timeout:.0f}s")
+                # max_workers slot. On terminate failure the instance
+                # stays TERMINATING and is retried next tick (never
+                # silently leak a running instance).
+                reason = ("died" if died else
+                          f"never registered within "
+                          f"{self._launch_timeout:.0f}s")
+                logger.warning("dropping node %s (%s)", handle[:8], reason)
+                self.instance_manager.transition(
+                    inst.instance_id, TERMINATING, reason)
                 try:
                     self._provider.terminate_node(handle)
                 except Exception:  # noqa: BLE001 — retried next tick
                     logger.exception("terminate of %s failed; will retry",
                                      handle[:8])
                 else:
-                    self._forget(handle)
+                    self.instance_manager.transition(
+                        inst.instance_id, TERMINATED, reason)
+                    self._idle_since.pop(handle, None)
                 continue  # either way: no capacity credit
-            capacities.append(dict(self._types[type_name].resources))
+            capacities.append(dict(self._types[inst.node_type].resources))
         unmet: List[Dict[str, float]] = []
         for demand in sorted(demands, key=lambda d: -sum(d.values())):
             for cap in capacities:
@@ -230,27 +272,33 @@ class Autoscaler:
                                "(or max_workers reached)", demand)
         for type_name, _cap in planned:
             t = self._types[type_name]
+            inst = self.instance_manager.create(t.name)  # QUEUED
+            self.instance_manager.transition(inst.instance_id, REQUESTED,
+                                             "launch issued")
             handle = self._provider.launch_node(
                 t.name, dict(t.resources), dict(t.labels))
-            self._launched[handle] = t.name
-            self._launch_time[handle] = time.monotonic()
-            # only after the launch is recorded may the node register —
-            # otherwise a fast in-process node can satisfy pending demand
-            # while status() still shows nothing launched
+            # the handle is recorded BEFORE confirm: a fast in-process
+            # node must not register while status() shows nothing launched
+            self.instance_manager.transition(inst.instance_id, ALLOCATED,
+                                             "provider allocated",
+                                             handle=handle)
             try:
                 self._provider.confirm_launch(handle)
             except Exception:  # noqa: BLE001 — boot failure: retry next tick
                 logger.exception("node %s failed to start", handle[:8])
+                self.instance_manager.transition(
+                    inst.instance_id, TERMINATING, "boot failed")
                 try:
                     # the provider may have allocated a real instance before
                     # the failure; never leak it unattended
                     self._provider.terminate_node(handle)
-                except Exception:  # noqa: BLE001 — keep the entry: the
-                    # launch-timeout sweep will retry the terminate
+                except Exception:  # noqa: BLE001 — stays TERMINATING: the
+                    # reconcile sweep retries the terminate next tick
                     logger.exception("terminate of %s failed; will retry",
                                      handle[:8])
                 else:
-                    self._forget(handle)
+                    self.instance_manager.transition(
+                        inst.instance_id, TERMINATED, "boot failed")
 
     def _terminate_idle(self, alive_nodes: List[dict], have_demand: bool):
         now = time.monotonic()
@@ -273,7 +321,14 @@ class Autoscaler:
             if fully_idle and not have_demand:
                 first = self._idle_since.setdefault(handle, now)
                 if now - first >= self._idle_timeout:
+                    inst = self.instance_manager.by_handle(handle)
+                    if inst is not None:
+                        self.instance_manager.transition(
+                            inst.instance_id, TERMINATING, "idle timeout")
                     self._provider.terminate_node(handle)
-                    self._forget(handle)
+                    if inst is not None:
+                        self.instance_manager.transition(
+                            inst.instance_id, TERMINATED, "idle timeout")
+                    self._idle_since.pop(handle, None)
             else:
                 self._idle_since.pop(handle, None)

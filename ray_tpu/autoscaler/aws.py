@@ -1,0 +1,102 @@
+"""AWS EC2 node provider.
+
+Reference: ``python/ray/autoscaler/_private/aws/node_provider.py`` —
+EC2 instances launched per node type, tagged for discovery, terminated
+by instance id. boto3 is not part of this image; the import is lazy and
+the request/response mapping is exercised in tests against a stub
+client. The node's launch handle (the EC2 instance id) must be stamped
+into the raylet's node labels (``rt.io/launch-handle``) by the user-data
+boot script so the autoscaler can correlate GCS nodes with instances —
+the same contract GcePodProvider uses.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from .provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+LAUNCH_HANDLE_LABEL = "rt.io/launch-handle"
+
+
+class AwsProvider(NodeProvider):
+    def __init__(self, *, region: str, ami: str, subnet_id: str,
+                 key_name: Optional[str] = None,
+                 security_group_ids: Optional[List[str]] = None,
+                 instance_types: Optional[Dict[str, str]] = None,
+                 user_data_template: str = "",
+                 tag_prefix: str = "ray-tpu"):
+        """``instance_types``: node-type name -> EC2 instance type."""
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AwsProvider requires the optional dependency 'boto3', "
+                "which is not installed. pip install boto3") from e
+        import boto3
+
+        self._ec2 = boto3.client("ec2", region_name=region)
+        self._ami = ami
+        self._subnet = subnet_id
+        self._key_name = key_name
+        self._sgs = list(security_group_ids or [])
+        self._instance_types = dict(instance_types or {})
+        self._user_data = user_data_template
+        self._tag_prefix = tag_prefix
+
+    def launch_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        ec2_type = self._instance_types.get(node_type, node_type)
+        kwargs = {
+            "ImageId": self._ami,
+            "InstanceType": ec2_type,
+            "MinCount": 1, "MaxCount": 1,
+            "SubnetId": self._subnet,
+            "TagSpecifications": [{
+                "ResourceType": "instance",
+                "Tags": [
+                    {"Key": "Name",
+                     "Value": f"{self._tag_prefix}-{node_type}"},
+                    {"Key": f"{self._tag_prefix}:node-type",
+                     "Value": node_type},
+                ],
+            }],
+        }
+        if self._key_name:
+            kwargs["KeyName"] = self._key_name
+        if self._sgs:
+            kwargs["SecurityGroupIds"] = self._sgs
+        if self._user_data:
+            # boot script joins the cluster and stamps the launch handle
+            # into node labels; the instance id isn't known pre-launch,
+            # so the template uses EC2 instance metadata at boot
+            kwargs["UserData"] = self._user_data.format(
+                node_type=node_type, resources=resources, labels=labels)
+        resp = self._ec2.run_instances(**kwargs)
+        instance_id = resp["Instances"][0]["InstanceId"]
+        logger.info("launched EC2 %s (%s) for node type %s",
+                    instance_id, ec2_type, node_type)
+        return instance_id
+
+    def confirm_launch(self, node_handle: str) -> None:
+        waiter = self._ec2.get_waiter("instance_running")
+        waiter.wait(InstanceIds=[node_handle],
+                    WaiterConfig={"Delay": 5, "MaxAttempts": 24})
+
+    def terminate_node(self, node_handle: str) -> None:
+        self._ec2.terminate_instances(InstanceIds=[node_handle])
+
+    def live_nodes(self) -> List[str]:
+        resp = self._ec2.describe_instances(Filters=[
+            {"Name": f"tag:{self._tag_prefix}:node-type",
+             "Values": ["*"]},
+            {"Name": "instance-state-name",
+             "Values": ["pending", "running"]},
+        ])
+        out = []
+        for res in resp.get("Reservations", []):
+            out.extend(i["InstanceId"] for i in res.get("Instances", []))
+        return out

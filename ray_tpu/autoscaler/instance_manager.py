@@ -1,0 +1,151 @@
+"""Instance manager: explicit per-instance lifecycle FSM.
+
+Reference: ``python/ray/autoscaler/v2/instance_manager/`` — instances
+move through a declared state machine (``instance_storage.py`` +
+``common.py`` InstanceStatus) and the reconciler converges cloud state +
+ray state against it. Here the same model drives the
+:class:`~ray_tpu.autoscaler.autoscaler.Autoscaler`:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+                 |             |            |
+                 v             v            v
+        ALLOCATION_FAILED  TERMINATING -> TERMINATED
+
+Every transition is validated against the table and appended to the
+instance's status history (timestamped), so scale-up/down decisions are
+auditable after the fact — the v2 property the round-3 flat dicts
+lacked.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# instance lifecycle states (reference v2 common.py InstanceStatus)
+QUEUED = "QUEUED"                        # decided, not yet requested
+REQUESTED = "REQUESTED"                  # provider.launch_node issued
+ALLOCATED = "ALLOCATED"                  # cloud says it exists
+RAY_RUNNING = "RAY_RUNNING"              # node registered with the GCS
+TERMINATING = "TERMINATING"              # terminate issued
+TERMINATED = "TERMINATED"                # gone (terminal)
+ALLOCATION_FAILED = "ALLOCATION_FAILED"  # launch failed (terminal)
+
+_VALID: Dict[str, tuple] = {
+    QUEUED: (REQUESTED, TERMINATED),
+    REQUESTED: (ALLOCATED, ALLOCATION_FAILED, TERMINATING),
+    ALLOCATED: (RAY_RUNNING, TERMINATING),
+    RAY_RUNNING: (TERMINATING,),
+    TERMINATING: (TERMINATED, TERMINATING),
+    TERMINATED: (),
+    ALLOCATION_FAILED: (),
+}
+
+ACTIVE_STATES = (REQUESTED, ALLOCATED, RAY_RUNNING)
+
+
+class InvalidTransition(RuntimeError):
+    pass
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    # provider launch handle (cloud instance id / raylet node id hex)
+    handle: Optional[str] = None
+    status_history: List[tuple] = field(default_factory=list)  # (st, ts)
+    details: str = ""
+
+    def __post_init__(self):
+        if not self.status_history:
+            self.status_history.append((self.status, time.time()))
+
+    @property
+    def created_at(self) -> float:
+        return self.status_history[0][1]
+
+    @property
+    def status_since(self) -> float:
+        return self.status_history[-1][1]
+
+    def view(self) -> dict:
+        return {"instance_id": self.instance_id,
+                "node_type": self.node_type, "status": self.status,
+                "handle": self.handle, "details": self.details,
+                "status_history": [
+                    {"status": s, "ts": ts}
+                    for s, ts in self.status_history]}
+
+
+class InstanceManager:
+    """In-memory instance table with validated transitions (reference
+    instance_storage.py; persistence is unnecessary here — on restart
+    the reconciler re-derives instances from provider + GCS state)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Instance] = {}
+        self._counter = itertools.count(1)
+
+    def create(self, node_type: str) -> Instance:
+        with self._lock:
+            inst = Instance(f"inst-{next(self._counter)}", node_type)
+            self._instances[inst.instance_id] = inst
+            return inst
+
+    def transition(self, instance_id: str, new_status: str,
+                   details: str = "", handle: Optional[str] = None
+                   ) -> Instance:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise KeyError(instance_id)
+            if new_status not in _VALID.get(inst.status, ()):
+                raise InvalidTransition(
+                    f"{inst.instance_id}: {inst.status} -> {new_status}")
+            inst.status = new_status
+            inst.details = details
+            if handle is not None:
+                inst.handle = handle
+            inst.status_history.append((new_status, time.time()))
+            return inst
+
+    def by_status(self, *statuses: str) -> List[Instance]:
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if i.status in statuses]
+
+    def by_handle(self, handle: str) -> Optional[Instance]:
+        with self._lock:
+            for i in self._instances.values():
+                if i.handle == handle:
+                    return i
+            return None
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        with self._lock:
+            return self._instances.get(instance_id)
+
+    def active(self) -> List[Instance]:
+        """Instances that count as (current or incoming) capacity."""
+        return self.by_status(*ACTIVE_STATES)
+
+    def all(self) -> List[Instance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def gc(self, keep_terminal: int = 64) -> None:
+        """Bound the table: keep only the newest terminal instances."""
+        with self._lock:
+            terminal = sorted(
+                (i for i in self._instances.values()
+                 if i.status in (TERMINATED, ALLOCATION_FAILED)),
+                key=lambda i: i.status_since)
+            excess = len(terminal) - keep_terminal
+            for i in terminal[:max(0, excess)]:
+                self._instances.pop(i.instance_id, None)

@@ -1,0 +1,223 @@
+"""Autoscaler v2 depth: instance-manager FSM (reference
+autoscaler/v2/instance_manager/), AWS and KubeRay providers (stub
+clients — boto3/k8s aren't in this image)."""
+
+import sys
+import types
+
+import pytest
+
+from ray_tpu.autoscaler.instance_manager import (
+    ALLOCATED,
+    ALLOCATION_FAILED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+    TERMINATING,
+    InstanceManager,
+    InvalidTransition,
+)
+
+
+class TestInstanceManager:
+    def test_happy_path_with_history(self):
+        im = InstanceManager()
+        inst = im.create("tpu-v5e-8")
+        assert inst.status == "QUEUED"
+        im.transition(inst.instance_id, REQUESTED, "launch issued")
+        im.transition(inst.instance_id, ALLOCATED, handle="i-123")
+        im.transition(inst.instance_id, RAY_RUNNING, "registered")
+        im.transition(inst.instance_id, TERMINATING, "idle")
+        im.transition(inst.instance_id, TERMINATED, "idle")
+        hist = [s for s, _ in im.get(inst.instance_id).status_history]
+        assert hist == ["QUEUED", REQUESTED, ALLOCATED, RAY_RUNNING,
+                        TERMINATING, TERMINATED]
+        assert im.get(inst.instance_id).handle == "i-123"
+
+    def test_invalid_transitions_rejected(self):
+        im = InstanceManager()
+        inst = im.create("t")
+        with pytest.raises(InvalidTransition):
+            im.transition(inst.instance_id, RAY_RUNNING)  # QUEUED -> RUN
+        im.transition(inst.instance_id, REQUESTED)
+        im.transition(inst.instance_id, ALLOCATION_FAILED, "no capacity")
+        with pytest.raises(InvalidTransition):  # terminal
+            im.transition(inst.instance_id, REQUESTED)
+
+    def test_queries_and_active(self):
+        im = InstanceManager()
+        a = im.create("t")
+        b = im.create("t")
+        im.transition(a.instance_id, REQUESTED)
+        im.transition(a.instance_id, ALLOCATED, handle="h-a")
+        im.transition(b.instance_id, REQUESTED)
+        assert {i.instance_id for i in im.active()} == \
+            {a.instance_id, b.instance_id}
+        assert im.by_handle("h-a").instance_id == a.instance_id
+        assert [i.instance_id for i in im.by_status(ALLOCATED)] == \
+            [a.instance_id]
+
+    def test_gc_keeps_newest_terminal(self):
+        im = InstanceManager()
+        for _ in range(5):
+            i = im.create("t")
+            im.transition(i.instance_id, REQUESTED)
+            im.transition(i.instance_id, ALLOCATION_FAILED)
+        live = im.create("t")
+        im.gc(keep_terminal=2)
+        assert len(im.all()) == 3  # 2 terminal + 1 live
+        assert im.get(live.instance_id) is not None
+
+
+class TestAutoscalerUsesFsm:
+    def test_status_exposes_instance_views(self, tmp_path):
+        """The reconcile-loop integration is covered end to end in
+        test_autoscaler.py; here: the instance table is visible with
+        audit history in status()."""
+        import ray_tpu
+        from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeType
+        from ray_tpu.autoscaler.provider import LocalRayletProvider
+        from ray_tpu.cluster_utils import Cluster
+
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+        ray_tpu.init(address=c.address)
+        a = Autoscaler(c.gcs.address,
+                       [NodeType("cpu2", {"CPU": 2}, max_workers=2)],
+                       LocalRayletProvider(c.gcs.address),
+                       interval_s=0.2, idle_timeout_s=60.0)
+        a.start()
+        try:
+            pg = ray_tpu.placement_group([{"CPU": 2}], strategy="PACK")
+            assert pg.wait(timeout_seconds=60)
+            st = a.status()
+            assert len(st["launched"]) == 1
+            (inst,) = st["instances"]
+            assert inst["status"] in (ALLOCATED, RAY_RUNNING)
+            states = [h["status"] for h in inst["status_history"]]
+            assert states[:3] == ["QUEUED", REQUESTED, ALLOCATED]
+        finally:
+            a.stop(terminate_nodes=True)
+            ray_tpu.shutdown()
+            c.shutdown()
+
+
+class TestAwsProvider:
+    def _stub_boto3(self, monkeypatch, launched, terminated):
+        class _Waiter:
+            def wait(self, **kw):
+                pass
+
+        class _Ec2:
+            def run_instances(self, **kw):
+                launched.append(kw)
+                return {"Instances": [{
+                    "InstanceId": f"i-{len(launched):04d}"}]}
+
+            def terminate_instances(self, InstanceIds):
+                terminated.extend(InstanceIds)
+
+            def get_waiter(self, name):
+                return _Waiter()
+
+            def describe_instances(self, Filters):
+                ids = [kw and f"i-{i+1:04d}"
+                       for i, kw in enumerate(launched)]
+                ids = [i for i in ids if i not in terminated]
+                return {"Reservations": [
+                    {"Instances": [{"InstanceId": i} for i in ids]}]}
+
+        fake = types.ModuleType("boto3")
+        fake.client = lambda svc, region_name=None: _Ec2()
+        monkeypatch.setitem(sys.modules, "boto3", fake)
+
+    def test_launch_terminate_roundtrip(self, monkeypatch):
+        from ray_tpu.autoscaler.aws import AwsProvider
+
+        launched, terminated = [], []
+        self._stub_boto3(monkeypatch, launched, terminated)
+        p = AwsProvider(region="us-x", ami="ami-1", subnet_id="sn-1",
+                        instance_types={"tpuish": "c7g.4xlarge"},
+                        user_data_template="join {node_type}")
+        h = p.launch_node("tpuish", {"CPU": 16}, {})
+        p.confirm_launch(h)
+        assert h == "i-0001"
+        req = launched[0]
+        assert req["InstanceType"] == "c7g.4xlarge"
+        assert req["ImageId"] == "ami-1"
+        assert req["UserData"] == "join tpuish"
+        tags = {t["Key"]: t["Value"]
+                for t in req["TagSpecifications"][0]["Tags"]}
+        assert tags["ray-tpu:node-type"] == "tpuish"
+        assert p.live_nodes() == ["i-0001"]
+        p.terminate_node(h)
+        assert terminated == ["i-0001"]
+        assert p.live_nodes() == []
+
+    def test_missing_boto3_named(self):
+        try:
+            import boto3  # noqa: F401
+            pytest.skip("boto3 present")
+        except ImportError:
+            pass
+        from ray_tpu.autoscaler.aws import AwsProvider
+
+        with pytest.raises(ImportError, match="boto3"):
+            AwsProvider(region="r", ami="a", subnet_id="s")
+
+
+class TestKubeRayProvider:
+    def _provider(self):
+        from ray_tpu.autoscaler.kuberay import KubeRayProvider
+
+        cr = {"spec": {"workerGroupSpecs": [
+            {"groupName": "tpu-group", "replicas": 1},
+            {"groupName": "cpu-group", "replicas": 0},
+        ]}}
+        patches = []
+
+        def requester(method, path, body=None,
+                      content_type="application/json"):
+            if method == "GET":
+                return cr
+            assert method == "PATCH"
+            assert content_type == "application/json-patch+json"
+            patches.append(body)
+            for op in body:
+                parts = op["path"].split("/")
+                idx = int(parts[3])
+                if parts[4] == "replicas":
+                    cr["spec"]["workerGroupSpecs"][idx]["replicas"] = \
+                        op["value"]
+                else:
+                    cr["spec"]["workerGroupSpecs"][idx]["scaleStrategy"] = \
+                        op["value"]
+            return {}
+
+        return KubeRayProvider(cluster_name="rc", namespace="ns",
+                               requester=requester), cr, patches
+
+    def test_scale_up_patches_replicas(self):
+        p, cr, patches = self._provider()
+        h = p.launch_node("tpu-group", {"TPU": 4}, {})
+        assert cr["spec"]["workerGroupSpecs"][0]["replicas"] == 2
+        assert h == "rc-tpu-group-1"
+        p.confirm_launch(h)  # no-op: operator converges asynchronously
+
+    def test_scale_down_names_worker_to_delete(self):
+        p, cr, patches = self._provider()
+        h = p.launch_node("tpu-group", {"TPU": 4}, {})
+        p.terminate_node(h)
+        assert cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+        strat = cr["spec"]["workerGroupSpecs"][0]["scaleStrategy"]
+        assert strat == {"workersToDelete": [h]}
+
+    def test_unknown_group_rejected(self):
+        p, _, _ = self._provider()
+        with pytest.raises(ValueError, match="no worker group"):
+            p.launch_node("nope", {}, {})
+
+    def test_live_nodes_from_replicas(self):
+        p, cr, _ = self._provider()
+        assert p.live_nodes() == ["rc-tpu-group-1"]
+        p.launch_node("cpu-group", {}, {})
+        assert "rc-cpu-group-1" in p.live_nodes()
