@@ -152,6 +152,28 @@ def _tpu_responsive(timeout_s: float = 240.0, retries: int = 3):
     return False, reason
 
 
+def _last_recorded_tpu_result():
+    """The most recent REAL-TPU bench datum committed in-tree
+    (BENCH_r*_builder.json, written by the builder when the device
+    tunnel was healthy) — surfaced in fallback artifacts so a wedged
+    tunnel at bench time doesn't hide the round's actual number."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here,
+                                              "BENCH_r*_builder.json"))):
+        try:
+            with open(path) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+            if "TPU" in str(rec.get("device", "")):
+                best = {"source": os.path.basename(path), **rec}
+        except Exception:  # noqa: BLE001
+            continue
+    return best
+
+
 def main():
     import os
 
@@ -181,9 +203,13 @@ def main():
             result = run(name, batch, seq)
             if not tpu_ok:
                 # Loud fallback: the number below is a CPU smoke value, not
-                # the headline metric. Say so in the artifact and fail.
+                # the headline metric. Say so in the artifact and fail —
+                # but carry the round's real-TPU datum (recorded when the
+                # tunnel was healthy) so the artifact still points at it.
                 result["tpu_unavailable"] = tpu_fail_reason
                 result["vs_baseline"] = 0.0
+                result["last_recorded_tpu_result"] = \
+                    _last_recorded_tpu_result()
                 print(json.dumps(result))
                 return 1
             print(json.dumps(result))
