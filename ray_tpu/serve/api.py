@@ -34,35 +34,63 @@ def _get_or_create_controller():
         return _controller
 
 
-def run(app: Application, *, name: Optional[str] = None,
-        blocking: bool = False, wait_timeout_s: float = 60.0
-        ) -> DeploymentHandle:
-    """Deploy an application; returns its handle
-    (reference ``serve.run``)."""
-    import time
-
+def _deploy_tree(app: Application, controller, deployed: dict,
+                 name: Optional[str] = None) -> DeploymentHandle:
+    """Model composition (reference ``serve.run(Driver.bind(A.bind(),
+    B.bind()))``): nested Applications in init args/kwargs deploy first
+    (depth-first) and are replaced by their DeploymentHandles — handles
+    pickle across the process boundary, so the driver replica receives
+    live handles to its sub-models."""
     import cloudpickle
 
     import ray_tpu
 
-    controller = _get_or_create_controller()
+    def resolve(v):
+        if isinstance(v, Application):
+            return _deploy_tree(v, controller, deployed)
+        return v
+
     dep = app.deployment
     app_name = name or dep.name
+    if app_name in deployed:
+        return deployed[app_name]
+    init_args = tuple(resolve(a) for a in app.init_args)
+    init_kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
     ray_tpu.get([controller.deploy.remote(
         app_name, cloudpickle.dumps(dep),
         cloudpickle.dumps(dep.func_or_class),
-        app.init_args, app.init_kwargs)])
+        init_args, init_kwargs)])
     handle = DeploymentHandle(app_name, controller)
-    # wait for at least one replica
+    deployed[app_name] = handle
+    return handle
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        blocking: bool = False, wait_timeout_s: float = 60.0
+        ) -> DeploymentHandle:
+    """Deploy an application — including any nested Applications bound
+    as init args (model composition) — and return the top handle
+    (reference ``serve.run``)."""
+    import time
+
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    deployed: dict = {}
+    handle = _deploy_tree(app, controller, deployed, name=name)
+    # wait for at least one replica of EVERY deployed app (children
+    # included: the driver's first call must not race their boot)
     deadline = time.monotonic() + wait_timeout_s
-    while True:
-        _, replicas, *_ = ray_tpu.get(
-            [controller.get_replicas.remote(app_name)])[0]
-        if replicas:
-            break
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"no replica of {app_name!r} became ready")
-        time.sleep(0.1)
+    for app_name in deployed:
+        while True:
+            _, replicas, *_ = ray_tpu.get(
+                [controller.get_replicas.remote(app_name)])[0]
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {app_name!r} became ready")
+            time.sleep(0.1)
     if blocking:  # pragma: no cover — interactive use
         while True:
             time.sleep(1)

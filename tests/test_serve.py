@@ -93,6 +93,46 @@ class TestServeCore:
         serve.delete("fragile")
 
 
+class TestComposition:
+    def test_nested_bind_deploys_graph(self, rt):
+        """Reference model composition: serve.run(Driver.bind(A.bind(),
+        B.bind())) deploys all three; the driver receives LIVE handles
+        to its sub-models as init args."""
+        @serve.deployment(name="adder")
+        class Adder:
+            def __init__(self, k):
+                self.k = k
+
+            def __call__(self, x):
+                return x + self.k
+
+        @serve.deployment(name="scaler")
+        class Scaler:
+            def __call__(self, x):
+                return x * 10
+
+        @serve.deployment(name="ensemble")
+        class Ensemble:
+            def __init__(self, adder, scaler):
+                self.adder = adder
+                self.scaler = scaler
+
+            def __call__(self, x):
+                import ray_tpu as _rt
+
+                a = _rt.get(self.adder.remote(x), timeout=30)
+                b = _rt.get(self.scaler.remote(x), timeout=30)
+                return a + b
+
+        handle = serve.run(Ensemble.bind(Adder.bind(5), Scaler.bind()))
+        assert rt.get(handle.remote(3), timeout=60) == (3 + 5) + 30
+        st = serve.status()
+        for name in ("adder", "scaler", "ensemble"):
+            assert st[name]["running_replicas"] >= 1, st
+        for name in ("ensemble", "adder", "scaler"):
+            serve.delete(name)
+
+
 class TestBatching:
     def test_batch_coalesces(self, rt):
         @serve.deployment(max_ongoing_requests=16)
