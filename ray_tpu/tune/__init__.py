@@ -15,6 +15,7 @@ from ray_tpu.tune.search import (  # noqa: F401
 from ray_tpu.tune.search_algo import (  # noqa: F401
     HaltonSearch,
     OptunaSearch,
+    TPESearch,
     Searcher,
 )
 from ray_tpu.tune.tuner import (  # noqa: F401

@@ -268,3 +268,33 @@ class TestSearchAlgorithms:
         assert len(grid) == 8
         best = grid.get_best_result()
         assert abs(best.config["x"] - 0.7) < 0.25  # quasi-random coverage
+
+    def test_tpe_search_concentrates_near_optimum(self, rt):
+        """Native TPE (BOHB's model, no optuna): after the random warmup
+        it must concentrate suggestions near the best region — the best
+        of 28 sequential trials lands much tighter than quasi-random
+        coverage, and the categorical dimension locks onto the good arm.
+        Fully seeded, max_concurrent=1 (the model needs completions)."""
+        from ray_tpu.tune import TPESearch
+
+        def trainable(config):
+            penalty = 0.0 if config["arm"] == "good" else 0.3
+            tune.report(
+                {"score": -((config["x"] - 0.7) ** 2) - penalty})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.uniform(0.0, 1.0),
+                         "arm": tune.choice(["good", "bad", "ugly"])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=28,
+                max_concurrent_trials=1,
+                search_alg=TPESearch(seed=3, n_initial=8)),
+        )
+        grid = tuner.fit(timeout_s=300)
+        best = grid.get_best_result()
+        assert best.config["arm"] == "good"
+        assert abs(best.config["x"] - 0.7) < 0.1, best.config
+        # the model phase should mostly pick the good arm
+        arms = [r.config["arm"] for r in grid]
+        assert arms[8:].count("good") >= len(arms[8:]) * 0.5, arms
