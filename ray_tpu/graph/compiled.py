@@ -63,7 +63,10 @@ class _PipelineStage:
         so per-item cost approaches max(compute, read, write) instead of
         their sum.
 
-        ``in_specs``: ordered arg slots — ("ch", channel) | ("const", v).
+        ``in_specs``: ordered arg slots — ("ch", channel) | ("const", v)
+        | ("ch-field", channel, key): read the channel's value once per
+        iteration, then pick a field (multi-arg DAG input — the channel
+        carries a ``_DagInput``; int keys index args, str keys kwargs).
         ``collective_spec``: None | (group_name, rank, world, op).
         """
         import queue as _q
@@ -85,9 +88,32 @@ class _PipelineStage:
         # distinct channels: a channel feeding two arg slots is read ONCE
         # per iteration (one version = one logical value)
         distinct = []
-        for kind, v in in_specs:
-            if kind == "ch" and all(v is not c for c in distinct):
-                distinct.append(v)
+        for spec_item in in_specs:
+            if spec_item[0] in ("ch", "ch-field"):
+                v = spec_item[1]
+                if all(v is not c for c in distinct):
+                    distinct.append(v)
+
+        def materialize(by_ch):
+            from ray_tpu.graph.dag import _DagInput
+
+            out = []
+            for spec_item in in_specs:
+                kind, v = spec_item[0], spec_item[1]
+                if kind == "const":
+                    out.append(v)
+                    continue
+                val = by_ch[id(v)]
+                if kind == "ch-field" and not isinstance(val, _StageError):
+                    key = spec_item[2]
+                    if isinstance(val, _DagInput):
+                        val = val.pick(key)
+                    elif isinstance(key, int):
+                        val = val[key]
+                    else:
+                        val = getattr(val, key)
+                out.append(val)
+            return out
 
         _END = object()
 
@@ -168,8 +194,7 @@ class _PipelineStage:
             by_ch = next_inputs()
             if by_ch is _END:
                 break
-            args = [by_ch[id(v)] if kind == "ch" else v
-                    for kind, v in in_specs]
+            args = materialize(by_ch)
             err = next((a for a in args if isinstance(a, _StageError)), None)
             if err is not None:
                 # propagate an upstream failure to the driver
@@ -293,6 +318,7 @@ class CompiledDAG:
 
         input_node: Optional[InputNode] = None
         stage_nodes: List[ClassMethodNode] = []
+        attr_nodes: List[InputAttributeNode] = []
         for node in self._schedule:
             if isinstance(node, InputNode):
                 if input_node is not None:
@@ -308,8 +334,10 @@ class CompiledDAG:
                         "channel stages take positional args only")
                 stage_nodes.append(node)
             elif isinstance(node, InputAttributeNode):
-                raise ValueError(
-                    "channel DAGs take exactly one positional input")
+                # multi-arg DAG: the input channel carries the whole
+                # _DagInput; stages bound to inp[i]/inp.x pick the field
+                # at read time ("ch-field" arg slots)
+                attr_nodes.append(node)
             elif not isinstance(node, (ClassNode, MultiOutputNode,
                                        CollectiveOutputNode)):
                 raise TypeError(
@@ -317,6 +345,7 @@ class CompiledDAG:
         if input_node is None or not stage_nodes:
             raise ValueError(
                 "channels=True requires an InputNode feeding actor stages")
+        self._multi_arg_input = bool(attr_nodes)
 
         # collective groups: every branch input must be a distinct stage
         coll_specs: Dict[int, tuple] = {}  # id(stage node) -> spec
@@ -342,6 +371,8 @@ class CompiledDAG:
             """The node whose output channel carries ``node``'s value."""
             if isinstance(node, CollectiveOutputNode):
                 return node._op.inputs[node._index]
+            if isinstance(node, InputAttributeNode):
+                return input_node  # field of the shared input channel
             return node
 
         # outputs (driver-read channels), in declared order
@@ -349,6 +380,11 @@ class CompiledDAG:
         out_nodes = (list(root._bound_args)
                      if isinstance(root, MultiOutputNode) else [root])
         self._multi_output = isinstance(root, MultiOutputNode)
+        if any(isinstance(n, (InputNode, InputAttributeNode))
+               for n in out_nodes):
+            raise ValueError(
+                "a channel DAG output must be a stage output, not the "
+                "input (or one of its fields)")
         out_producers = [producer_of(n) for n in out_nodes]
 
         # consumer census per producer: distinct stages + the driver
@@ -410,7 +446,11 @@ class CompiledDAG:
             self._owned_actors.append(handle)
             in_specs = []
             for arg in stage._data_args():
-                if isinstance(arg, DAGNode):
+                if isinstance(arg, InputAttributeNode):
+                    in_specs.append(
+                        ("ch-field", chan_by_producer[id(input_node)],
+                         arg._key))
+                elif isinstance(arg, DAGNode):
                     in_specs.append(
                         ("ch", chan_by_producer[id(producer_of(arg))]))
                 else:
@@ -482,9 +522,16 @@ class CompiledDAG:
         Backpressure: caps driver-side inflight refs (RPC mode) / the
         depth-1 stage channels themselves (channel mode)."""
         if self._channels is not None:
-            if kwargs or len(args) != 1:
+            if getattr(self, "_multi_arg_input", False):
+                from ray_tpu.graph.dag import _DagInput
+
+                payload = _DagInput(args, kwargs)
+            elif kwargs or len(args) != 1:
                 raise TypeError(
-                    "channel pipelines take exactly one positional input")
+                    "channel pipelines take exactly one positional input "
+                    "(bind inp[i]/inp.key for multi-arg DAGs)")
+            else:
+                payload = args[0]
             # Depth-1 stage channels bound the in-flight items to ~#stages.
             # When full, drain completed outputs into the result buffer so
             # a burst of execute() calls never deadlocks against its own
@@ -501,7 +548,7 @@ class CompiledDAG:
                 except TimeoutError:
                     pass
                 try:
-                    self._in_channel.write(args[0], timeout_s=0.02)
+                    self._in_channel.write(payload, timeout_s=0.02)
                     break
                 except TimeoutError:
                     if time.monotonic() > deadline:

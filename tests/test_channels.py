@@ -183,6 +183,99 @@ def test_device_buffer_channel_two_actor_tp_graph(rt):
     ch.unlink()
 
 
+def test_multi_arg_channel_dag(rt):
+    """Multi-arg compiled DAGs (reference ``inp[0]``/``inp.key``): the
+    input channel carries the whole (args, kwargs) bundle once; stages
+    bound to fields pick at read time — one broadcast, no per-field
+    channels."""
+    from ray_tpu.graph import MultiOutputNode
+
+    def make_ops():
+        class Add:
+            def __init__(self, _):
+                pass
+
+            def run(self, a, b):
+                return a + b
+
+        class Scale:
+            def __init__(self, _):
+                pass
+
+            def run(self, x, k):
+                return x * k
+
+        return Add, Scale
+
+    Add, Scale = make_ops()
+    with InputNode() as inp:
+        s1 = rt.remote(Add).bind(0).run.bind(inp[0], inp[1])
+        s2 = rt.remote(Scale).bind(0).run.bind(inp[0], inp.k)
+        dag = MultiOutputNode([s1, s2])
+    compiled = dag.experimental_compile(channels=True)
+    try:
+        futs = [compiled.execute(i, 10 * i, k=3) for i in range(3)]
+        for i, f in enumerate(futs):
+            add, scale = f.get(timeout_s=60)
+            assert add == i + 10 * i
+            assert scale == 3 * i
+    finally:
+        compiled.teardown()
+
+
+def test_multi_arg_channel_dag_with_fan_in(rt):
+    """A downstream stage fans in a field-fed stage output AND a raw
+    input field."""
+    def make_ops():
+        class Double:
+            def __init__(self, _):
+                pass
+
+            def run(self, x):
+                return 2 * x
+
+        class Combine:
+            def __init__(self, _):
+                pass
+
+            def run(self, doubled, offset):
+                return doubled + offset
+
+        return Double, Combine
+
+    Double, Combine = make_ops()
+    with InputNode() as inp:
+        d = rt.remote(Double).bind(0).run.bind(inp[0])
+        dag = rt.remote(Combine).bind(0).run.bind(d, inp[1])
+    compiled = dag.experimental_compile(channels=True)
+    try:
+        assert compiled.execute(5, 100).get(timeout_s=60) == 110
+        assert compiled.execute(7, 1).get(timeout_s=60) == 15
+    finally:
+        compiled.teardown()
+
+
+def test_input_as_output_rejected(rt):
+    from ray_tpu.graph import MultiOutputNode
+
+    def make_id():
+        class Id:
+            def __init__(self, _):
+                pass
+
+            def run(self, x):
+                return x
+
+        return Id
+
+    Id = make_id()
+    with InputNode() as inp:
+        s = rt.remote(make_id()).bind(0).run.bind(inp[0])
+        dag = MultiOutputNode([s, inp[1]])
+    with pytest.raises(ValueError, match="stage output"):
+        dag.experimental_compile(channels=True)
+
+
 def test_device_channel_compiled_pipeline(rt):
     """channel_kind="device": a compiled pipeline whose edges are
     DeviceBufferChannels — activations travel as arrays (host-staged,
