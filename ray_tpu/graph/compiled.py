@@ -30,6 +30,7 @@ from ray_tpu.graph.dag import (
     InputAttributeNode,
     InputNode,
     MultiOutputNode,
+    _DagInput,
 )
 
 
@@ -95,8 +96,6 @@ class _PipelineStage:
                     distinct.append(v)
 
         def materialize(by_ch):
-            from ray_tpu.graph.dag import _DagInput
-
             out = []
             for spec_item in in_specs:
                 kind, v = spec_item[0], spec_item[1]
@@ -106,12 +105,20 @@ class _PipelineStage:
                 val = by_ch[id(v)]
                 if kind == "ch-field" and not isinstance(val, _StageError):
                     key = spec_item[2]
-                    if isinstance(val, _DagInput):
-                        val = val.pick(key)
-                    elif isinstance(key, int):
-                        val = val[key]
-                    else:
-                        val = getattr(val, key)
+                    try:
+                        if isinstance(val, _DagInput):
+                            val = val.pick(key)
+                        elif isinstance(key, int):
+                            val = val[key]
+                        else:
+                            val = getattr(val, key)
+                    except Exception as e:  # noqa: BLE001 — bad arity /
+                        # missing kwarg: propagate as the item's error
+                        # instead of killing the loop (which would strand
+                        # the writer and wedge the driver's get())
+                        import traceback as _tb
+
+                        val = _StageError(repr(e), _tb.format_exc())
                 out.append(val)
             return out
 
@@ -346,6 +353,16 @@ class CompiledDAG:
             raise ValueError(
                 "channels=True requires an InputNode feeding actor stages")
         self._multi_arg_input = bool(attr_nodes)
+        if self._multi_arg_input and any(
+                arg is input_node
+                for stage in stage_nodes for arg in stage._data_args()):
+            # the input channel carries the _DagInput wrapper in multi-arg
+            # mode; a stage bound to the BARE InputNode would receive the
+            # wrapper (diverging from eager execution) — reject loudly
+            raise ValueError(
+                "cannot mix bare InputNode args with inp[i]/inp.key "
+                "fields in a channel DAG: bind a field for every "
+                "input-consuming stage")
 
         # collective groups: every branch input must be a distinct stage
         coll_specs: Dict[int, tuple] = {}  # id(stage node) -> spec
@@ -523,8 +540,6 @@ class CompiledDAG:
         depth-1 stage channels themselves (channel mode)."""
         if self._channels is not None:
             if getattr(self, "_multi_arg_input", False):
-                from ray_tpu.graph.dag import _DagInput
-
                 payload = _DagInput(args, kwargs)
             elif kwargs or len(args) != 1:
                 raise TypeError(
