@@ -368,11 +368,43 @@ class ResultGrid:
              "error": bool(r.error)} for r in self._results])
 
 
+def _trainer_trainable(trainer) -> Callable[[dict], Any]:
+    """Reference ``Tuner(trainer)``: a Trainer instance (JaxTrainer /
+    TorchTrainer — anything with ``.fit()`` and a ``config`` dict) as the
+    trainable. Each trial shallow-copies the trainer, merges the trial's
+    sampled config into ``train_loop_config`` (a nested
+    ``train_loop_config`` dict in the sample merges as that subdict;
+    flat keys merge directly), runs ``fit()``, and reports the run's
+    final metrics once — trial-level early-stopping schedulers see one
+    report per trial."""
+    import copy
+
+    def run(config):
+        from ray_tpu import tune as _tune
+
+        sampled = dict(config)
+        nested = sampled.pop("train_loop_config", None)
+        merged = dict(getattr(trainer, "config", None) or {})
+        if isinstance(nested, dict):
+            merged.update(nested)
+        merged.update(sampled)
+        t = copy.copy(trainer)
+        t.config = merged
+        result = t.fit()
+        metrics = dict(getattr(result, "metrics", None) or {})
+        if metrics:
+            _tune.report(metrics)
+
+    return run
+
+
 class Tuner:
     def __init__(self, trainable: Callable[[dict], Any], *,
                  param_space: Dict[str, Any],
                  tune_config: Optional[TuneConfig] = None,
                  storage_path: Optional[str] = None):
+        if not callable(trainable) and hasattr(trainable, "fit"):
+            trainable = _trainer_trainable(trainable)
         self._trainable = trainable
         self._space = param_space
         self._cfg = tune_config or TuneConfig()

@@ -298,3 +298,34 @@ class TestSearchAlgorithms:
         # the model phase should mostly pick the good arm
         arms = [r.config["arm"] for r in grid]
         assert arms[8:].count("good") >= len(arms[8:]) * 0.5, arms
+
+
+class TestTunerOverTrainer:
+    def test_tuner_accepts_jax_trainer(self, rt, tmp_path):
+        """Reference Tuner(trainer): each trial merges its sampled config
+        into train_loop_config and runs the trainer's gang fit()."""
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+        def train_fn(config):
+            from ray_tpu import train
+
+            # pseudo-objective: best at lr=0.1; base_offset proves the
+            # trainer's own train_loop_config survives the merge
+            score = -abs(config["lr"] - 0.1) + config["base_offset"]
+            train.report({"score": score})
+
+        trainer = JaxTrainer(
+            train_fn,
+            train_loop_config={"base_offset": 1.0},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="tuned", storage_path=str(tmp_path)))
+        grid = tune.Tuner(
+            trainer,
+            param_space={"lr": tune.grid_search([0.01, 0.1, 0.5])},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        max_concurrent_trials=1),
+        ).fit(timeout_s=300)
+        assert len(grid) == 3
+        best = grid.get_best_result()
+        assert best.config["lr"] == 0.1
+        assert abs(best.metrics["score"] - 1.0) < 1e-9
