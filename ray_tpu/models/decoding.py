@@ -208,6 +208,98 @@ def make_prefill(params: Params, config: LlamaConfig):
     return call
 
 
+def make_chunked_prefill(params: Params, config: LlamaConfig):
+    """Build the jitted chunked prefill (vLLM-class chunked prefill /
+    Sarathi-style): process one fixed-size chunk of a long prompt per
+    call, attending causally within the chunk AND over the slot's
+    already-written prefix rows — so the engine can interleave decode
+    steps of other slots between chunks instead of stalling them for a
+    whole long-prompt prefill.
+
+    chunk(cache, tokens (1, C) padded, true_len-in-chunk, start_pos,
+          slot) → (cache, last_logits (vocab,) f32)
+
+    One compile per chunk size C. ``cache["length"]`` for the slot
+    becomes ``start_pos + true_len`` after the call (callers pass the
+    running offset); the returned logits are for the chunk's last valid
+    token (only meaningful on the final chunk).
+    """
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def chunk(cache: Cache, tokens: jax.Array, true_len: jax.Array,
+              start_pos: jax.Array, slot: jax.Array, pad_len: int):
+        S = cache["k"].shape[2]
+        x = params["embed"].astype(c.dtype)[tokens]          # (1, C, E)
+        rel = jnp.arange(pad_len)                            # (C,)
+        positions = (start_pos + rel)[None, :]               # (1, C)
+        mask_valid = rel < true_len                          # (C,)
+
+        def body(x, scanned):
+            layer, kc_all, vc_all = scanned                  # (slots, S, …)
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            # write the chunk's k/v at rows [start_pos, start_pos + C)
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, jnp.where(mask_valid[None, :, None, None], k,
+                                  0.0).astype(kc_all.dtype),
+                (slot, start_pos, 0, 0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, jnp.where(mask_valid[None, :, None, None], v,
+                                  0.0).astype(vc_all.dtype),
+                (slot, start_pos, 0, 0))
+            # attend over the slot's FULL row set (prefix + this chunk):
+            # key j visible to query i iff j <= start_pos + i
+            ks = kc_all[slot]                                # (S, KV, D)
+            vs = vc_all[slot]
+            KV = ks.shape[1]
+            H = q.shape[2]
+            group = H // KV
+            qg = (q[0].astype(jnp.float32)
+                  .reshape(pad_len, KV, group, -1))          # (C,KV,g,D)
+            s = jnp.einsum("ckgd,skd->kgcs", qg,
+                           ks.astype(jnp.float32)) * (c.head_dim ** -0.5)
+            allowed = (jnp.arange(S)[None, :]
+                       <= (start_pos + rel)[:, None])        # (C, S)
+            s = jnp.where(allowed[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("kgcs,skd->ckgd", p,
+                             vs.astype(jnp.float32))
+            out = out.reshape(1, pad_len, H, -1).astype(x.dtype)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            return x, (kc_all, vc_all)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        last = x[0, jnp.maximum(true_len - 1, 0)]
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = (last.astype(jnp.float32) @ head.astype(jnp.float32))
+        new_len = cache["length"].at[slot].set(start_pos + true_len)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    def call(cache, tokens, true_len, start_pos, slot):
+        pad_len = tokens.shape[1]
+        return chunk(cache, tokens, jnp.asarray(true_len, jnp.int32),
+                     jnp.asarray(start_pos, jnp.int32),
+                     jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
 def make_inject(config: LlamaConfig):
     """Build the jitted KV-injection step: write an externally computed
     prompt KV (from a prefill replica or a prefix cache) into one slot.

@@ -54,14 +54,16 @@ class LLMEngine:
                  seed: int = 0, prefix_cache_size: int = 0,
                  kv_cache: str = "paged",
                  kv_pool_tokens: Optional[int] = None,
-                 kv_block_size: int = 64):
+                 kv_block_size: int = 64,
+                 prefill_chunk: Optional[int] = None):
         import collections
 
         import jax
 
         from ray_tpu.models import llama
         from ray_tpu.models.decoding import (
-            init_cache, make_decode_step, make_inject, make_prefill)
+            init_cache, make_chunked_prefill, make_decode_step,
+            make_inject, make_prefill)
 
         self.config = config or llama.CONFIGS[model]
         if params is None:
@@ -102,6 +104,22 @@ class LLMEngine:
             self._decode = make_decode_step(params, self.config)
             self._prefill = make_prefill(params, self.config)
             self._inject = make_inject(self.config)
+        # Chunked prefill (vLLM-class / Sarathi): prompts longer than the
+        # chunk prefill one fixed-size chunk per engine iteration,
+        # interleaved with decode steps of the other slots — a long
+        # prompt no longer stalls everyone's TTFT for its whole prefill.
+        if prefill_chunk is not None:
+            if kv_cache != "slot":
+                raise ValueError(
+                    "prefill_chunk currently requires kv_cache='slot' "
+                    "(paged prompts already prefill per padded bucket)")
+            if prefill_chunk <= 0:
+                raise ValueError("prefill_chunk must be positive")
+            self._chunk_prefill = make_chunked_prefill(params, self.config)
+        self.prefill_chunk = prefill_chunk
+        # slot -> {"req", "tokens", "pos"} for in-progress chunked prefills
+        self._prefilling: Dict[int, dict] = {}
+        self._chunks_run = 0
         self._key = jax.random.key(seed)
         # Exact-prompt KV cache (host LRU), OFF by default: storing pays
         # a device->host copy of the prompt KV per admission, worth it
@@ -227,6 +245,8 @@ class LLMEngine:
                "queued": self._queue.qsize() + len(self._waiting),
                "prefix_hits": self._prefix_hits,
                "prefix_misses": self._prefix_misses,
+               "prefill_chunks_run": self._chunks_run,
+               "prefilling_slots": len(self._prefilling),
                "kv_cache": self.kv_cache}
         if self.kv_cache == "paged":
             out.update(
@@ -349,6 +369,19 @@ class LLMEngine:
                 self._prefix_cache.move_to_end(key)
                 self._inject_kv(slot, cached["k"], cached["v"], plen)
                 logits_np = cached["logits"]
+            elif (self.prefill_chunk is not None
+                  and plen > self.prefill_chunk):
+                # chunked prefill: register and let the engine loop
+                # advance one chunk per iteration interleaved with other
+                # slots' decode; the slot starts decoding after the last
+                # chunk (see _advance_chunked_prefill)
+                self._slots[slot] = req
+                self._slot_len[slot] = 0
+                self._admit_counter += 1
+                self._admit_seq[slot] = self._admit_counter
+                self._prefilling[slot] = {"req": req,
+                                          "tokens": full_prompt, "pos": 0}
+                continue
             else:
                 # cap padding at max_seq: a prompt that fits must be admitted
                 P = self._prompt_pad(plen)
@@ -377,6 +410,41 @@ class LLMEngine:
             self._admit_counter += 1
             self._admit_seq[slot] = self._admit_counter
             self._maybe_finish(slot)
+
+    def _advance_chunked_prefill(self):
+        """Run ONE chunk of the oldest in-progress chunked prefill; on
+        the final chunk, sample the first token and activate the slot."""
+        import jax.numpy as jnp
+
+        slot = next(iter(self._prefilling))
+        st = self._prefilling[slot]
+        toks, pos, C = st["tokens"], st["pos"], self.prefill_chunk
+        n = min(C, len(toks) - pos)
+        buf = np.zeros((1, C), np.int32)
+        buf[0, :n] = toks[pos:pos + n]
+        self._cache, logits = self._chunk_prefill(
+            self._cache, jnp.asarray(buf), n, pos, slot)
+        self._chunks_run += 1
+        st["pos"] = pos + n
+        if st["pos"] < len(toks):
+            return
+        req = st["req"]
+        del self._prefilling[slot]
+        plen = len(toks)
+        logits_np = np.asarray(logits)
+        resumed = bool(req.output)
+        if self._prefix_cache_size > 0 and not resumed:
+            self._prefix_misses += 1
+            k, v = self._extract_kv(slot, plen)
+            self._prefix_cache[tuple(toks)] = {"k": k, "v": v,
+                                               "logits": logits_np}
+            while len(self._prefix_cache) > self._prefix_cache_size:
+                self._prefix_cache.popitem(last=False)
+        tok = self._sample(logits_np.reshape(1, -1), req.temperature)[0]
+        req.output.append(int(tok))
+        self._last_token[slot] = tok
+        self._slot_len[slot] = plen
+        self._maybe_finish(slot)
 
     def _sample(self, logits: np.ndarray, temperature: float) -> np.ndarray:
         if temperature <= 0.0:
@@ -452,6 +520,7 @@ class LLMEngine:
                             # blocks would otherwise leak for good: only
                             # _maybe_finish/_preempt release them
                             self._alloc.release(slot)
+                self._prefilling.clear()
 
     _PENDING_TTL_S = 180.0
 
@@ -480,9 +549,16 @@ class LLMEngine:
         if self.kv_cache == "paged":
             self._grow_active_slots()
         self._admit()
-        active = np.array([s is not None for s in self._slots])
+        # one prefill chunk per iteration: bounded interference with the
+        # decode of already-active slots (vLLM-class chunked prefill)
+        if self._prefilling:
+            self._advance_chunked_prefill()
+        active = np.array([
+            self._slots[s] is not None and s not in self._prefilling
+            for s in range(self.num_slots)])
         if not active.any():
-            time.sleep(0.002)
+            if not self._prefilling:
+                time.sleep(0.002)
             return
         if self.kv_cache == "paged":
             self._cache, logits = self._decode(
@@ -496,7 +572,9 @@ class LLMEngine:
         self._steps += 1
         for slot in range(self.num_slots):
             req = self._slots[slot]
-            if req is None:
+            if req is None or slot in self._prefilling:
+                # mid-chunked-prefill slots were masked inactive in the
+                # decode; their logits row is garbage — no sampling
                 continue
             tok = self._sample(logits_np[slot][None], req.temperature)[0]
             req.output.append(int(tok))
