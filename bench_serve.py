@@ -99,6 +99,56 @@ def run_engine_bench(model: str, num_slots: int, n_requests: int,
     }
 
 
+def run_chunked_prefill_bench(model: str, long_len: int = 48,
+                              chunk: int = 8) -> dict:
+    """TTFT interference: p95 TTFT of SHORT requests arriving while LONG
+    prompts keep prefilling — chunked vs monolithic prefill. Chunking
+    bounds the decode-stall a long prompt inflicts on everyone else."""
+    import numpy as np
+
+    from ray_tpu.serve.llm import LLMEngine
+
+    out = {}
+    for label, kwargs in (("monolithic", {}),
+                          ("chunked", {"prefill_chunk": chunk})):
+        engine = LLMEngine(model=model, num_slots=4, kv_cache="slot",
+                           **kwargs)
+        rng = np.random.default_rng(0)
+        vocab = engine.config.vocab_size
+        engine.generate(list(rng.integers(1, vocab, size=long_len)),
+                        max_tokens=2)  # compile both programs
+        engine.generate([1, 2, 3], max_tokens=2)
+        ttfts = []
+        stop = threading.Event()
+
+        def long_feeder():
+            while not stop.is_set():
+                engine.generate(
+                    list(rng.integers(1, vocab, size=long_len)),
+                    max_tokens=2)
+
+        t = threading.Thread(target=long_feeder, daemon=True)
+        t.start()
+        for _ in range(20):
+            t0 = time.perf_counter()
+            rid = engine.submit([7, 8, 9], max_tokens=2)
+            while not engine.poll(rid)["chunks"]:
+                time.sleep(0.001)
+            ttfts.append(time.perf_counter() - t0)
+        stop.set()
+        t.join(timeout=30)
+        engine.shutdown()
+        out[label] = {
+            "short_ttft_p50_ms": round(
+                float(np.percentile(ttfts, 50)) * 1000, 1),
+            "short_ttft_p95_ms": round(
+                float(np.percentile(ttfts, 95)) * 1000, 1),
+        }
+    out["long_len"] = long_len
+    out["prefill_chunk"] = chunk
+    return out
+
+
 def main():
     # reuse bench.py's loud TPU-vs-CPU contract
     from bench import _tpu_responsive
@@ -116,6 +166,8 @@ def main():
         model, slots, n_req, plen, mtok = "1b", 8, 24, 128, 128
 
     result = run_engine_bench(model, slots, n_req, plen, mtok)
+    result["chunked_prefill_interference"] = run_chunked_prefill_bench(
+        model, long_len=max(48, plen), chunk=max(8, plen // 4))
     if not tpu_ok:
         result["tpu_unavailable"] = reason
     print(json.dumps(result))
