@@ -145,6 +145,107 @@ def _attend_paged(q, k_pool, v_pool, tables, lengths, scale):
                                      scale=scale)
 
 
+def make_chunked_paged_prefill(params: Params, config: LlamaConfig,
+                               page: PagedConfig):
+    """Chunked prefill over the paged pool (vLLM/Sarathi chunked
+    prefill, paged flavor): one fixed-size chunk per call; chunk k/v
+    scatter into the blocks the table row names, attention runs over the
+    slot's full prefix+chunk rows gathered via the table.
+
+    chunk(cache, table_row (MBS,), tokens (1, C), true_len-in-chunk,
+          start_pos, slot) → (cache, last_logits)
+
+    C and start_pos must be multiples of block_size (the engine enforces
+    prefill_chunk % block_size == 0); the block budget for the WHOLE
+    prompt is ensured at admission, so chunking here only splits the
+    compute, never the allocation.
+    """
+    c = config
+    bs = page.block_size
+    MBS = page.max_blocks_per_seq
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def chunk(cache: PagedCache, table_row, tokens, true_len, start_pos,
+              slot, pad_len: int):
+        nblk = pad_len // bs
+        x = params["embed"].astype(c.dtype)[tokens]           # (1, C, E)
+        rel = jnp.arange(pad_len)
+        positions = (start_pos + rel)[None, :]
+        mask_valid = rel < true_len                           # (C,)
+        start_blk = start_pos // bs
+        # destination blocks for this chunk; fully-invalid blocks write
+        # into the null block
+        blk_ids = start_blk + jnp.arange(nblk)
+        dest = jnp.where(jnp.arange(nblk) * bs < true_len,
+                         table_row[blk_ids], 0)               # (nblk,)
+
+        def body(x, scanned):
+            layer, kc, vc = scanned            # (NB, bs, KV, D)
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            kb = jnp.where(mask_valid[:, None, None], k[0],
+                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
+            vb = jnp.where(mask_valid[:, None, None], v[0],
+                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
+            kc = kc.at[dest].set(kb.astype(kc.dtype))
+            vc = vc.at[dest].set(vb.astype(vc.dtype))
+            # gather the slot's full row set (prefix + this chunk) and
+            # attend with absolute-position causal visibility
+            ks = kc[table_row].reshape(MBS * bs, c.n_kv_heads, c.head_dim)
+            vs = vc[table_row].reshape(MBS * bs, c.n_kv_heads, c.head_dim)
+            KV = c.n_kv_heads
+            H = q.shape[2]
+            group = H // KV
+            qg = (q[0].astype(jnp.float32)
+                  .reshape(pad_len, KV, group, -1))           # (C,KV,g,D)
+            s = jnp.einsum("ckgd,skd->kgcs", qg,
+                           ks.astype(jnp.float32)) * (c.head_dim ** -0.5)
+            allowed = (jnp.arange(MBS * bs)[None, :]
+                       <= (start_pos + rel)[:, None])         # (C, S)
+            s = jnp.where(allowed[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("kgcs,skd->ckgd", p,
+                             vs.astype(jnp.float32))
+            out = out.reshape(1, pad_len, H, -1).astype(x.dtype)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        last = x[0, jnp.maximum(true_len - 1, 0)]
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        logits = (last.astype(jnp.float32) @ head.astype(jnp.float32))
+        new_len = cache["length"].at[slot].set(start_pos + true_len)
+        return ({"k": new_k, "v": new_v, "length": new_len}, logits)
+
+    def call(cache, table_row, tokens, true_len, start_pos, slot):
+        pad_len = tokens.shape[1]
+        if pad_len % bs:
+            raise ValueError(
+                f"chunk length {pad_len} must be a multiple of "
+                f"block_size {bs}")
+        return chunk(cache, jnp.asarray(table_row, jnp.int32),
+                     tokens, jnp.asarray(true_len, jnp.int32),
+                     jnp.asarray(start_pos, jnp.int32),
+                     jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
 def make_paged_decode_step(params: Params, config: LlamaConfig,
                            page: PagedConfig):
     """step(cache, tables (B,MBS) i32, tokens (B,) i32, active (B,) bool)

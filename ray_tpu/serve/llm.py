@@ -109,13 +109,21 @@ class LLMEngine:
         # interleaved with decode steps of the other slots — a long
         # prompt no longer stalls everyone's TTFT for its whole prefill.
         if prefill_chunk is not None:
-            if kv_cache != "slot":
-                raise ValueError(
-                    "prefill_chunk currently requires kv_cache='slot' "
-                    "(paged prompts already prefill per padded bucket)")
             if prefill_chunk <= 0:
                 raise ValueError("prefill_chunk must be positive")
-            self._chunk_prefill = make_chunked_prefill(params, self.config)
+            if kv_cache == "paged":
+                if prefill_chunk % kv_block_size:
+                    raise ValueError(
+                        f"prefill_chunk={prefill_chunk} must be a "
+                        f"multiple of kv_block_size={kv_block_size}")
+                from ray_tpu.models.paged_cache import \
+                    make_chunked_paged_prefill
+
+                self._chunk_prefill = make_chunked_paged_prefill(
+                    params, self.config, self._page)
+            else:
+                self._chunk_prefill = make_chunked_prefill(
+                    params, self.config)
         self.prefill_chunk = prefill_chunk
         # slot -> {"req", "tokens", "pos"} for in-progress chunked prefills
         self._prefilling: Dict[int, dict] = {}
@@ -422,8 +430,13 @@ class LLMEngine:
         n = min(C, len(toks) - pos)
         buf = np.zeros((1, C), np.int32)
         buf[0, :n] = toks[pos:pos + n]
-        self._cache, logits = self._chunk_prefill(
-            self._cache, jnp.asarray(buf), n, pos, slot)
+        if self.kv_cache == "paged":
+            self._cache, logits = self._chunk_prefill(
+                self._cache, self._alloc.tables[slot], jnp.asarray(buf),
+                n, pos, slot)
+        else:
+            self._cache, logits = self._chunk_prefill(
+                self._cache, jnp.asarray(buf), n, pos, slot)
         self._chunks_run += 1
         st["pos"] = pos + n
         if st["pos"] < len(toks):
@@ -478,6 +491,8 @@ class LLMEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._alloc.release(slot)
+        # a mid-chunked-prefill victim restarts its prefill on re-admission
+        self._prefilling.pop(slot, None)
         self._waiting.appendleft(req)
         self._preemptions += 1
 

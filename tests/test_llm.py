@@ -177,8 +177,33 @@ class TestChunkedPrefill:
             eng.shutdown()
             base.shutdown()
 
-    def test_paged_combination_rejected(self):
+    def test_paged_chunk_must_align_to_blocks(self):
         from ray_tpu.serve.llm import LLMEngine
 
-        with pytest.raises(ValueError, match="slot"):
-            LLMEngine(model="debug", kv_cache="paged", prefill_chunk=16)
+        with pytest.raises(ValueError, match="multiple of"):
+            LLMEngine(model="debug", kv_cache="paged", kv_block_size=16,
+                      prefill_chunk=24)
+
+    def test_paged_outputs_match_unchunked(self):
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import LLMEngine
+
+        cfg = llama.CONFIGS["debug"]
+        params = llama.init_params(cfg, jax.random.key(0))
+        prompts = [list(range(1, 60)), [5, 6, 7], list(range(20, 55))]
+        base = LLMEngine(config=cfg, params=params, num_slots=4,
+                         kv_cache="paged", kv_block_size=16, seed=0)
+        want = [base.generate(p, max_tokens=8) for p in prompts]
+        base.shutdown()
+
+        eng = LLMEngine(config=cfg, params=params, num_slots=4,
+                        kv_cache="paged", kv_block_size=16, seed=0,
+                        prefill_chunk=16)
+        try:
+            got = [eng.generate(p, max_tokens=8) for p in prompts]
+            assert got == want
+            assert eng.stats()["prefill_chunks_run"] == 7
+        finally:
+            eng.shutdown()
