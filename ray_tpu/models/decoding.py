@@ -300,6 +300,112 @@ def make_chunked_prefill(params: Params, config: LlamaConfig):
     return call
 
 
+def make_spec_verify(params: Params, config: LlamaConfig):
+    """Speculative-decoding verify step (vLLM prompt-lookup / ngram
+    flavor): evaluate k+1 candidate tokens starting at the slot's
+    current length in ONE forward, returning logits for EVERY position —
+    the engine accepts the longest proposal prefix whose argmax chain
+    matches and takes one bonus token from the first divergence.
+
+    verify(cache, tokens (1, C), true_len, start_pos, slot) →
+        (cache, all_logits (C, vocab) f32)
+
+    Cache rows for ALL C tokens are written (rejected rows sit beyond
+    the final length and are overwritten by later writes; attention
+    masks by length, so they are invisible). The caller fixes
+    ``cache["length"]`` to the accepted length afterwards."""
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("pad_len",))
+    def verify(cache: Cache, tokens: jax.Array, true_len: jax.Array,
+               start_pos: jax.Array, slot: jax.Array, pad_len: int):
+        S = cache["k"].shape[2]
+        x = params["embed"].astype(c.dtype)[tokens]          # (1, C, E)
+        rel = jnp.arange(pad_len)
+        positions = (start_pos + rel)[None, :]
+        mask_valid = rel < true_len
+
+        def body(x, scanned):
+            layer, kc_all, vc_all = scanned
+            h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
+            q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
+            k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
+            v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            kc_all = jax.lax.dynamic_update_slice(
+                kc_all, jnp.where(mask_valid[None, :, None, None], k,
+                                  0.0).astype(kc_all.dtype),
+                (slot, start_pos, 0, 0))
+            vc_all = jax.lax.dynamic_update_slice(
+                vc_all, jnp.where(mask_valid[None, :, None, None], v,
+                                  0.0).astype(vc_all.dtype),
+                (slot, start_pos, 0, 0))
+            ks = kc_all[slot]
+            vs = vc_all[slot]
+            KV = ks.shape[1]
+            H = q.shape[2]
+            group = H // KV
+            qg = (q[0].astype(jnp.float32)
+                  .reshape(pad_len, KV, group, -1))
+            s = jnp.einsum("ckgd,skd->kgcs", qg,
+                           ks.astype(jnp.float32)) * (c.head_dim ** -0.5)
+            allowed = (jnp.arange(S)[None, :]
+                       <= (start_pos + rel)[:, None])
+            s = jnp.where(allowed[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("kgcs,skd->ckgd", p,
+                             vs.astype(jnp.float32))
+            out = out.reshape(1, pad_len, H, -1).astype(x.dtype)
+            x = x + jnp.einsum("bshd,hde->bse", out,
+                               layer["wo"].astype(x.dtype))
+            h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+            g = jnp.einsum("bse,em->bsm", h2,
+                           layer["w_gate"].astype(h2.dtype))
+            u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
+            x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
+                               layer["w_down"].astype(h2.dtype))
+            return x, (kc_all, vc_all)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+        all_logits = jnp.einsum("ce,ev->cv", x[0].astype(jnp.float32),
+                                head.astype(jnp.float32))
+        # length is provisionally start+true_len; the engine overwrites
+        # it with the accepted length right after
+        new_len = cache["length"].at[slot].set(start_pos + true_len)
+        return ({"k": new_k, "v": new_v, "length": new_len}, all_logits)
+
+    def call(cache, tokens, true_len, start_pos, slot):
+        pad_len = tokens.shape[1]
+        return verify(cache, tokens, jnp.asarray(true_len, jnp.int32),
+                      jnp.asarray(start_pos, jnp.int32),
+                      jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
+def propose_ngram(context: list, k: int, ngram: int = 2):
+    """Prompt-lookup proposal (vLLM "[ngram]" speculative method): find
+    the most recent earlier occurrence of the trailing ``ngram`` tokens
+    and propose the k tokens that followed it. None if no match."""
+    if len(context) < ngram + 1:
+        return None
+    tail = context[-ngram:]
+    # scan right-to-left, excluding the trailing occurrence itself
+    for i in range(len(context) - ngram - 1, -1, -1):
+        if context[i:i + ngram] == tail:
+            nxt = context[i + ngram:i + ngram + k]
+            if nxt:
+                return list(nxt)
+            return None
+    return None
+
+
 def make_inject(config: LlamaConfig):
     """Build the jitted KV-injection step: write an externally computed
     prompt KV (from a prefill replica or a prefix cache) into one slot.

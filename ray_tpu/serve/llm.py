@@ -55,7 +55,9 @@ class LLMEngine:
                  kv_cache: str = "paged",
                  kv_pool_tokens: Optional[int] = None,
                  kv_block_size: int = 64,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 speculation: Optional[str] = None,
+                 spec_k: int = 4):
         import collections
 
         import jax
@@ -63,7 +65,7 @@ class LLMEngine:
         from ray_tpu.models import llama
         from ray_tpu.models.decoding import (
             init_cache, make_chunked_prefill, make_decode_step,
-            make_inject, make_prefill)
+            make_inject, make_prefill, make_spec_verify)
 
         self.config = config or llama.CONFIGS[model]
         if params is None:
@@ -128,6 +130,26 @@ class LLMEngine:
         # slot -> {"req", "tokens", "pos"} for in-progress chunked prefills
         self._prefilling: Dict[int, dict] = {}
         self._chunks_run = 0
+        # Speculative decoding, prompt-lookup flavor (vLLM's "[ngram]"
+        # method — no draft model): greedy single-stream generations
+        # propose the k tokens that followed the most recent earlier
+        # occurrence of the trailing 2-gram and verify them in ONE
+        # forward; acceptance only skips compute, never changes outputs.
+        if speculation is not None:
+            if speculation != "ngram":
+                raise ValueError(
+                    f"speculation={speculation!r}: only 'ngram' "
+                    "(prompt lookup) is supported")
+            if kv_cache != "slot":
+                raise ValueError(
+                    "speculation currently requires kv_cache='slot'")
+            if spec_k <= 0:
+                raise ValueError("spec_k must be positive")
+            self._spec_verify = make_spec_verify(params, self.config)
+        self.speculation = speculation
+        self.spec_k = spec_k
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._key = jax.random.key(seed)
         # Exact-prompt KV cache (host LRU), OFF by default: storing pays
         # a device->host copy of the prompt KV per admission, worth it
@@ -255,6 +277,8 @@ class LLMEngine:
                "prefix_misses": self._prefix_misses,
                "prefill_chunks_run": self._chunks_run,
                "prefilling_slots": len(self._prefilling),
+               "spec_proposed": self._spec_proposed,
+               "spec_accepted": self._spec_accepted,
                "kv_cache": self.kv_cache}
         if self.kv_cache == "paged":
             out.update(
@@ -419,6 +443,56 @@ class LLMEngine:
             self._admit_seq[slot] = self._admit_counter
             self._maybe_finish(slot)
 
+    def _try_speculate(self, slot: int, req) -> bool:
+        """One prompt-lookup speculative step for a lone greedy stream.
+        Returns False (caller falls back to normal decode) when no
+        proposal exists or the window would overrun max_seq."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import propose_ngram
+
+        C = self.spec_k + 1
+        start = int(self._slot_len[slot])
+        if start + C > self.max_seq:
+            return False
+        prop = propose_ngram(req.prompt + req.output, self.spec_k)
+        if not prop:
+            return False
+        buf = np.zeros((1, C), np.int32)
+        buf[0, 0] = self._last_token[slot]
+        buf[0, 1:1 + len(prop)] = prop
+        true_len = 1 + len(prop)
+        self._cache, all_logits = self._spec_verify(
+            self._cache, jnp.asarray(buf), true_len, start, slot)
+        greedy = np.asarray(all_logits)[:true_len].argmax(-1)
+        accepted = 0
+        while accepted < len(prop) and int(greedy[accepted]) == prop[accepted]:
+            accepted += 1
+        emitted = [int(t) for t in prop[:accepted]] + [int(greedy[accepted])]
+        self._spec_proposed += len(prop)
+        self._spec_accepted += accepted
+        # respect max_tokens and eos inside the speculative window
+        room = req.max_tokens - len(req.output)
+        emitted = emitted[:max(0, room)]
+        if req.eos_token is not None and req.eos_token in emitted:
+            emitted = emitted[:emitted.index(req.eos_token) + 1]
+        if not emitted:
+            # shouldn't happen (finished requests leave the slot), but
+            # never let the device length run ahead of the host state
+            self._cache["length"] = self._cache["length"].at[slot].set(start)
+            return True
+        req.output.extend(emitted)
+        self._last_token[slot] = emitted[-1]
+        new_len = start + len(emitted)
+        # rows beyond the accepted window hold rejected-token K/V; they
+        # sit past the length and are overwritten by later writes
+        self._cache["length"] = self._cache["length"].at[slot].set(new_len)
+        self._slot_len[slot] = new_len
+        self._steps += 1
+        self._tokens_generated += len(emitted)
+        self._maybe_finish(slot)
+        return True
+
     def _advance_chunked_prefill(self):
         """Run ONE chunk of the oldest in-progress chunked prefill; on
         the final chunk, sample the first token and activate the slot."""
@@ -575,6 +649,12 @@ class LLMEngine:
             if not self._prefilling:
                 time.sleep(0.002)
             return
+        if (self.speculation == "ngram" and int(active.sum()) == 1
+                and not self._prefilling):
+            slot = int(np.argmax(active))
+            req = self._slots[slot]
+            if req.temperature <= 0.0 and self._try_speculate(slot, req):
+                return
         if self.kv_cache == "paged":
             self._cache, logits = self._decode(
                 self._cache, self._alloc.device_tables(),
