@@ -148,6 +148,7 @@ class Autoscaler:
                 logger.exception("autoscaler reconcile failed")
 
     def _reconcile_once(self):
+        self.instance_manager.gc()  # bound terminal-instance history
         load = self._gcs.call("get_cluster_load")
         nodes = self._gcs.get_all_nodes()
         raw: List[dict] = list(load.get("lease_demands", []))
@@ -193,6 +194,21 @@ class Autoscaler:
             handle = inst.handle
             if handle is None:
                 continue
+            if inst.status == ALLOCATED and handle not in alive_ids:
+                # provider may only now know the node's real identity
+                # (KubeRay: the operator picks pod names after the launch)
+                try:
+                    resolved = self._provider.resolve_handle(handle)
+                except Exception:  # noqa: BLE001 — retried next tick
+                    logger.exception("resolve_handle(%s) failed", handle[:8])
+                    resolved = handle
+                if resolved is not None and resolved != handle:
+                    self.instance_manager.update_handle(
+                        inst.instance_id, resolved)
+                    if handle in self._idle_since:
+                        self._idle_since[resolved] = \
+                            self._idle_since.pop(handle)
+                    handle = resolved
             if handle in alive_ids:
                 if inst.status == ALLOCATED:
                     self.instance_manager.transition(
@@ -275,8 +291,15 @@ class Autoscaler:
             inst = self.instance_manager.create(t.name)  # QUEUED
             self.instance_manager.transition(inst.instance_id, REQUESTED,
                                              "launch issued")
-            handle = self._provider.launch_node(
-                t.name, dict(t.resources), dict(t.labels))
+            try:
+                handle = self._provider.launch_node(
+                    t.name, dict(t.resources), dict(t.labels))
+            except Exception:  # noqa: BLE001 — provider rejected the launch:
+                # terminal ALLOCATION_FAILED, never a stranded REQUESTED
+                logger.exception("launch of %s failed", t.name)
+                self.instance_manager.transition(
+                    inst.instance_id, ALLOCATION_FAILED, "launch_node raised")
+                continue
             # the handle is recorded BEFORE confirm: a fast in-process
             # node must not register while status() shows nothing launched
             self.instance_manager.transition(inst.instance_id, ALLOCATED,

@@ -115,6 +115,18 @@ class InstanceManager:
             inst.status_history.append((new_status, time.time()))
             return inst
 
+    def update_handle(self, instance_id: str, handle: str) -> Instance:
+        """Re-key an instance to the identity the provider resolved after
+        launch (no status change; the swap is recorded in details)."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise KeyError(instance_id)
+            if inst.handle != handle:
+                inst.details = f"handle {inst.handle} -> {handle}"
+                inst.handle = handle
+            return inst
+
     def by_status(self, *statuses: str) -> List[Instance]:
         with self._lock:
             return [i for i in self._instances.values()

@@ -61,9 +61,18 @@ class KubeRayProvider(NodeProvider):
         self._ns = namespace
         self._path = (f"/apis/ray.io/v1/namespaces/{namespace}"
                       f"/rayclusters/{cluster_name}")
-        # synthetic handles: group/N counters per launch (the operator
-        # picks pod names; correlation happens via pod labels)
+        self._pods_path = f"/api/v1/namespaces/{namespace}/pods"
+        # launch_node returns a synthetic placeholder (the operator picks
+        # pod names, so the real identity can't be known at launch time);
+        # resolve_handle() later swaps it for the pod name, which is what
+        # the node registers under (a pod's hostname IS its name, and the
+        # raylet stamps it into node labels — see resolve_handle)
         self._counts: Dict[str, int] = {}
+        self._group_of: Dict[str, str] = {}     # any handle -> group name
+        self._pod_of: Dict[str, str] = {}       # synthetic -> pod name
+        # pods that already existed when we issued a launch can't be the
+        # pod that launch creates — never claim them
+        self._foreign: set = set()
 
     def _get_cr(self) -> dict:
         return self._req("GET", self._path)
@@ -96,15 +105,29 @@ class KubeRayProvider(NodeProvider):
         self._req("PATCH", self._path, patch,
                   content_type="application/json-patch+json")
 
+    def _list_group_pods(self, group: str) -> List[dict]:
+        """Worker pods the operator created for `group` (the standard
+        KubeRay-operator labels)."""
+        selector = (f"ray.io/cluster={self._name},"
+                    f"ray.io/group={group}")
+        reply = self._req(
+            "GET", f"{self._pods_path}?labelSelector={selector}")
+        return [p for p in reply.get("items", [])
+                if (p.get("metadata", {}).get("deletionTimestamp") is None)]
+
     def launch_node(self, node_type: str, resources: Dict[str, float],
                     labels: Dict[str, str]) -> str:
         cr = self._get_cr()
         group = self._group(cr, node_type)
+        self._foreign.update(
+            p["metadata"]["name"] for p in self._list_group_pods(node_type)
+            if p["metadata"]["name"] not in self._pod_of.values())
         target = int(group.get("replicas", 0)) + 1
         self._patch_replicas(node_type, target)
         n = self._counts.get(node_type, 0) + 1
         self._counts[node_type] = n
-        handle = f"{self._name}-{node_type}-{n}"
+        handle = f"pending:{self._name}-{node_type}-{n}"
+        self._group_of[handle] = node_type
         logger.info("kuberay: %s replicas -> %d (handle %s)",
                     node_type, target, handle)
         return handle
@@ -114,19 +137,76 @@ class KubeRayProvider(NodeProvider):
         # GCS (watched by the reconcile loop) is the readiness signal
         return None
 
+    def resolve_handle(self, node_handle: str) -> Optional[str]:
+        """Swap a ``pending:`` placeholder for the real pod name.
+
+        The autoscaler calls this every reconcile tick for unregistered
+        instances.  A pod not yet claimed by another placeholder is
+        claimed first-come-first-served — which pod maps to which launch
+        is arbitrary but irrelevant (pods in a group are fungible; what
+        matters is one handle per pod).  The node registers under the pod
+        name because the raylet's startup stamps ``rt.io/pod-name:
+        $HOSTNAME`` into its node labels (a pod's hostname is its name),
+        so the resolved handle matches GCS node identities and the
+        launch-timeout sweep stops churning healthy nodes."""
+        if not node_handle.startswith("pending:"):
+            return node_handle
+        pod = self._pod_of.get(node_handle)
+        if pod is not None:
+            return pod
+        group = self._group_of.get(node_handle)
+        if group is None:
+            return None
+        claimed = set(self._pod_of.values()) | self._foreign
+        for p in sorted(self._list_group_pods(group),
+                        key=lambda p: p["metadata"].get(
+                            "creationTimestamp", "")):
+            name = p["metadata"]["name"]
+            if name not in claimed:
+                self._pod_of[node_handle] = name
+                self._group_of[name] = group
+                logger.info("kuberay: handle %s resolved to pod %s",
+                            node_handle, name)
+                return name
+        return None  # operator hasn't created the pod yet
+
     def terminate_node(self, node_handle: str) -> None:
-        # handle format: <cluster>-<group>-<n>
-        group = node_handle[len(self._name) + 1:].rsplit("-", 1)[0]
+        group = self._group_of.get(node_handle)
+        pod = self._pod_of.pop(node_handle, None)  # placeholder case
+        if node_handle.startswith("pending:"):
+            if group is None:  # pre-restart handle: derive from format
+                group = node_handle[len("pending:") + len(self._name)
+                                    + 1:].rsplit("-", 1)[0]
+        else:
+            pod = node_handle
+            if group is None:
+                # provider restarted since launch: recover the group from
+                # the pod's own labels
+                for g_cr in self._get_cr()["spec"].get(
+                        "workerGroupSpecs", []):
+                    g = g_cr["groupName"]
+                    if any(p["metadata"]["name"] == pod
+                           for p in self._list_group_pods(g)):
+                        group = g
+                        break
+        if group is None:
+            raise ValueError(f"cannot map handle {node_handle!r} to a "
+                             f"worker group of {self._name}")
         cr = self._get_cr()
         g = self._group(cr, group)
         target = max(0, int(g.get("replicas", 0)) - 1)
+        # workersToDelete must name REAL pods — the operator ignores
+        # anything else and would delete an arbitrary pod instead
         self._patch_replicas(group, target,
-                             workers_to_delete=[node_handle])
+                             workers_to_delete=[pod] if pod else None)
+        self._group_of.pop(node_handle, None)
+        if pod:
+            self._group_of.pop(pod, None)
 
     def live_nodes(self) -> List[str]:
         cr = self._get_cr()
-        out = []
+        out: List[str] = []
         for g in cr["spec"].get("workerGroupSpecs", []):
-            out.extend(f"{self._name}-{g['groupName']}-{i + 1}"
-                       for i in range(int(g.get("replicas", 0))))
+            out.extend(p["metadata"]["name"]
+                       for p in self._list_group_pods(g["groupName"]))
         return out

@@ -38,6 +38,17 @@ class NodeProvider:
     def terminate_node(self, node_handle: str) -> None:
         raise NotImplementedError
 
+    def resolve_handle(self, node_handle: str) -> Optional[str]:
+        """Map a launch handle to the identity the node will register under
+        (GCS node-id hex or a node-label value).  Providers whose handle IS
+        that identity (AWS instance ids stamped into labels via user-data,
+        local raylet node ids) return it unchanged — the default.  Providers
+        that cannot know the identity at launch time (KubeRay: the operator
+        picks pod names) return the real identity once it exists, or None
+        while it doesn't; the autoscaler re-polls every reconcile tick and
+        the launch timeout keeps covering the never-appears case."""
+        return node_handle
+
     def live_nodes(self) -> List[str]:
         raise NotImplementedError
 

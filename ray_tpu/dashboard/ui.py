@@ -52,8 +52,8 @@ INDEX_HTML = """<!doctype html>
 const VIEWS = ["overview","nodes","actors","pgs","jobs","serve","tasks",
                "metrics","logs"];
 const $ = (s) => document.querySelector(s);
-const esc = (s) => String(s).replace(/[&<>]/g,
-  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const esc = (s) => String(s).replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const pill = (s) => `<span class="pill ${esc(s)}">${esc(s)}</span>`;
 const fmtB = (b) => b > 1<<30 ? (b/(1<<30)).toFixed(1)+" GiB"
   : b > 1<<20 ? (b/(1<<20)).toFixed(1)+" MiB" : b + " B";

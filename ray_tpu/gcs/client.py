@@ -14,8 +14,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.rpc.pubsub import Subscriber
-from ray_tpu.rpc.rpc import RetryableRpcClient, RpcError
-from ray_tpu.common.status import RtTimeoutError
+from ray_tpu.rpc.rpc import (RetryableRpcClient, RpcError, RpcMethodNotFound,
+                             RpcRetriesExhausted)
 
 
 def _standby_addresses_from_env() -> List[Tuple[str, int]]:
@@ -62,13 +62,21 @@ class GcsClient:
                 pass
             self._subscriber = None
 
+    # Rotation triggers: RpcMethodNotFound = an unpromoted standby answered
+    # ("not the leader" — rotate instantly, no retry window burned);
+    # RpcRetriesExhausted = the address is transport-dead.  A plain per-call
+    # RtTimeoutError (slow-but-alive primary) deliberately does NOT rotate —
+    # tearing down the subscriber over one slow call would lose pubsub state
+    # for no availability gain.
+    _ROTATE_ON = (RpcMethodNotFound, RpcRetriesExhausted, RpcError)
+
     # -- async passthrough for in-loop callers --
     async def call_async(self, method: str, **kwargs):
         last: Optional[Exception] = None
         for _ in range(len(self.addresses)):
             try:
                 return await self._rpc.call_async(method, **kwargs)
-            except (RtTimeoutError, RpcError) as e:
+            except self._ROTATE_ON as e:
                 last = e
                 if len(self.addresses) == 1:
                     raise
@@ -80,7 +88,7 @@ class GcsClient:
         for _ in range(len(self.addresses)):
             try:
                 return self._rpc.call(method, **kwargs)
-            except (RtTimeoutError, RpcError) as e:
+            except self._ROTATE_ON as e:
                 last = e
                 if len(self.addresses) == 1:
                     raise

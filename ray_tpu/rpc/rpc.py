@@ -53,6 +53,19 @@ class RpcProtocolError(RpcError):
     incompatible protocol will not heal on reconnect)."""
 
 
+class RpcMethodNotFound(RpcError):
+    """Peer answered but doesn't serve this method — NOT retryable on the
+    same connection (an unpromoted GCS standby looks exactly like this;
+    rotating clients treat it as "not the leader, try the next address")."""
+
+
+class RpcRetriesExhausted(RtTimeoutError):
+    """Reconnect-with-backoff burned the whole per-address deadline — the
+    peer is dead at the transport level, not merely slow.  Distinct from a
+    plain per-call RtTimeoutError (slow-but-alive handler) so failover
+    clients rotate only on the former."""
+
+
 class RemoteMethodError(Exception):
     """Handler raised; carries the remote traceback."""
 
@@ -358,9 +371,19 @@ class RpcClient:
 
     async def _handshake(self, writer: asyncio.StreamWriter):
         """First frames on the wire: HELLO out, HELLO back (protocol.py).
-        Completes before any request is written."""
+        Completes before any request is written.
+
+        A pre-handshake (protocol-1) server drops the unknown HELLO frame
+        without replying, so a HELLO timeout on an otherwise-live
+        connection means "legacy peer": degrade to protocol 1 on this
+        connection (the new-client→old-server half of the rolling-upgrade
+        contract; old-client→new-server is the server's REQ-first path).
+        The downgrade is remembered so reconnects skip the wait."""
         from ray_tpu.rpc import protocol as _proto
 
+        if getattr(self, "_peer_is_legacy", False):
+            self.negotiated_protocol = 1
+            return
         self._hello_fut = asyncio.get_running_loop().create_future()
         try:
             from ray_tpu.rpc.schema import SCHEMA_VERSION
@@ -372,7 +395,13 @@ class RpcClient:
             await writer.drain()
             hello = await asyncio.wait_for(
                 self._hello_fut, GLOBAL_CONFIG.get("rpc_connect_timeout_s"))
-        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+        except asyncio.TimeoutError:
+            # Live connection, no HELLO back: legacy protocol-1 server.
+            self._peer_is_legacy = True
+            self.negotiated_protocol = 1
+            self._hello_fut = None
+            return
+        except (ConnectionError, OSError) as e:
             self._fail_all(RpcError(f"handshake with {self.address} failed"))
             raise RpcError(
                 f"handshake with {self.address} failed: {e}") from e
@@ -407,6 +436,10 @@ class RpcClient:
                         kind, cause, tb = msg["error"]
                         if kind == "raised" and isinstance(cause, BaseException):
                             fut.set_exception(RemoteMethodError(msg.get("method", "?"), cause, tb))
+                        elif kind == "nomethod":
+                            # typed so callers (and the retry loop) can tell
+                            # "peer doesn't serve this" from transport failure
+                            fut.set_exception(RpcMethodNotFound(str(cause)))
                         else:
                             fut.set_exception(RpcError(f"{kind}: {cause}"))
                     else:
@@ -501,14 +534,15 @@ class RetryableRpcClient:
         while True:
             try:
                 return await self._client.call_async(method, timeout=timeout, **kwargs)
-            except RpcProtocolError:
-                raise  # version mismatch will not heal on reconnect
+            except (RpcProtocolError, RpcMethodNotFound):
+                raise  # neither heals on reconnect to the same peer
             except (RpcError, chaos.RpcChaosError) as e:
                 attempt += 1
                 if attempt >= self._max_attempts:
                     raise
                 if deadline is not None and time.monotonic() >= deadline:
-                    raise RtTimeoutError(f"rpc {method} retries exhausted: {e}") from e
+                    raise RpcRetriesExhausted(
+                        f"rpc {method} retries exhausted: {e}") from e
                 await asyncio.sleep(min(cap, base * (2 ** (attempt - 1))))
                 self._client.close()
                 self._client = RpcClient(self.address)

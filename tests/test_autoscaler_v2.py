@@ -165,6 +165,64 @@ class TestAwsProvider:
             AwsProvider(region="r", ami="a", subnet_id="s")
 
 
+class _FakeKubeApi:
+    """Stub API server: serves the RayCluster CR and a pods listing; a
+    fake operator (`converge`) creates/deletes pods to match replicas,
+    honouring workersToDelete — the contract the provider drives."""
+
+    def __init__(self, cr):
+        self.cr = cr
+        self.patches = []
+        self.pods = {}  # name -> group
+        self._counter = 0
+
+    def converge(self):
+        for g in self.cr["spec"]["workerGroupSpecs"]:
+            group = g["groupName"]
+            want = int(g.get("replicas", 0))
+            doomed = (g.get("scaleStrategy") or {}).get(
+                "workersToDelete", [])
+            for name in list(self.pods):
+                if self.pods[name] == group and name in doomed:
+                    del self.pods[name]
+            have = [n for n, grp in self.pods.items() if grp == group]
+            while len(have) < want:
+                self._counter += 1
+                # operator-style random-suffix pod name
+                name = f"rc-{group}-worker-{self._counter:05x}"
+                self.pods[name] = group
+                have.append(name)
+            while len(have) > want:
+                del self.pods[have.pop()]
+
+    def __call__(self, method, path, body=None,
+                 content_type="application/json"):
+        if method == "GET" and "/pods" in path:
+            selector = path.split("labelSelector=")[1]
+            group = dict(kv.split("=") for kv in
+                         selector.split(","))["ray.io/group"]
+            return {"items": [
+                {"metadata": {"name": n,
+                              "creationTimestamp": f"t{i:04d}"}}
+                for i, (n, grp) in enumerate(sorted(self.pods.items()))
+                if grp == group]}
+        if method == "GET":
+            return self.cr
+        assert method == "PATCH"
+        assert content_type == "application/json-patch+json"
+        self.patches.append(body)
+        for op in body:
+            parts = op["path"].split("/")
+            idx = int(parts[3])
+            if parts[4] == "replicas":
+                self.cr["spec"]["workerGroupSpecs"][idx]["replicas"] = \
+                    op["value"]
+            else:
+                self.cr["spec"]["workerGroupSpecs"][idx]["scaleStrategy"] = \
+                    op["value"]
+        return {}
+
+
 class TestKubeRayProvider:
     def _provider(self):
         from ray_tpu.autoscaler.kuberay import KubeRayProvider
@@ -173,51 +231,66 @@ class TestKubeRayProvider:
             {"groupName": "tpu-group", "replicas": 1},
             {"groupName": "cpu-group", "replicas": 0},
         ]}}
-        patches = []
-
-        def requester(method, path, body=None,
-                      content_type="application/json"):
-            if method == "GET":
-                return cr
-            assert method == "PATCH"
-            assert content_type == "application/json-patch+json"
-            patches.append(body)
-            for op in body:
-                parts = op["path"].split("/")
-                idx = int(parts[3])
-                if parts[4] == "replicas":
-                    cr["spec"]["workerGroupSpecs"][idx]["replicas"] = \
-                        op["value"]
-                else:
-                    cr["spec"]["workerGroupSpecs"][idx]["scaleStrategy"] = \
-                        op["value"]
-            return {}
-
+        api = _FakeKubeApi(cr)
+        api.converge()  # pre-existing replica gets its pod
         return KubeRayProvider(cluster_name="rc", namespace="ns",
-                               requester=requester), cr, patches
+                               requester=api), api
 
     def test_scale_up_patches_replicas(self):
-        p, cr, patches = self._provider()
+        p, api = self._provider()
         h = p.launch_node("tpu-group", {"TPU": 4}, {})
-        assert cr["spec"]["workerGroupSpecs"][0]["replicas"] == 2
-        assert h == "rc-tpu-group-1"
+        assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 2
+        assert h.startswith("pending:")
         p.confirm_launch(h)  # no-op: operator converges asynchronously
 
-    def test_scale_down_names_worker_to_delete(self):
-        p, cr, patches = self._provider()
+    def test_resolve_waits_for_operator_then_claims_pod(self):
+        p, api = self._provider()
         h = p.launch_node("tpu-group", {"TPU": 4}, {})
+        # operator hasn't created the pod yet: unresolved, NOT an error
+        assert p.resolve_handle(h) is None
+        api.converge()
+        pod = p.resolve_handle(h)
+        assert pod in api.pods and api.pods[pod] == "tpu-group"
+        # stable on re-poll
+        assert p.resolve_handle(h) == pod
+        # a second launch claims a DIFFERENT pod
+        h2 = p.launch_node("tpu-group", {"TPU": 4}, {})
+        api.converge()
+        pod2 = p.resolve_handle(h2)
+        assert pod2 is not None and pod2 != pod
+
+    def test_scale_down_names_real_pod_to_delete(self):
+        p, api = self._provider()
+        h = p.launch_node("tpu-group", {"TPU": 4}, {})
+        api.converge()
+        pod = p.resolve_handle(h)
+        p.terminate_node(pod)
+        assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+        strat = api.cr["spec"]["workerGroupSpecs"][0]["scaleStrategy"]
+        # workersToDelete names the REAL pod, never a synthetic handle
+        assert strat == {"workersToDelete": [pod]}
+        api.converge()
+        assert pod not in api.pods
+
+    def test_terminate_unresolved_pending_handle(self):
+        # launch timed out before the operator made a pod: scale back down
+        # without naming any pod for deletion
+        p, api = self._provider()
+        h = p.launch_node("cpu-group", {}, {})
         p.terminate_node(h)
-        assert cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
-        strat = cr["spec"]["workerGroupSpecs"][0]["scaleStrategy"]
-        assert strat == {"workersToDelete": [h]}
+        assert api.cr["spec"]["workerGroupSpecs"][1]["replicas"] == 0
+        strat = (api.cr["spec"]["workerGroupSpecs"][1].get(
+            "scaleStrategy") or {})
+        assert strat.get("workersToDelete", []) == []
 
     def test_unknown_group_rejected(self):
-        p, _, _ = self._provider()
+        p, _ = self._provider()
         with pytest.raises(ValueError, match="no worker group"):
             p.launch_node("nope", {}, {})
 
-    def test_live_nodes_from_replicas(self):
-        p, cr, _ = self._provider()
-        assert p.live_nodes() == ["rc-tpu-group-1"]
+    def test_live_nodes_lists_real_pods(self):
+        p, api = self._provider()
+        assert p.live_nodes() == sorted(api.pods)
         p.launch_node("cpu-group", {}, {})
-        assert "rc-cpu-group-1" in p.live_nodes()
+        api.converge()
+        assert sorted(p.live_nodes()) == sorted(api.pods)

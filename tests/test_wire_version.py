@@ -113,6 +113,75 @@ class TestHandshake:
         assert msg["min_protocol"] == proto.MIN_SUPPORTED_PROTOCOL
         assert "schema" in msg
 
+    def test_new_client_degrades_to_legacy_server(self):
+        """A handshake-aware client talking to a pre-handshake server (which
+        drops unknown frame types without replying) must fall back to
+        protocol 1 on that connection instead of failing every reconnect —
+        the other half of the rolling-upgrade contract."""
+        import threading
+
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        def legacy_server(sock):
+            conn, _ = sock.accept()
+            buf = b""
+            try:
+                while True:
+                    chunk = conn.recv(1 << 16)
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while len(buf) >= _HEADER.size:
+                        length, ftype = _HEADER.unpack(buf[:_HEADER.size])
+                        if len(buf) < _HEADER.size + length:
+                            break
+                        body = buf[_HEADER.size:_HEADER.size + length]
+                        buf = buf[_HEADER.size + length:]
+                        if ftype != 1:
+                            continue  # pre-handshake: drop unknown frames
+                        msg = pickle.loads(body)
+                        rep = pickle.dumps(
+                            {"id": msg["id"],
+                             "result": msg["kwargs"]})
+                        conn.sendall(_HEADER.pack(len(rep), 2) + rep)
+            except OSError:
+                pass
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        addr = sock.getsockname()
+        t = threading.Thread(target=legacy_server, args=(sock,),
+                             daemon=True)
+        t.start()
+        old = GLOBAL_CONFIG.get("rpc_connect_timeout_s")
+        GLOBAL_CONFIG.set_system_config_value("rpc_connect_timeout_s", 1.0)
+        try:
+            c = RpcClient(addr)
+            assert c.call("echo", a=5, timeout=10.0) == {"a": 5}
+            assert c.negotiated_protocol == 1
+            c.close()
+        finally:
+            GLOBAL_CONFIG.set_system_config_value(
+                "rpc_connect_timeout_s", old)
+            sock.close()
+
+    def test_nomethod_fails_fast_not_retried(self, server):
+        """'unknown method' is an application answer, not a transport
+        failure — RetryableRpcClient must surface it immediately (an
+        unpromoted GCS standby answers exactly this way; burning the whole
+        15 s retry window on it would stall failover)."""
+        import time
+
+        from ray_tpu.rpc.rpc import RpcMethodNotFound
+
+        c = RetryableRpcClient(server.address, deadline_s=30.0)
+        t0 = time.monotonic()
+        with pytest.raises(RpcMethodNotFound):
+            c.call("no_such_method")
+        assert time.monotonic() - t0 < 5.0, "nomethod was retried"
+        c.close()
+
     def test_protocol_error_not_retried(self, server, monkeypatch):
         """RetryableRpcClient must fail a version mismatch immediately —
         reconnecting cannot heal it."""
