@@ -70,9 +70,12 @@ class KubeRayProvider(NodeProvider):
         self._counts: Dict[str, int] = {}
         self._group_of: Dict[str, str] = {}     # any handle -> group name
         self._pod_of: Dict[str, str] = {}       # synthetic -> pod name
-        # pods that already existed when we issued a launch can't be the
-        # pod that launch creates — never claim them
-        self._foreign: set = set()
+        # per-group: pods that already existed when we issued a launch
+        # can't be the pod that launch creates — never claim them.  Only
+        # snapshotted while the group has no unresolved launches (a pod
+        # seen then might belong to one of them); pruned against live
+        # listings so deleted pods don't accumulate forever.
+        self._foreign: Dict[str, set] = {}
 
     def _get_cr(self) -> dict:
         return self._req("GET", self._path)
@@ -105,6 +108,11 @@ class KubeRayProvider(NodeProvider):
         self._req("PATCH", self._path, patch,
                   content_type="application/json-patch+json")
 
+    def _unresolved_handles(self, group: str) -> List[str]:
+        return [h for h, g in self._group_of.items()
+                if g == group and h.startswith("pending:")
+                and h not in self._pod_of]
+
     def _list_group_pods(self, group: str) -> List[dict]:
         """Worker pods the operator created for `group` (the standard
         KubeRay-operator labels)."""
@@ -119,9 +127,12 @@ class KubeRayProvider(NodeProvider):
                     labels: Dict[str, str]) -> str:
         cr = self._get_cr()
         group = self._group(cr, node_type)
-        self._foreign.update(
-            p["metadata"]["name"] for p in self._list_group_pods(node_type)
-            if p["metadata"]["name"] not in self._pod_of.values())
+        if not self._unresolved_handles(node_type):
+            claimed = set(self._pod_of.values())
+            self._foreign.setdefault(node_type, set()).update(
+                p["metadata"]["name"]
+                for p in self._list_group_pods(node_type)
+                if p["metadata"]["name"] not in claimed)
         target = int(group.get("replicas", 0)) + 1
         self._patch_replicas(node_type, target)
         n = self._counts.get(node_type, 0) + 1
@@ -157,10 +168,14 @@ class KubeRayProvider(NodeProvider):
         group = self._group_of.get(node_handle)
         if group is None:
             return None
-        claimed = set(self._pod_of.values()) | self._foreign
-        for p in sorted(self._list_group_pods(group),
-                        key=lambda p: p["metadata"].get(
-                            "creationTimestamp", "")):
+        pods = self._list_group_pods(group)
+        live = {p["metadata"]["name"] for p in pods}
+        foreign = self._foreign.get(group, set())
+        foreign &= live  # deleted pods never return: drop their marks
+        self._foreign[group] = foreign
+        claimed = set(self._pod_of.values()) | foreign
+        for p in sorted(pods, key=lambda p: p["metadata"].get(
+                "creationTimestamp", "")):
             name = p["metadata"]["name"]
             if name not in claimed:
                 self._pod_of[node_handle] = name

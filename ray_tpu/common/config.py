@@ -123,6 +123,14 @@ _D("rpc_schema_validation", bool, True,
 _D("rpc_retry_base_ms", int, 100, "retryable client initial backoff")
 _D("rpc_retry_max_ms", int, 5000, "retryable client max backoff")
 _D("rpc_connect_timeout_s", float, 10.0, "client connect timeout")
+_D("rpc_require_hello", bool, True,
+   "when True (default), a peer that never answers HELLO is treated as a "
+   "transport failure (retry/rotate); set False only during a rolling "
+   "upgrade from pre-handshake nodes, where the silent peer is assumed "
+   "legacy and the connection degrades to protocol 1")
+_D("fastloop_enabled", bool, True,
+   "C dispatch loop for eligible actor calls (rpc/native/fastloop.c); "
+   "falls back to the asyncio path when the extension can't build")
 
 # --- scheduling --------------------------------------------------------------
 _D("scheduler_top_k_fraction", float, 0.2, "hybrid policy: top-k fraction of nodes")

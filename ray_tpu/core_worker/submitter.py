@@ -310,6 +310,14 @@ class ActorTaskSubmitter:
         # cancelled call ids: never resent after an actor restart, and
         # their failures surface as TaskCancelledError (not ActorDied)
         self._cancelled = BoundedSet()
+        # fastloop channel (rpc/native/fastloop.c): eligible calls skip the
+        # asyncio pump entirely — the caller thread writes the frame, the C
+        # reader thread completes the reply.  All state below is guarded by
+        # _fast_lock because submit/reply/teardown touch it from three
+        # different threads.
+        self._fast = None
+        self._fast_lock = threading.Lock()
+        self._fast_inflight: Dict[int, TaskSpec] = {}
 
     def next_seq(self) -> int:
         # Called from arbitrary caller threads (e.g. a server fanning out
@@ -322,6 +330,8 @@ class ActorTaskSubmitter:
             return self._seq
 
     def submit(self, spec: TaskSpec):
+        if self._try_fast(spec):
+            return
         # batched wakeup (see NormalTaskSubmitter.submit)
         with self._pending_lock:
             self._pending.append(spec)
@@ -329,6 +339,127 @@ class ActorTaskSubmitter:
                 return
             self._wakeup_scheduled = True
         self._io.loop.call_soon_threadsafe(self._drain_pending)
+
+    # ------------------------------------------------------ fastloop path
+    def _try_fast(self, spec: TaskSpec) -> bool:
+        """Submit over the C channel when eligible.  Eligible = connected,
+        channel up, and the spec carries a _fast_payload (inline plain-value
+        args — by-ref args would block the executee's C thread on
+        dependency fetches).  Returns False to take the asyncio path."""
+        cli = self._fast
+        if cli is None or self._state != "CONNECTED":
+            return False
+        if getattr(spec, "_fast_payload", None) is None or spec.streaming:
+            return False
+        payload = self._encode_spec(spec)
+        with self._fast_lock:
+            if self._fast is not cli:
+                return False
+            self._fast_inflight[spec.sequence_number] = spec
+            try:
+                cli.call(spec.sequence_number, payload)
+            except Exception:  # noqa: BLE001 — write failed, possibly MID-
+                # frame: the byte stream can no longer be trusted, so the
+                # whole channel goes down (never reuse it for a next call)
+                self._fast_inflight.pop(spec.sequence_number, None)
+                self._io.loop.call_soon_threadsafe(self._fast_conn_down)
+                return False
+        return True
+
+    def _setup_fast(self, fast_port) -> None:
+        """(Re)wire the fast channel after address resolution.  Called on
+        the IO loop: the old channel is torn down inline, but the connect
+        itself (DNS + TCP, potentially seconds against a black-holed port)
+        runs on a pool thread — it must never stall the shared loop.
+        Calls submitted before the channel is up just take the asyncio
+        path."""
+        old = None
+        with self._fast_lock:
+            old, self._fast = self._fast, None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if not fast_port or not GLOBAL_CONFIG.get("fastloop_enabled"):
+            return
+        from ray_tpu.rpc.native import load_fastloop
+
+        fl = load_fastloop()
+        if fl is None:
+            return
+        address = self._address  # pin: resolution may move it later
+
+        def connect():
+            import socket as _socket
+
+            try:
+                host = _socket.gethostbyname(address[0])
+                cli = fl.Client(host, int(fast_port), self._on_fast_reply,
+                                timeout=GLOBAL_CONFIG.get(
+                                    "rpc_connect_timeout_s"))
+            except Exception:  # noqa: BLE001 — asyncio path still works
+                logger.debug("fastloop connect to %s:%s failed",
+                             address[0], fast_port, exc_info=True)
+                return
+            stale = False
+            with self._fast_lock:
+                if self._state == "CONNECTED" and self._address == address \
+                        and self._fast is None:
+                    self._fast = cli
+                else:
+                    stale = True  # re-resolved (or died) while connecting
+            if stale:
+                try:
+                    cli.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=connect, name="rt-fastconnect",
+                         daemon=True).start()
+
+    def _on_fast_reply(self, req_id: int, payload) -> None:
+        """Runs on the C reader thread."""
+        if req_id == 0 and payload is None:
+            # connection lost: requeue unacked fast calls through the
+            # ordinary resolve/resend machinery (on the IO loop)
+            self._io.loop.call_soon_threadsafe(self._fast_conn_down)
+            return
+        with self._fast_lock:
+            spec = self._fast_inflight.pop(req_id, None)
+        if spec is None:
+            return  # raced with a teardown requeue: the resend owns it now
+        try:
+            reply = pickle.loads(payload)
+            self._cw.store_task_reply(spec, reply, self._address)
+        except Exception:  # noqa: BLE001 — never kill the reader thread
+            logger.exception("fastloop reply for seq=%d failed", req_id)
+
+    def _fast_conn_down(self) -> None:
+        """IO loop: the fast channel died (worker crash, restart, or our
+        own close).  Unacked fast calls rejoin the slow queue in sequence
+        order; the executee's seq-dedup replays anything that actually
+        completed, so the handover is exactly-once."""
+        with self._fast_lock:
+            cli, self._fast = self._fast, None
+            pending = sorted(self._fast_inflight.values(),
+                             key=lambda s: s.sequence_number)
+            self._fast_inflight.clear()
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if not pending and self._state != "CONNECTED":
+            return
+        if self._state == "DEAD":
+            for spec in pending:
+                self._fail_spec(spec, self._death_error
+                                or ActorDiedError(self.actor_id))
+            return
+        self._queue = pending + self._queue
+        self._io.spawn(self._on_connection_failure(
+            RpcError("fastloop connection lost")))
 
     def _drain_pending(self):
         with self._pending_lock:
@@ -393,7 +524,12 @@ class ActorTaskSubmitter:
                 # state is empty and would otherwise wait forever for the old
                 # sequence numbers (reference: actor_task_submitter resend
                 # protocol).
-                pending = sorted(self._inflight.values(),
+                with self._fast_lock:
+                    # unacked fast calls: the old channel's replies can no
+                    # longer be trusted to arrive; the resend owns them now
+                    fast_pending = list(self._fast_inflight.values())
+                    self._fast_inflight.clear()
+                pending = sorted(list(self._inflight.values()) + fast_pending,
                                  key=lambda s: s.sequence_number) + self._queue
                 self._inflight.clear()
                 # a cancelled call must not ride the resend protocol into
@@ -415,6 +551,7 @@ class ActorTaskSubmitter:
                                 self.actor_id.hex()[:8], len(pending))
                 self._queue = pending
                 self._state = "CONNECTED"
+                self._setup_fast(info.get("fast_port"))
                 return
             if state == "DEAD":
                 self._mark_dead(ActorDiedError(self.actor_id, info.get("death_cause", "")))
@@ -481,7 +618,16 @@ class ActorTaskSubmitter:
     def _mark_dead(self, error: Exception):
         self._state = "DEAD"
         self._death_error = error
-        for spec in list(self._inflight.values()) + self._queue:
+        with self._fast_lock:
+            cli, self._fast = self._fast, None
+            fast_pending = list(self._fast_inflight.values())
+            self._fast_inflight.clear()
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for spec in list(self._inflight.values()) + fast_pending + self._queue:
             self._fail_spec(spec, error)
         self._inflight.clear()
         self._queue.clear()
@@ -511,6 +657,10 @@ class ActorTaskSubmitter:
         for spec in self._inflight.values():
             if spec.task_id.binary() == task_id_bin:
                 return ("running", self._address)
+        with self._fast_lock:
+            for spec in self._fast_inflight.values():
+                if spec.task_id.binary() == task_id_bin:
+                    return ("running", self._address)
         return (None, None)
 
     def notify_actor_state(self, view: dict):

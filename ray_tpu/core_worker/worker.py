@@ -189,6 +189,27 @@ class CoreWorker:
 
         # execution state (executee side)
         self._executor = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rt-exec")
+        # C dispatch loop (rpc/native/fastloop.c): eligible actor pushes
+        # bypass asyncio end to end — frames execute straight off the C
+        # thread (ordered, immediately-runnable calls) or hop once to the
+        # executor/actor loop (concurrent or async-actor calls).  The
+        # SURVEY §2.5 native hot path; drivers never execute actor tasks,
+        # so only workers pay for the extra thread.
+        self._fast_server = None
+        self._fast_port: Optional[int] = None
+        self._fast_gap_buf: Dict[bytes, dict] = {}
+        if mode != MODE_DRIVER and GLOBAL_CONFIG.get("fastloop_enabled"):
+            from ray_tpu.rpc.native import load_fastloop
+
+            fl = load_fastloop()
+            if fl is not None:
+                try:
+                    self._fast_server = fl.Server(self._fast_frame)
+                    self._fast_server.start()
+                    self._fast_port = self._fast_server.port
+                except Exception:  # noqa: BLE001 — asyncio path still works
+                    logger.exception("fastloop server failed to start")
+                    self._fast_server = None
         self._actor_instance: Any = None
         self._actor_max_concurrency = 1
         self._actor_id: Optional[ActorID] = None
@@ -1442,6 +1463,90 @@ class CoreWorker:
             return reply
         return await loop.run_in_executor(self._executor, self._execute_task, task)
 
+    # -------------------------------------------------- fastloop execution
+    def _fast_frame(self, conn_id: int, req_id: int, payload: bytes):
+        """Runs ON the C dispatch thread (rpc/native/fastloop.c Server).
+
+        Returns the pickled reply to write inline, or None when the reply
+        is deferred (send_reply later from whatever thread finishes the
+        task).  MUST NOT BLOCK: an ordered call whose predecessors haven't
+        executed yet is parked in the gap buffer and flushed by
+        _seq_finish — blocking here would stall every caller wired to
+        this worker.  An escaping exception drops the connection, which
+        flips the caller to the asyncio path (seq-dedup keeps that
+        exactly-once)."""
+        if payload[:4] == b"RTFS":
+            task = TaskSpec.from_fast(payload)
+        else:
+            task = pickle.loads(payload)
+        if task.runtime_env is not None:
+            self.job_runtime_env = task.runtime_env  # children inherit
+        if task.job_id is not None and not task.job_id.is_nil():
+            self.current_job_hex = task.job_id.hex()
+            self.job_id = task.job_id
+        if task.is_actor_task() and self._is_async_actor_call(task):
+            start = time.time()
+            cf = asyncio.run_coroutine_threadsafe(
+                self._execute_async_actor_task(task), self._io.loop)
+
+            def _done(f, _start=start):
+                try:
+                    self._record_task_event(task, _start, time.time(),
+                                            f.result() if not f.exception()
+                                            else {"results": {}})
+                except Exception:  # noqa: BLE001
+                    pass
+                self._fast_deferred_reply(conn_id, req_id, f)
+
+            cf.add_done_callback(_done)
+            return None
+        if self._actor_max_concurrency > 1:
+            # concurrent sync methods: same executor hop the asyncio path
+            # takes — the win is skipping the RPC framing, not the pool
+            f = self._executor.submit(self._execute_task, task)
+            f.add_done_callback(
+                lambda f: self._fast_deferred_reply(conn_id, req_id, f))
+            return None
+        caller = (task.caller_worker_id.binary()
+                  if task.caller_worker_id is not None else b"?")
+        seq = task.sequence_number
+        with self._actor_seq_cv:
+            st = self._actor_seq_state.setdefault(
+                caller, {"next": 1, "replies": {}})
+            if seq > st["next"] and seq not in st["replies"]:
+                buf = self._fast_gap_buf.setdefault(caller, {})
+                if len(buf) > 4096:
+                    raise RuntimeError(
+                        "fastloop gap buffer overflow (predecessor call "
+                        "lost?) — dropping connection")
+                buf[seq] = (conn_id, req_id, task)
+                return None
+        return pickle.dumps(self._execute_task(task))
+
+    def _fast_deferred_reply(self, conn_id: int, req_id: int, fut) -> None:
+        try:
+            blob = pickle.dumps(fut.result())
+        except Exception:  # noqa: BLE001 — framework bug; user errors are
+            # already folded into the reply by _execute_task
+            logger.exception("fastloop deferred task failed")
+            return
+        srv = self._fast_server
+        if srv is not None:
+            srv.send_reply(conn_id, req_id, blob)
+
+    def _fast_run_and_reply(self, conn_id: int, req_id: int,
+                            task: TaskSpec) -> None:
+        """Executor-side runner for gap-buffered frames (ready by the time
+        they are flushed, so _execute_task won't block on ordering)."""
+        try:
+            blob = pickle.dumps(self._execute_task(task))
+        except Exception:  # noqa: BLE001
+            logger.exception("fastloop buffered task failed")
+            return
+        srv = self._fast_server
+        if srv is not None:
+            srv.send_reply(conn_id, req_id, blob)
+
     def _is_async_actor_call(self, task: TaskSpec) -> bool:
         with self._actor_lock:
             inst = self._actor_instance
@@ -1555,7 +1660,7 @@ class CoreWorker:
         await self.gcs.call_async(
             "report_actor_state", actor_id=task.actor_id.binary(), state="ALIVE",
             worker_id=self.worker_id.binary(), address=self.server.address,
-            node_id=node_id)
+            node_id=node_id, fast_port=self._fast_port)
         return {"ok": True}
 
     def _execute_task(self, task: TaskSpec) -> dict:
@@ -1666,6 +1771,7 @@ class CoreWorker:
         return None
 
     def _seq_finish(self, caller: bytes, seq: int, reply: dict) -> None:
+        flush = []
         with self._actor_seq_cv:
             st = self._actor_seq_state.setdefault(
                 caller, {"next": 1, "replies": {}})
@@ -1678,6 +1784,18 @@ class CoreWorker:
                 for s in sorted(st["replies"])[: self._REPLY_CACHE_CAP // 2]:
                     del st["replies"][s]
             self._actor_seq_cv.notify_all()
+            buf = self._fast_gap_buf.get(caller)
+            if buf:
+                for s in sorted(buf):
+                    if s <= st["next"] or s in st["replies"]:
+                        flush.append(buf.pop(s))
+                if not buf:
+                    del self._fast_gap_buf[caller]
+        for conn_id, req_id, task in flush:
+            # now immediately runnable (or a duplicate): executes without
+            # blocking an executor thread on the ordering gate
+            self._executor.submit(self._fast_run_and_reply,
+                                  conn_id, req_id, task)
 
     def _execute_actor_task(self, task: TaskSpec) -> dict:
         # In-order execution per caller (unless concurrency > 1).  Completed
@@ -2013,6 +2131,24 @@ class CoreWorker:
             self.gcs.close()
         except Exception:  # noqa: BLE001
             pass
+        if self._fast_server is not None:
+            try:
+                self._fast_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._fast_server = None
+        with self._actor_sub_lock:
+            subs = list(self._actor_submitters.values())
+        for sub in subs:
+            # under the lock: a caller thread mid-cli.call() must finish
+            # its write before the fd is closed out from under it
+            with sub._fast_lock:
+                cli, sub._fast = getattr(sub, "_fast", None), None
+            if cli is not None:
+                try:
+                    cli.close()
+                except Exception:  # noqa: BLE001
+                    pass
         self.server.stop()
         self._executor.shutdown(wait=False)
 

@@ -53,6 +53,9 @@ class ActorRecord:
     node_id: Optional[NodeID] = None
     worker_id: Optional[WorkerID] = None
     address: Optional[Tuple[str, int]] = None
+    # C fastloop dispatch port of the hosting worker (rpc/native/fastloop.c);
+    # None when the worker runs without the native loop
+    fast_port: Optional[int] = None
     num_restarts: int = 0
     death_cause: str = ""
     handled_deaths: set = field(default_factory=set)
@@ -69,6 +72,7 @@ class ActorRecord:
             "node_id": self.node_id and self.node_id.binary(),
             "worker_id": self.worker_id and self.worker_id.binary(),
             "address": self.address,
+            "fast_port": self.fast_port,
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
             "handled_deaths": [w.binary() for w in self.handled_deaths],
@@ -87,6 +91,7 @@ class ActorRecord:
             node_id=d["node_id"] and NodeID(d["node_id"]),
             worker_id=d["worker_id"] and WorkerID(d["worker_id"]),
             address=d["address"] and tuple(d["address"]),
+            fast_port=d.get("fast_port"),
             num_restarts=d["num_restarts"],
             death_cause=d["death_cause"],
             handled_deaths={WorkerID(w) for w in d["handled_deaths"]},
@@ -99,6 +104,7 @@ class ActorRecord:
             "name": self.name,
             "state": self.state,
             "address": self.address,
+            "fast_port": self.fast_port,
             "node_id": self.node_id.hex() if self.node_id else None,
             "num_restarts": self.num_restarts,
             "max_restarts": self.max_restarts,
@@ -716,7 +722,8 @@ class GcsServer:
     async def h_report_actor_state(self, actor_id: bytes, state: str,
                                    worker_id: Optional[bytes] = None,
                                    address=None, node_id: Optional[bytes] = None,
-                                   death_cause: str = ""):
+                                   death_cause: str = "",
+                                   fast_port: Optional[int] = None):
         rec = self._actors.get(ActorID(actor_id))
         if rec is None:
             return False
@@ -724,6 +731,7 @@ class GcsServer:
             rec.state = ACTOR_ALIVE
             rec.worker_id = worker_id and WorkerID(worker_id)
             rec.address = address and tuple(address)
+            rec.fast_port = fast_port
             if node_id:
                 rec.node_id = NodeID(node_id)
             self._unconfirmed_actors.discard(rec.actor_id)

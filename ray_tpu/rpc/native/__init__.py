@@ -51,6 +51,42 @@ def load_fastspec():
         return None if _mod is _FAILED else _mod
 
 
+_FL_SO = os.path.join(_DIR, "_fastloop.so")
+_FL_SRC = os.path.join(_DIR, "fastloop.c")
+_fl_lock = threading.Lock()
+_fl_mod = None
+
+
+def load_fastloop():
+    """Returns the _fastloop extension (C dispatch loop for the actor-call
+    hot path — see fastloop.c), or None when it can't be built; a failed
+    build is cached so callers fall back to the asyncio path for good."""
+    global _fl_mod
+    if _fl_mod is not None:
+        return None if _fl_mod is _FAILED else _fl_mod
+    with _fl_lock:
+        if _fl_mod is not None:
+            return None if _fl_mod is _FAILED else _fl_mod
+        try:
+            if (not os.path.exists(_FL_SO)
+                    or os.path.getmtime(_FL_SO) < os.path.getmtime(_FL_SRC)):
+                include = sysconfig.get_paths()["include"]
+                tmp = _FL_SO + f".tmp.{os.getpid()}"
+                subprocess.run(
+                    ["gcc", "-O2", "-fPIC", "-shared", "-pthread",
+                     f"-I{include}", "-o", tmp, _FL_SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, _FL_SO)
+            spec = importlib.util.spec_from_file_location("_fastloop",
+                                                          _FL_SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _fl_mod = mod
+        except Exception:  # noqa: BLE001 - no compiler / arch mismatch
+            _fl_mod = _FAILED
+        return None if _fl_mod is _FAILED else _fl_mod
+
+
 def unpack_fastspec(blob: bytes):
     """Decode a fastspec buffer with the C codec when available, else a
     pure-Python reader — a receiver without a compiler must still accept

@@ -374,11 +374,15 @@ class RpcClient:
         Completes before any request is written.
 
         A pre-handshake (protocol-1) server drops the unknown HELLO frame
-        without replying, so a HELLO timeout on an otherwise-live
-        connection means "legacy peer": degrade to protocol 1 on this
-        connection (the new-client→old-server half of the rolling-upgrade
-        contract; old-client→new-server is the server's REQ-first path).
-        The downgrade is remembered so reconnects skip the wait."""
+        without replying.  With ``rpc_require_hello=False`` (rolling-
+        upgrade mode) a HELLO timeout on an otherwise-live connection is
+        therefore read as "legacy peer" and the connection degrades to
+        protocol 1 (the new-client→old-server half of the contract;
+        old-client→new-server is the server's REQ-first path), remembered
+        so reconnects skip the wait.  By default the flag is True and the
+        timeout is a transport failure — a wedged-but-accepting NEW server
+        must keep triggering retry/rotation (GCS failover), not a silent
+        permanent downgrade."""
         from ray_tpu.rpc import protocol as _proto
 
         if getattr(self, "_peer_is_legacy", False):
@@ -395,12 +399,17 @@ class RpcClient:
             await writer.drain()
             hello = await asyncio.wait_for(
                 self._hello_fut, GLOBAL_CONFIG.get("rpc_connect_timeout_s"))
-        except asyncio.TimeoutError:
-            # Live connection, no HELLO back: legacy protocol-1 server.
-            self._peer_is_legacy = True
-            self.negotiated_protocol = 1
-            self._hello_fut = None
-            return
+        except asyncio.TimeoutError as e:
+            if not GLOBAL_CONFIG.get("rpc_require_hello"):
+                # rolling-upgrade mode: live connection, no HELLO back —
+                # assume legacy protocol-1 server
+                self._peer_is_legacy = True
+                self.negotiated_protocol = 1
+                self._hello_fut = None
+                return
+            self._fail_all(RpcError(f"handshake with {self.address} failed"))
+            raise RpcError(
+                f"handshake with {self.address} timed out: {e}") from e
         except (ConnectionError, OSError) as e:
             self._fail_all(RpcError(f"handshake with {self.address} failed"))
             raise RpcError(

@@ -157,7 +157,8 @@ RPC_SCHEMAS: Dict[str, Message] = {
     "report_actor_state": _m("report_actor_state", req("actor_id", bytes),
                              req("state", str), opt("worker_id", bytes),
                              opt("address", (tuple, list)),
-                             opt("node_id", bytes), opt("death_cause", str)),
+                             opt("node_id", bytes), opt("death_cause", str),
+                             opt("fast_port", int)),
     "kv_put": _m("kv_put", req("namespace", str), req("key", (bytes, str)),
                  req("value", bytes), opt("overwrite", bool)),
     "kv_get": _m("kv_get", req("namespace", str), req("key", (bytes, str))),

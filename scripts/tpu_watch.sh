@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-long TPU tunnel watcher: probe every ~10 min; the first time the
+# chip answers, capture the headline + kernel + serving benches as
+# builder-recorded artifacts, then exit.  Rounds 2-5 all saw the axon
+# tunnel wedge (a bare jax.devices() hangs); the recorded VERDICT ask is
+# to land a driver-verifiable TPU datum the moment a window opens.
+cd /root/repo || exit 1
+LOG=/tmp/tpu_watch.log
+for i in $(seq 1 70); do
+  if timeout -k 10 240 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu', d" >>"$LOG" 2>&1; then
+    echo "$(date) probe $i: tunnel ALIVE - running benches" >>"$LOG"
+    timeout -k 30 2700 python bench.py >/tmp/bench_r05.out 2>/tmp/bench_r05.err
+    rc=$?
+    echo "bench rc=$rc" >>"$LOG"
+    tail -1 /tmp/bench_r05.out >BENCH_r05_builder.json 2>/dev/null
+    if [ -f bench.py ] && grep -q -- --kernels bench.py; then
+      timeout -k 30 1200 python bench.py --kernels >/tmp/bench_r05_kernels.out 2>&1
+      tail -1 /tmp/bench_r05_kernels.out >BENCH_r05_kernels_builder.json 2>/dev/null
+    fi
+    if [ -f bench_serve.py ]; then
+      timeout -k 30 2700 python bench_serve.py >/tmp/bench_r05_serve.out 2>/tmp/bench_r05_serve.err
+      echo "serve rc=$?" >>"$LOG"
+      tail -1 /tmp/bench_r05_serve.out >BENCH_serve_builder.json 2>/dev/null
+    fi
+    echo "$(date) benches done" >>"$LOG"
+    exit 0
+  fi
+  echo "$(date) probe $i: tunnel dead" >>"$LOG"
+  sleep 540
+done

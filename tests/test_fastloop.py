@@ -1,0 +1,249 @@
+"""fastloop (rpc/native/fastloop.c): the C dispatch path for actor calls.
+
+Reference parity point: the reference's per-call path is C++ end to end
+(src/ray/core_worker/transport/direct_actor_transport, rpc/grpc_server.h);
+here eligible actor calls ride a C poll loop + C reader thread instead of
+asyncio, with the seq-dedup resend protocol guaranteeing exactly-once
+across fast/slow switchovers.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.rpc.native import load_fastloop
+
+
+pytestmark = pytest.mark.skipif(load_fastloop() is None,
+                                reason="no C toolchain")
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu, None
+    ray_tpu.shutdown()
+
+
+class TestTransport:
+    def test_inline_and_deferred_replies(self):
+        fl = load_fastloop()
+        srv_holder = {}
+
+        def handler(conn_id, req_id, payload):
+            if req_id % 2 == 0:
+                threading.Thread(
+                    target=lambda: srv_holder["s"].send_reply(
+                        conn_id, req_id, b"D" + payload)).start()
+                return None
+            return b"I" + payload
+
+        srv = srv_holder["s"] = fl.Server(handler)
+        srv.start()
+        got, done = {}, threading.Event()
+
+        def on_reply(req_id, payload):
+            got[req_id] = payload
+            if len(got) >= 100:
+                done.set()
+
+        cli = fl.Client("127.0.0.1", srv.port, on_reply)
+        for i in range(1, 101):
+            cli.call(i, b"x%d" % i)
+        assert done.wait(10)
+        assert got[1] == b"Ix1" and got[2] == b"Dx2"
+        cli.close()
+        srv.stop()
+
+    def test_disconnect_signals_req_id_zero(self):
+        fl = load_fastloop()
+        srv = fl.Server(lambda c, r, p: b"ok")
+        srv.start()
+        sig = threading.Event()
+        seen = []
+
+        def on_reply(req_id, payload):
+            seen.append((req_id, payload))
+            if req_id == 0 and payload is None:
+                sig.set()
+
+        cli = fl.Client("127.0.0.1", srv.port, on_reply)
+        srv.stop()  # server side goes away underneath the client
+        assert sig.wait(10), seen
+        cli.close()
+
+    def test_handler_exception_drops_connection(self):
+        fl = load_fastloop()
+
+        def handler(conn_id, req_id, payload):
+            raise RuntimeError("boom")
+
+        srv = fl.Server(handler)
+        srv.start()
+        sig = threading.Event()
+
+        def on_reply(req_id, payload):
+            if req_id == 0 and payload is None:
+                sig.set()
+
+        cli = fl.Client("127.0.0.1", srv.port, on_reply)
+        cli.call(1, b"x")
+        assert sig.wait(10), "connection should drop on handler error"
+        cli.close()
+        srv.stop()
+
+    def test_send_reply_to_dead_conn_returns_false(self):
+        fl = load_fastloop()
+        holder = {}
+
+        def handler(conn_id, req_id, payload):
+            holder["conn"] = conn_id
+            return b"ok"
+
+        srv = fl.Server(handler)
+        srv.start()
+        got = threading.Event()
+        cli = fl.Client("127.0.0.1", srv.port,
+                        lambda r, p: got.set())
+        cli.call(1, b"x")
+        assert got.wait(10)
+        cli.close()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if not srv.send_reply(holder["conn"], 9, b"late"):
+                break
+            time.sleep(0.05)
+        assert not srv.send_reply(holder["conn"], 9, b"late")
+        srv.stop()
+
+    def test_large_payload_roundtrip(self):
+        fl = load_fastloop()
+        srv = fl.Server(lambda c, r, p: p)
+        srv.start()
+        got, done = {}, threading.Event()
+
+        def on_reply(req_id, payload):
+            got[req_id] = payload
+            done.set()
+
+        cli = fl.Client("127.0.0.1", srv.port, on_reply)
+        blob = b"z" * (4 << 20)
+        cli.call(7, blob)
+        assert done.wait(20)
+        assert got[7] == blob
+        cli.close()
+        srv.stop()
+
+
+class TestActorIntegration:
+    def test_fast_channel_engages_and_is_exact(self, ray_cluster):
+        ray, _ = ray_cluster
+
+        @ray.remote
+        class Counter:
+            def __init__(self):
+                self.x = 0
+
+            def incr(self, n=1):
+                self.x += n
+                return self.x
+
+        c = Counter.remote()
+        assert ray.get(c.incr.remote()) == 1
+        vals = ray.get([c.incr.remote() for _ in range(300)])
+        assert vals == list(range(2, 302))
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        sub = list(CoreWorker._current._actor_submitters.values())[0]
+        assert sub._fast is not None, "fast channel did not engage"
+
+    def test_mixed_fast_slow_ordering(self, ray_cluster):
+        """ObjectRef args force the slow path; interleaving them with
+        fast-path calls must preserve per-caller order (the executee's
+        gap buffer + seq gate reorder across the two sockets)."""
+        ray, _ = ray_cluster
+
+        @ray.remote
+        class Log:
+            def __init__(self):
+                self.items = []
+
+            def add(self, v):
+                self.items.append(v)
+                return len(self.items)
+
+            def get(self):
+                return self.items
+
+        log = Log.remote()
+        dep = ray.put("dep")
+        expect = []
+        for i in range(40):
+            if i % 3 == 0:
+                log.add.remote(dep)  # by-ref arg -> slow path
+                expect.append("dep")
+            else:
+                log.add.remote(i)  # fast path
+                expect.append(i)
+        assert ray.get(log.get.remote()) == expect
+
+    def test_fast_path_exceptions_surface(self, ray_cluster):
+        ray, _ = ray_cluster
+
+        @ray.remote
+        class Bomb:
+            def boom(self):
+                raise ValueError("expected-boom")
+
+            def ok(self):
+                return 42
+
+        from ray_tpu.common.status import TaskError
+
+        b = Bomb.remote()
+        with pytest.raises(TaskError, match="expected-boom"):
+            ray.get(b.boom.remote())
+        assert ray.get(b.ok.remote()) == 42
+
+    def test_kill_with_fast_inflight_fails_cleanly(self, ray_cluster):
+        ray, _ = ray_cluster
+
+        @ray.remote
+        class Slow:
+            def nap(self, s):
+                time.sleep(s)
+                return "done"
+
+        s = Slow.remote()
+        ray.get(s.nap.remote(0.0))  # ensure alive + fast channel up
+        refs = [s.nap.remote(0.5) for _ in range(4)]
+        ray.kill(s)
+        with pytest.raises(Exception):
+            ray.get(refs, timeout=30)
+
+    def test_async_actor_on_fast_channel(self, ray_cluster):
+        ray, _ = ray_cluster
+
+        @ray.remote(max_concurrency=8)
+        class Gate:
+            def __init__(self):
+                import asyncio
+
+                self.ev = asyncio.Event()
+
+            async def wait_open(self):
+                await self.ev.wait()
+                return "opened"
+
+            async def open(self):
+                self.ev.set()
+                return "ok"
+
+        g = Gate.remote()
+        waiter = g.wait_open.remote()
+        time.sleep(0.2)
+        assert ray.get(g.open.remote()) == "ok"
+        assert ray.get(waiter, timeout=10) == "opened"

@@ -156,10 +156,38 @@ class TestHandshake:
         t.start()
         old = GLOBAL_CONFIG.get("rpc_connect_timeout_s")
         GLOBAL_CONFIG.set_system_config_value("rpc_connect_timeout_s", 1.0)
+        # the degrade is rolling-upgrade-mode only: by default a silent
+        # peer is a transport failure (a wedged NEW server must keep
+        # triggering retry/rotation, not a permanent downgrade)
+        GLOBAL_CONFIG.set_system_config_value("rpc_require_hello", False)
         try:
             c = RpcClient(addr)
             assert c.call("echo", a=5, timeout=10.0) == {"a": 5}
             assert c.negotiated_protocol == 1
+            c.close()
+        finally:
+            GLOBAL_CONFIG.set_system_config_value(
+                "rpc_connect_timeout_s", old)
+            GLOBAL_CONFIG.set_system_config_value("rpc_require_hello", True)
+            sock.close()
+
+    def test_silent_peer_is_transport_failure_by_default(self):
+        """rpc_require_hello=True (default): a peer that accepts TCP but
+        never answers HELLO must raise — rotation/retry depends on it."""
+        import socket as _socket
+
+        from ray_tpu.common.config import GLOBAL_CONFIG
+        from ray_tpu.rpc.rpc import RpcError
+
+        sock = _socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        old = GLOBAL_CONFIG.get("rpc_connect_timeout_s")
+        GLOBAL_CONFIG.set_system_config_value("rpc_connect_timeout_s", 0.5)
+        try:
+            c = RpcClient(sock.getsockname())
+            with pytest.raises(RpcError, match="handshake"):
+                c.call("echo", a=1, timeout=5.0)
             c.close()
         finally:
             GLOBAL_CONFIG.set_system_config_value(
