@@ -19,6 +19,22 @@ class RtSystemError(RtError):
     """Internal invariant violation."""
 
 
+class GcsDeposedError(RtError):
+    """This GCS lost leadership (a standby promoted with a higher epoch).
+    Clients treat it as "not the leader" and rotate; see gcs/failover.py
+    for the fencing protocol."""
+
+    def __init__(self, epoch: int, new_epoch: int):
+        self.epoch = epoch
+        self.new_epoch = new_epoch
+        super().__init__(
+            f"GCS deposed: this leader's epoch {epoch} was superseded by "
+            f"epoch {new_epoch}")
+
+    def __reduce__(self):  # two-arg __init__: default reduce would break
+        return (GcsDeposedError, (self.epoch, self.new_epoch))
+
+
 class TaskError(RtError):
     """A task raised an exception; re-raised at `get` on the caller."""
 

@@ -13,8 +13,10 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.common.status import GcsDeposedError
 from ray_tpu.rpc.pubsub import Subscriber
-from ray_tpu.rpc.rpc import (RetryableRpcClient, RpcError, RpcMethodNotFound,
+from ray_tpu.rpc.rpc import (RemoteMethodError, RetryableRpcClient, RpcClient,
+                             RpcError, RpcMethodNotFound,
                              RpcRetriesExhausted)
 
 
@@ -48,10 +50,52 @@ class GcsClient:
         self._rpc = RetryableRpcClient(self.address, deadline_s=deadline)
         self._subscriber: Optional[Subscriber] = None
         self._client_id = client_id or f"client-{id(self):x}"
+        # fencing: the highest leader epoch this client has followed — a
+        # server claiming a LOWER epoch is a stale/deposed leader and is
+        # skipped during rotation (gcs/failover.py protocol)
+        self.leader_epoch_seen = 0
 
-    def _rotate(self):
+    def _judge_leader_info(self, info) -> bool:
+        """Shared verdict on a get_leader_info reply (None = probe failed:
+        dead / legacy / unpromoted standby — pass, call-level retries sort
+        those out)."""
+        if not isinstance(info, dict):
+            return True
+        if info.get("deposed"):
+            return False
+        epoch = int(info.get("epoch", 0))
+        if epoch < self.leader_epoch_seen:
+            return False
+        self.leader_epoch_seen = max(self.leader_epoch_seen, epoch)
+        return True
+
+    def _leader_acceptable(self, address) -> bool:
+        """Fencing probe (blocking — caller threads only, never the IO
+        loop; the loop path uses _leader_acceptable_async)."""
+        probe = RpcClient(address)
+        try:
+            info = probe.call("get_leader_info", timeout=5.0)
+        except Exception:  # noqa: BLE001
+            return True
+        finally:
+            probe.close()
+        return self._judge_leader_info(info)
+
+    async def _leader_acceptable_async(self, address) -> bool:
+        probe = RpcClient(address)
+        try:
+            info = await probe.call_async("get_leader_info", timeout=5.0)
+        except Exception:  # noqa: BLE001
+            return True
+        finally:
+            probe.close()
+        return self._judge_leader_info(info)
+
+    def _advance_addr(self):
         self._addr_i = (self._addr_i + 1) % len(self.addresses)
         self.address = self.addresses[self._addr_i]
+
+    def _swap_rpc(self):
         self._rpc.close()
         self._rpc = RetryableRpcClient(self.address,
                                        deadline_s=self._deadline_s)
@@ -62,6 +106,22 @@ class GcsClient:
                 pass
             self._subscriber = None
 
+    def _rotate(self):
+        for _ in range(len(self.addresses)):
+            self._advance_addr()
+            if self._leader_acceptable(self.address):
+                break
+        self._swap_rpc()
+
+    async def _rotate_async(self):
+        """IO-loop-safe rotation: the fencing probe must await, not block
+        the only event loop (raylet report loops rotate in-loop)."""
+        for _ in range(len(self.addresses)):
+            self._advance_addr()
+            if await self._leader_acceptable_async(self.address):
+                break
+        self._swap_rpc()
+
     # Rotation triggers: RpcMethodNotFound = an unpromoted standby answered
     # ("not the leader" — rotate instantly, no retry window burned);
     # RpcRetriesExhausted = the address is transport-dead.  A plain per-call
@@ -69,6 +129,11 @@ class GcsClient:
     # tearing down the subscriber over one slow call would lose pubsub state
     # for no availability gain.
     _ROTATE_ON = (RpcMethodNotFound, RpcRetriesExhausted, RpcError)
+
+    @staticmethod
+    def _deposed(e: Exception) -> bool:
+        return (isinstance(e, RemoteMethodError)
+                and isinstance(e.cause, GcsDeposedError))
 
     # -- async passthrough for in-loop callers --
     async def call_async(self, method: str, **kwargs):
@@ -80,7 +145,12 @@ class GcsClient:
                 last = e
                 if len(self.addresses) == 1:
                     raise
-                self._rotate()
+                await self._rotate_async()
+            except RemoteMethodError as e:
+                if not self._deposed(e) or len(self.addresses) == 1:
+                    raise
+                last = e
+                await self._rotate_async()
         raise last  # type: ignore[misc]
 
     def call(self, method: str, **kwargs):
@@ -92,6 +162,11 @@ class GcsClient:
                 last = e
                 if len(self.addresses) == 1:
                     raise
+                self._rotate()
+            except RemoteMethodError as e:
+                if not self._deposed(e) or len(self.addresses) == 1:
+                    raise
+                last = e
                 self._rotate()
         raise last  # type: ignore[misc]
 

@@ -58,7 +58,13 @@ class GcsStandby:
         self._failure_threshold = failure_threshold
         self._offset = 0
         self._generation: Optional[int] = None
+        self._primary_epoch = 1  # last leader epoch seen in the log stream
+        self._ever_synced = False  # at least one successful poll
+        self.leader_epoch: Optional[int] = None  # set at promotion
         self._failures = 0
+        # test hook: simulate a standby↔primary partition (polls fail while
+        # the primary stays up and reachable by everyone else)
+        self._testing_drop_polls = False
         self._stop = threading.Event()
         self.promoted = threading.Event()
         self.server = None  # the promoted GcsServer
@@ -93,10 +99,14 @@ class GcsStandby:
         try:
             while not self._stop.is_set():
                 try:
+                    if self._testing_drop_polls:
+                        raise ConnectionError("testing: partition injected")
                     chunk = client.call("fetch_table_log", timeout=5.0,
                                         offset=self._offset,
                                         generation=self._generation)
                     self._failures = 0
+                    self._ever_synced = True
+                    self._primary_epoch = int(chunk.get("epoch", 1))
                     if chunk.get("unsupported"):
                         logger.warning(
                             "primary GCS has no persistence; standby can "
@@ -122,6 +132,18 @@ class GcsStandby:
                     logger.info("standby: primary probe failed (%d/%d)",
                                 self._failures, self._failure_threshold)
                     if self._failures >= self._failure_threshold:
+                        if not self._ever_synced:
+                            # Never reached the primary at all: we hold no
+                            # state and no epoch — promoting would serve an
+                            # empty control plane and could mint an epoch
+                            # BELOW the real leader's, inverting the fence.
+                            # Keep trying instead.
+                            logger.warning(
+                                "standby: primary unreachable since boot; "
+                                "refusing to promote without ever syncing")
+                            self._failures = 0
+                            self._stop.wait(self._poll_interval_s)
+                            continue
                         log.close()
                         self._promote()
                         return
@@ -136,8 +158,10 @@ class GcsStandby:
         from ray_tpu.gcs.server import GcsServer
 
         host, port = self.address
-        logger.warning("standby promoting to GCS leader on %s:%d (replica "
-                       "log: %d bytes)", host, port, self._offset)
+        self.leader_epoch = self._primary_epoch + 1
+        logger.warning("standby promoting to GCS leader on %s:%d epoch %d "
+                       "(replica log: %d bytes)", host, port,
+                       self.leader_epoch, self._offset)
         # free the pinned port, then boot the real control plane on it
         self._placeholder.stop()
         deadline = time.monotonic() + 30.0
@@ -145,7 +169,8 @@ class GcsStandby:
         while time.monotonic() < deadline:
             try:
                 self.server = GcsServer(host, port,
-                                        persist_dir=self.replica_dir)
+                                        persist_dir=self.replica_dir,
+                                        leader_epoch=self.leader_epoch)
                 self.server.start()
                 break
             except OSError as e:  # port not yet released
@@ -155,6 +180,31 @@ class GcsStandby:
             raise RuntimeError(
                 f"standby could not bind {host}:{port}: {last}")
         self.promoted.set()
+        # Fencing: keep telling the old primary it is deposed until the
+        # message lands (it may be alive but partitioned — the exact
+        # split-brain case; when the partition heals, this or a raylet
+        # report stamped with the new epoch fences it).
+        threading.Thread(target=self._fence_old_primary, daemon=True,
+                         name="gcs-fence").start()
+
+    def _fence_old_primary(self):
+        client = RetryableRpcClient(self.primary_address, deadline_s=2.0)
+        try:
+            while not self._stop.is_set():
+                if self._testing_drop_polls:  # simulated partition covers
+                    self._stop.wait(0.2)      # the fence path too
+                    continue
+                try:
+                    if client.call("step_down", timeout=5.0,
+                                   epoch=self.leader_epoch):
+                        logger.info("old primary %s acknowledged step-down",
+                                    self.primary_address)
+                        return
+                except Exception:  # noqa: BLE001 — still partitioned/dead
+                    pass
+                self._stop.wait(2.0)
+        finally:
+            client.close()
 
     def stop(self):
         self._stop.set()

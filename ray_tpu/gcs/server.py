@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -179,9 +180,63 @@ class RayletHandle:
 class GcsServer:
     """All managers in one process, handlers on one event loop."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: Optional[str] = None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_dir: Optional[str] = None,
+                 leader_epoch: Optional[int] = None):
         from .kv import InternalKV
         from .storage import GcsTableStorage
+
+        # Leadership fencing (gcs/failover.py): every leader incarnation
+        # carries a monotonically-increasing epoch, persisted next to the
+        # table log.  A promoted standby mints epoch+1; a primary that
+        # learns of a higher epoch (step_down RPC, or a raylet report
+        # stamped with one) deposes itself instead of split-braining.
+        # Reference contract: GCS restart + NotifyGCSRestart
+        # (src/ray/protobuf/node_manager.proto RequestResourceReport path).
+        self._epoch_path = (os.path.join(persist_dir, "leader_epoch")
+                            if persist_dir else None)
+        if leader_epoch is not None:
+            self.leader_epoch = int(leader_epoch)
+        else:
+            self.leader_epoch = 1
+            if self._epoch_path and os.path.exists(self._epoch_path):
+                try:
+                    with open(self._epoch_path) as f:
+                        self.leader_epoch = int(f.read().strip() or 1)
+                except (OSError, ValueError):
+                    pass
+        if self._epoch_path:
+            try:
+                os.makedirs(persist_dir, exist_ok=True)
+                with open(self._epoch_path, "w") as f:
+                    f.write(str(self.leader_epoch))
+            except OSError:
+                logger.exception("could not persist leader epoch")
+        self.deposed = False
+        self._deposed_by: Optional[int] = None
+        # Deposition survives restarts: a supervisor-restarted old leader
+        # must come back FENCED, not as a fresh epoch-N claimant (operator
+        # remediation = remove the marker file after reconciling).
+        self._deposed_path = (os.path.join(persist_dir, "deposed_by")
+                              if persist_dir else None)
+        if (leader_epoch is not None and self._deposed_path
+                and os.path.exists(self._deposed_path)):
+            try:  # explicit promotion supersedes any stale marker
+                os.unlink(self._deposed_path)
+            except OSError:
+                pass
+        if (leader_epoch is None and self._deposed_path
+                and os.path.exists(self._deposed_path)):
+            try:
+                with open(self._deposed_path) as f:
+                    self._deposed_by = int(f.read().strip())
+                self.deposed = True
+                logger.warning(
+                    "GCS booting DEPOSED (epoch %d superseded by %d); "
+                    "remove %s to force-reclaim leadership",
+                    self.leader_epoch, self._deposed_by, self._deposed_path)
+            except (OSError, ValueError):
+                pass
 
         self.server = RpcServer(host, port)
         self.publisher = Publisher()
@@ -288,8 +343,28 @@ class GcsServer:
             "add_task_events", "get_task_events",
             "get_system_config", "health_check", "debug_state",
             "publish_worker_log", "fetch_table_log",
+            "get_leader_info", "step_down",
         ):
-            s.register(name, getattr(self, f"h_{name}"))
+            s.register(name, self._fenced(name, getattr(self, f"h_{name}")))
+
+    # methods still answered after deposition: discovery/fencing plus the
+    # log tail (harmless reads a late standby may still be draining)
+    _DEPOSED_OK = frozenset({"get_leader_info", "step_down", "health_check",
+                             "fetch_table_log", "standby_info"})
+
+    def _fenced(self, name: str, handler):
+        if name in self._DEPOSED_OK:
+            return handler
+
+        async def guarded(**kwargs):
+            if self.deposed:
+                from ray_tpu.common.status import GcsDeposedError
+
+                raise GcsDeposedError(self.leader_epoch,
+                                      self._deposed_by or 0)
+            return await handler(**kwargs)
+
+        return guarded
 
     def attach_export_logger(self, session_dir: str) -> None:
         """Start writing structured export events (actor/node/job/PG
@@ -460,7 +535,15 @@ class GcsServer:
 
     async def h_report_resources(self, node_id: bytes, snapshot: dict, seq: int,
                                  pending: Optional[List[dict]] = None,
-                                 stats: Optional[dict] = None):
+                                 stats: Optional[dict] = None,
+                                 leader_epoch: Optional[int] = None):
+        if leader_epoch is not None and int(leader_epoch) > self.leader_epoch:
+            # the raylet has already followed a newer leader: fence ourselves
+            # even if the promoted standby's step_down never reached us
+            await self.h_step_down(epoch=int(leader_epoch))
+            from ray_tpu.common.status import GcsDeposedError
+
+            raise GcsDeposedError(self.leader_epoch, int(leader_epoch))
         nid = NodeID(node_id)
         entry = self.view.get(nid)
         if entry is None:
@@ -532,6 +615,11 @@ class GcsServer:
         await asyncio.sleep(GLOBAL_CONFIG.get("health_check_initial_delay_ms") / 1000.0)
         misses: Dict[NodeID, int] = {}
         while not self._stopped:
+            if self.deposed:
+                # fenced: a deposed leader must stop COMMANDING the
+                # cluster too (declaring nodes dead, rescheduling actors)
+                await asyncio.sleep(period)
+                continue
             for entry in list(self.view.alive_nodes()):
                 handle = self._raylets.get(entry.node_id)
                 if handle is None:
@@ -616,6 +704,8 @@ class GcsServer:
         clients: Dict[JobID, RpcClient] = {}
         while not self._stopped:
             await asyncio.sleep(period)
+            if self.deposed:
+                continue  # fenced: no job teardown from a zombie leader
             for jid, rec in list(self._jobs.items()):
                 if rec.state != "RUNNING" or not rec.driver_address:
                     c = clients.pop(jid, None)
@@ -999,7 +1089,29 @@ class GcsServer:
         return GLOBAL_CONFIG.system_config_json()
 
     async def h_health_check(self):
-        return True
+        return not self.deposed
+
+    async def h_get_leader_info(self):
+        return {"epoch": self.leader_epoch, "deposed": self.deposed}
+
+    async def h_step_down(self, epoch: int):
+        """Fencing: a promoted standby (or anyone relaying its epoch)
+        tells this leader a higher incarnation exists."""
+        if int(epoch) > self.leader_epoch and not self.deposed:
+            self.deposed = True
+            self._deposed_by = int(epoch)
+            if self._deposed_path:
+                try:
+                    with open(self._deposed_path, "w") as f:
+                        f.write(str(self._deposed_by))
+                except OSError:
+                    logger.exception("could not persist deposition")
+            logger.warning(
+                "GCS stepping down: epoch %d superseded by %d — this "
+                "instance now rejects all control-plane calls",
+                self.leader_epoch, epoch)
+            return True
+        return self.deposed
 
     async def h_fetch_table_log(self, offset: int = 0,
                                 generation: Optional[int] = None,
@@ -1008,11 +1120,15 @@ class GcsServer:
         Reference role: Redis replication under the reference's
         redis_store_client.h-backed GCS FT."""
         if self.storage is None:
-            return {"unsupported": True}
-        return self.storage.read_chunk(offset, generation, max_bytes)
+            return {"unsupported": True, "epoch": self.leader_epoch}
+        reply = self.storage.read_chunk(offset, generation, max_bytes)
+        reply["epoch"] = self.leader_epoch  # standby mints epoch+1 on promotion
+        return reply
 
     def _kick_pending(self):
         """Retry pending actors/PGs (resources may have freed up)."""
+        if self.deposed:
+            return  # fenced: no scheduling commands from a zombie leader
         if not self._pending_actor_queue and not self._pending_pg_queue:
             return
 

@@ -432,11 +432,19 @@ class Raylet:
         while not self._stopped:
             self._seq += 1
             try:
+                # fencing relay: once this raylet has followed a promoted
+                # leader, its reports carry that epoch so a stale primary
+                # deposes itself (gcs/failover.py).  The kwarg is omitted
+                # entirely until then — a pre-fencing GCS would reject the
+                # unknown keyword (its handler signature predates it).
+                fencing = ({"leader_epoch": self.gcs.leader_epoch_seen}
+                           if self.gcs.leader_epoch_seen else {})
                 reply = await self.gcs.call_async(
                     "report_resources",
                     node_id=self.node_id.binary(),
                     snapshot=self.resources.snapshot(),
                     seq=self._seq,
+                    **fencing,
                     # queued lease demands feed the autoscaler's bin-packing
                     # (reference: SchedulerResourceReporter → autoscaler
                     # state, gcs_autoscaler_state_manager)

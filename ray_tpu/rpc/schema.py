@@ -154,6 +154,12 @@ RPC_SCHEMAS: Dict[str, Message] = {
                          req("actor_id", bytes), req("job_id", bytes),
                          opt("name", str), opt("namespace", str),
                          opt("max_restarts", int)),
+    "report_resources": _m("report_resources", req("node_id", bytes),
+                           req("snapshot", dict), req("seq", int),
+                           opt("pending", list), opt("stats", dict),
+                           # leadership-fencing relay (gcs/failover.py)
+                           Field("leader_epoch", int, required=False,
+                                 since=2)),
     "report_actor_state": _m("report_actor_state", req("actor_id", bytes),
                              req("state", str), opt("worker_id", bytes),
                              opt("address", (tuple, list)),

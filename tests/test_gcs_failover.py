@@ -175,6 +175,125 @@ def test_raylet_rejoins_promoted_standby(tmp_path, monkeypatch):
             "gcs_restart_reconcile_delay_s", 2.0)
 
 
+def test_split_brain_fenced_by_epoch(primary, tmp_path):
+    """THE fencing case: the standby loses sight of a primary that is
+    still alive and reachable by clients.  Without fencing this is two
+    leaders.  With it: the promoted standby mints epoch+1, keeps
+    notifying the old primary, the old primary deposes itself the moment
+    the 'partition' heals, and clients end up on exactly one leader."""
+    c = GcsClient(primary.address)
+    c.kv_put("ns", b"k", b"v")
+    assert primary.leader_epoch == 1
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    try:
+        _wait(lambda: sb._offset > 0, msg="replication")
+        # partition: standby can't see the primary; primary stays healthy
+        sb._testing_drop_polls = True
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        assert sb.leader_epoch == 2
+        # partition heals: the fencing notifier reaches the old primary
+        sb._testing_drop_polls = False
+        _wait(lambda: primary.deposed, timeout=30.0, msg="step-down")
+        # the deposed primary rejects control-plane calls...
+        from ray_tpu.rpc.rpc import RetryableRpcClient, RemoteMethodError
+
+        probe = RetryableRpcClient(primary.address, deadline_s=5.0)
+        with pytest.raises(RemoteMethodError, match="deposed"):
+            probe.call("kv_get", namespace="ns", key=b"k", timeout=10.0)
+        info = probe.call("get_leader_info", timeout=10.0)
+        assert info["deposed"] and info["epoch"] == 1
+        probe.close()
+        # ...and a rotating client converges on the one real leader
+        c2 = GcsClient(primary.address, standby_addresses=[sb.address])
+        assert c2.kv_get("ns", b"k") == b"v"
+        assert c2.address == sb.address
+        assert c2.leader_epoch_seen == 2
+        c2.close()
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_client_rejects_stale_lower_epoch_leader(primary, tmp_path):
+    """A client that has followed epoch N skips a reachable leader still
+    claiming epoch N-1 during rotation (raylets must never re-register
+    with a zombie primary)."""
+    c = GcsClient(primary.address)
+    c.kv_put("ns", b"x", b"1")
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.1, failure_threshold=3).start()
+    try:
+        _wait(lambda: sb._offset > 0, msg="replication")
+        sb._testing_drop_polls = True
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        # client with BOTH addresses, currently on the new leader
+        c2 = GcsClient(sb.address, standby_addresses=[primary.address])
+        assert c2.kv_get("ns", b"x") == b"1"
+        assert c2._leader_acceptable(sb.address)
+        assert c2.leader_epoch_seen == 2
+        # the old primary (alive, not yet deposed) is rejected outright
+        assert not c2._leader_acceptable(primary.address)
+        c2.close()
+    finally:
+        sb.stop()
+        c.close()
+
+
+def test_epoch_persists_across_restart(tmp_path):
+    """Leader epoch survives a GCS restart from the same persist dir —
+    a restarted old leader must not come back pretending epoch 1... and a
+    promoted standby's epoch survives ITS restarts too."""
+    d = str(tmp_path / "p")
+    srv = GcsServer(persist_dir=d, leader_epoch=7)
+    srv.start()
+    srv.stop()
+    srv2 = GcsServer(persist_dir=d)
+    try:
+        assert srv2.leader_epoch == 7
+    finally:
+        srv2.stop()
+
+
+def test_deposition_survives_restart(tmp_path):
+    """A supervisor-restarted old leader must come back FENCED — its
+    in-memory deposed flag is backed by a marker file in persist_dir."""
+    import asyncio
+
+    d = str(tmp_path / "p")
+    srv = GcsServer(persist_dir=d)
+    srv.start()
+    try:
+        assert asyncio.run(srv.h_step_down(epoch=5)) is True
+        assert srv.deposed
+    finally:
+        srv.stop()
+    back = GcsServer(persist_dir=d)
+    try:
+        assert back.deposed and back._deposed_by == 5
+    finally:
+        back.stop()
+    # explicit promotion into the same dir supersedes the stale marker
+    promoted = GcsServer(persist_dir=d, leader_epoch=6)
+    try:
+        assert not promoted.deposed and promoted.leader_epoch == 6
+    finally:
+        promoted.stop()
+
+
+def test_never_synced_standby_refuses_promotion(tmp_path):
+    """A standby that has NEVER reached the primary holds no state and no
+    epoch — promoting would serve an empty control plane (and could mint
+    an epoch below the real leader's).  It must keep retrying instead."""
+    sb = GcsStandby(("127.0.0.1", 1), str(tmp_path / "replica"),
+                    poll_interval_s=0.05, failure_threshold=2).start()
+    try:
+        time.sleep(2.0)  # many threshold-crossings worth of failures
+        assert not sb.promoted.is_set()
+    finally:
+        sb.stop()
+
+
 def test_compaction_restarts_replication(primary, tmp_path):
     """When the primary compacts its log, the standby restarts the
     stream from offset 0 of the new generation instead of appending
