@@ -129,6 +129,10 @@ class Dashboard:
         r("GET", "/api/metrics", self._metrics)
         r("GET", "/api/metrics/history", self._metrics_history)
         r("GET", "/api/serve", self._serve_status)
+        # declarative deploy (reference: PUT /api/serve/applications/ on
+        # the dashboard agent + serve/schema.py ServeDeploySchema)
+        r("PUT", "/api/serve/applications", self._serve_apply)
+        r("GET", "/api/serve/applications", self._serve_declared)
         # Prometheus HTTP service discovery (reference:
         # dashboard/modules/metrics prometheus config); point
         # `http_sd_configs` here and every scrape target is enumerated
@@ -202,6 +206,44 @@ class Dashboard:
         if not raw:
             return {"apps": {}, "updated_at": None}
         return _json.loads(raw)
+
+    async def _serve_apply(self, req: HttpRequest):
+        """PUT a declarative app spec: validated, then persisted in the
+        GCS KV where the Serve controller reconciles onto it.  A spec PUT
+        before Serve starts applies when it does (the KV outlives every
+        Serve component)."""
+        import json as _json
+
+        from ray_tpu.serve import schema
+        from ray_tpu.util.http import HttpResponse
+
+        try:
+            doc = schema.make_config_doc(req.json())
+        except (schema.ServeConfigError, ValueError) as e:
+            # ValueError covers a non-JSON body (json.JSONDecodeError):
+            # both are client errors, not server faults
+            return HttpResponse({"error": str(e)}, 400)
+        await self._gcs.call_async(
+            "kv_put", namespace=schema.KV_NAMESPACE,
+            key=schema.KV_CONFIG_KEY,
+            value=_json.dumps(doc).encode(), overwrite=True)
+        return {"ok": True, "version": doc["version"]}
+
+    async def _serve_declared(self, _req: HttpRequest):
+        """GET the declared spec + the controller's last apply status +
+        live app table."""
+        import json as _json
+
+        from ray_tpu.serve import schema
+
+        out = {}
+        for field, key in (("config", schema.KV_CONFIG_KEY),
+                           ("apply_status", schema.KV_APPLY_STATUS_KEY),
+                           ("live", b"status")):
+            raw = await self._gcs.call_async(
+                "kv_get", namespace=schema.KV_NAMESPACE, key=key)
+            out[field] = _json.loads(raw) if raw else None
+        return out
 
     async def _prometheus_sd(self, _req: HttpRequest):
         host, port = self._http.address

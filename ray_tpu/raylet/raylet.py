@@ -250,6 +250,30 @@ class Raylet:
             self._io.spawn_threadsafe(prestart())
         logger.info("raylet %s serving at %s", self.node_id.hex()[:8], self.server.address)
 
+    def _replenish_pool(self):
+        """Keep ``num_prestart_workers`` warm default-env workers forked in
+        the BACKGROUND: sustained actor churn then pipelines interpreter
+        forks behind control-plane work instead of paying them on every
+        creation's critical path (reference: worker_pool.cc
+        PrestartWorkers on demand-prediction)."""
+        target = GLOBAL_CONFIG.get("num_prestart_workers")
+        if target <= 0 or self._stopped:
+            return
+        warm = sum(1 for w in self._workers.values()
+                   if w.env_key is None
+                   and (w.state == "STARTING"  # pid may not be known yet
+                        or (w.state == "IDLE" and w.alive())))
+        if warm >= target:
+            return
+
+        async def refill():
+            try:
+                await self._start_worker()
+            except Exception:  # noqa: BLE001 — warm pool is best-effort
+                logger.debug("pool replenish failed", exc_info=True)
+
+        self._io.spawn_threadsafe(refill())
+
     def _start_factory(self):
         """Boot the forkserver worker factory (worker_factory.py): one warm
         interpreter whose forks cut worker creation from interpreter-boot
@@ -932,6 +956,10 @@ class Raylet:
         w.request = request
         w.assignment = assignment
         w.actor_id = spec.actor_id.binary()
+        # the actor consumed a warm worker for good (actor workers die with
+        # their actor — state isolation, as in the reference); refill the
+        # pool off the critical path so the NEXT creation finds one warm
+        self._replenish_pool()
         tpu_chips = (assignment or {}).get(TPU)
         try:
             c = RetryableRpcClient(w.address, deadline_s=30.0)

@@ -244,6 +244,64 @@ def cmd_job(args) -> int:
     return 2
 
 
+def cmd_serve(args) -> int:
+    """Declarative Serve ops against a running cluster (reference:
+    ``serve deploy`` / ``serve status`` CLI over the agent REST)."""
+    from ray_tpu.gcs.client import GcsClient
+    from ray_tpu.serve import schema
+
+    host, _, port = args.address.partition(":")
+    gcs = GcsClient((host, int(port)))
+    try:
+        if args.serve_cmd == "deploy":
+            with open(args.config_file) as f:
+                text = f.read()
+            config = None
+            try:
+                import yaml
+
+                config = yaml.safe_load(text)
+            except ImportError:
+                try:
+                    config = json.loads(text)
+                except json.JSONDecodeError:
+                    print("error: config is not JSON and PyYAML is not "
+                          "installed to parse YAML", file=sys.stderr)
+                    return 2
+            except Exception as e:  # noqa: BLE001 — yaml syntax error
+                print(f"error: could not parse {args.config_file}: {e}",
+                      file=sys.stderr)
+                return 2
+            try:
+                doc = schema.make_config_doc(config)
+            except schema.ServeConfigError as e:
+                print(f"error: invalid config: {e}", file=sys.stderr)
+                return 2
+            gcs.kv_put(schema.KV_NAMESPACE, schema.KV_CONFIG_KEY,
+                       json.dumps(doc).encode(), overwrite=True)
+            print(json.dumps({
+                "ok": True, "version": doc["version"],
+                "applications": [a["name"] for a in
+                                 doc["config"]["applications"]]}))
+            return 0
+        if args.serve_cmd == "status":
+            out = {}
+            for field, key in (("apply_status",
+                                schema.KV_APPLY_STATUS_KEY),
+                               ("live", b"status")):
+                raw = gcs.kv_get(schema.KV_NAMESPACE, key)
+                out[field] = json.loads(raw) if raw else None
+            print(json.dumps(out, indent=2))
+            return 0
+        if args.serve_cmd == "config":
+            raw = gcs.kv_get(schema.KV_NAMESPACE, schema.KV_CONFIG_KEY)
+            print(json.dumps(json.loads(raw) if raw else None, indent=2))
+            return 0
+    finally:
+        gcs.close()
+    return 2
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -285,6 +343,17 @@ def main(argv=None) -> int:
     pm.add_argument("--dashboard-url", default="http://127.0.0.1:8265")
     pm.add_argument("--prometheus-url", default="http://127.0.0.1:9090")
     pm.set_defaults(fn=cmd_metrics_config)
+
+    psv = sub.add_parser("serve", help="declarative Serve deploy/status")
+    svsub = psv.add_subparsers(dest="serve_cmd", required=True)
+    svd = svsub.add_parser("deploy", help="apply an app spec (yaml/json)")
+    svd.add_argument("config_file")
+    svst = svsub.add_parser("status", help="apply status + live app table")
+    svcf = svsub.add_parser("config", help="show the declared spec")
+    for leaf in (svd, svst, svcf):
+        leaf.add_argument("--address", default="127.0.0.1:6379",
+                          help="GCS address host:port")
+    psv.set_defaults(fn=cmd_serve)
 
     pj = sub.add_parser("job", help="job submission commands")
     pj.add_argument("job_cmd",

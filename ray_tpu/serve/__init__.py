@@ -11,7 +11,9 @@ decode step instead of delegating to vLLM.
 
 from ray_tpu.serve.api import (  # noqa: F401
     delete,
+    deploy_config,
     deployment,
+    get_declarative_config,
     get_deployment_handle,
     proxy_address,
     run,

@@ -29,8 +29,13 @@ def _get_or_create_controller():
             _controller = ray_tpu.get_actor(CONTROLLER_NAME)
         except Exception:  # noqa: BLE001 — not started yet
             remote_cls = ray_tpu.remote(ServeController)
+            # infinite restarts: a crashed controller comes back and
+            # re-applies the declarative spec persisted in the GCS KV
+            # (schema.py) — programmatic-only apps die with it, as in the
+            # reference without a checkpointed config
             _controller = remote_cls.options(
-                name=CONTROLLER_NAME, max_concurrency=16).remote()
+                name=CONTROLLER_NAME, max_concurrency=16,
+                max_restarts=-1).remote()
         return _controller
 
 
@@ -131,6 +136,68 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
         if _proxy_addr is None and addr.get("http_port"):
             _proxy_addr = addr
     return dict(addr)
+
+
+def deploy_config(config: Optional[Dict[str, Any]] = None, *,
+                  app=None, name: str = "default",
+                  wait: bool = True, timeout_s: float = 120.0
+                  ) -> Dict[str, Any]:
+    """Declarative deploy (reference: ``serve deploy`` + ``PUT
+    /api/serve/applications/``): persist a validated app spec in the GCS
+    KV; the controller reconciles running apps onto it — across its own
+    restarts.  Pass either a full config dict (see serve/schema.py) or a
+    bound ``app`` (cloudpickled into the spec for un-importable apps).
+    Returns the apply status."""
+    import json
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.core_worker.worker import CoreWorker
+    from ray_tpu.serve import schema
+
+    if (config is None) == (app is None):
+        raise ValueError("pass exactly one of config / app")
+    if app is not None:
+        config = {"applications": [
+            {"name": name, "pickled_app": schema.pack_application(app)}]}
+    doc = schema.make_config_doc(config)
+    _get_or_create_controller()  # controller watches the KV key
+    gcs = CoreWorker.current_or_raise().gcs
+    gcs.kv_put(schema.KV_NAMESPACE, schema.KV_CONFIG_KEY,
+               json.dumps(doc).encode(), overwrite=True)
+    if not wait:
+        return {"version": doc["version"], "apps": {}}
+    deadline = _time.monotonic() + timeout_s
+    want = {a["name"] for a in doc["config"]["applications"]}
+    while _time.monotonic() < deadline:
+        raw = gcs.kv_get(schema.KV_NAMESPACE, schema.KV_APPLY_STATUS_KEY)
+        if raw:
+            st = json.loads(raw)
+            if st.get("version") == doc["version"]:
+                failed = {n: s for n, s in st["apps"].items()
+                          if s.get("state") == "DEPLOY_FAILED"}
+                if failed:
+                    raise RuntimeError(f"declarative deploy failed: {failed}")
+                live = ray_tpu.get(
+                    [_get_or_create_controller().status.remote()])[0]
+                if all(live.get(n, {}).get("running_replicas", 0) > 0
+                       for n in want):
+                    return st
+        _time.sleep(0.2)
+    raise TimeoutError("declarative deploy did not converge "
+                       f"within {timeout_s:.0f}s")
+
+
+def get_declarative_config() -> Optional[Dict[str, Any]]:
+    """The spec currently persisted in the GCS KV (None = none)."""
+    import json
+
+    from ray_tpu.core_worker.worker import CoreWorker
+    from ray_tpu.serve import schema
+
+    raw = CoreWorker.current_or_raise().gcs.kv_get(
+        schema.KV_NAMESPACE, schema.KV_CONFIG_KEY)
+    return json.loads(raw) if raw else None
 
 
 def proxy_address() -> Optional[Dict[str, Any]]:

@@ -106,6 +106,18 @@ class ServeController:
             "rt_serve_ongoing_requests",
             "in-flight requests summed over an app's replicas",
             tag_keys=("app",))
+        # declarative mode (schema.py): version of the KV config this
+        # incarnation has applied, and the app names it owns.  Starts at
+        # None so a freshly (re)started controller re-applies whatever
+        # spec is persisted — THAT is what makes the spec survive
+        # controller crashes (reference: controller checkpoint recovery).
+        self._declarative_version = None
+        self._declarative_apps: set = set()
+        self._declarative_hashes: Dict[str, str] = {}
+        # transiently-failed app deploys are retried (the spec still
+        # declares them) — with a floor between attempts so a persistent
+        # import error doesn't spam every reconcile tick
+        self._declarative_retry_at = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
@@ -213,11 +225,88 @@ class ServeController:
     def _reconcile_loop(self):
         while not self._stop.is_set():
             try:
+                self._check_declarative()
+            except Exception:  # noqa: BLE001 — bad spec must not stop
+                logger.error("declarative apply error:\n%s",
+                             traceback.format_exc())
+            try:
                 self._reconcile_once()
                 self._publish_status()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 logger.error("reconcile error:\n%s", traceback.format_exc())
             self._stop.wait(self.RECONCILE_INTERVAL_S)
+
+    # ---------------------------------------------------- declarative mode
+    def _check_declarative(self):
+        """Converge running apps onto the spec persisted in the GCS KV
+        (serve/schema.py).  Runs every reconcile tick; cheap no-op while
+        the version is unchanged."""
+        import json
+
+        from ray_tpu.core_worker.worker import CoreWorker
+        from ray_tpu.serve import schema
+
+        try:
+            gcs = CoreWorker.current_or_raise().gcs
+            raw = gcs.kv_get(schema.KV_NAMESPACE, schema.KV_CONFIG_KEY)
+        except Exception:  # noqa: BLE001 — GCS hiccup: retry next tick
+            return
+        if not raw:
+            return
+        doc = json.loads(raw)
+        version = doc.get("version")
+        if version == self._declarative_version:
+            return
+        if time.monotonic() < self._declarative_retry_at:
+            return  # backing off after a failed apply of this version
+        status: dict = {"version": version, "apps": {}}
+        config = schema.validate_config(doc.get("config") or {})
+        import ray_tpu
+        from ray_tpu.serve.api import _deploy_tree
+
+        own_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        wanted = set()
+        for entry in config["applications"]:
+            name = entry["name"]
+            wanted.add(name)
+            # unchanged entries keep their running replicas: a config bump
+            # that only touches app B must not drain-and-replace app A
+            entry_hash = json.dumps(entry, sort_keys=True)
+            if (self._declarative_hashes.get(name) == entry_hash
+                    and name in self._apps):
+                status["apps"][name] = {"state": "UNCHANGED"}
+                continue
+            try:
+                app = schema.resolve_application(entry)
+                schema.apply_overrides(app, entry)
+                _deploy_tree(app, own_handle, {}, name=name)
+                self._declarative_hashes[name] = entry_hash
+                status["apps"][name] = {"state": "DEPLOYED"}
+            except Exception as e:  # noqa: BLE001 — per-app isolation:
+                # one bad import must not block the other apps
+                logger.error("declarative deploy of %r failed:\n%s",
+                             name, traceback.format_exc())
+                status["apps"][name] = {"state": "DEPLOY_FAILED",
+                                        "error": repr(e)}
+        # apps this controller previously declared but the new spec drops
+        for gone in self._declarative_apps - wanted:
+            self.delete_app(gone)
+            self._declarative_hashes.pop(gone, None)
+            status["apps"][gone] = {"state": "DELETED"}
+        self._declarative_apps = wanted
+        failed = any(s.get("state") == "DEPLOY_FAILED"
+                     for s in status["apps"].values())
+        if failed:
+            # leave the version unlatched: failed apps are re-attempted
+            # (succeeded ones skip via their entry hash) every 5s
+            self._declarative_retry_at = time.monotonic() + 5.0
+        else:
+            self._declarative_version = version
+        try:
+            gcs.kv_put(schema.KV_NAMESPACE, schema.KV_APPLY_STATUS_KEY,
+                       json.dumps(status).encode(), overwrite=True)
+        except Exception:  # noqa: BLE001 — status is best-effort
+            pass
 
     def _publish_status(self):
         """Drop the app table into GCS KV so the dashboard's Serve view
