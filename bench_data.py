@@ -1,0 +1,142 @@
+"""GB-scale shuffle proof: distributed hash-partition groupby moving
+multi-GB payloads through the shm object plane WITH SPILLING ENGAGED.
+
+Prints ONE JSON line:
+    {"metric": "groupby_shuffle_gb_per_min", "value": ..., "unit": ...,
+     "rows": {...}, "spilled_bytes": N}
+
+Reference bar: the dedicated streaming hash-shuffle operator family
+(python/ray/data/_internal/execution/operators/hash_shuffle.py) routinely
+moves >GB datasets per node; this proves the same movement (generation →
+hash shuffle → per-group aggregation) holds on this runtime at ≥2 GB with
+the store capped far below the working set, so most bytes cross the
+spill path.
+
+Usage: python bench_data.py [--gb 2.2] [--cap-mb 256]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+
+def _spilled_bytes(spill_root: str) -> int:
+    total = 0
+    # rt_spill_*: per-process memory-store spills; rtshm_spill_*: the
+    # node arena's demoted (spill-before-evict) objects
+    for pat in ("rt_spill_*", "rtshm_spill_*"):
+        for path in glob.glob(os.path.join(spill_root, pat, "*")):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+    return total
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gb", type=float, default=2.2)
+    ap.add_argument("--cap-mb", type=int, default=256)
+    ap.add_argument("--groups", type=int, default=64)
+    ap.add_argument("--inflight", type=int, default=8,
+                    help="streaming window (block chains in flight); the "
+                         "default 16 oversubscribes a 1-core box badly "
+                         "enough to thrash the spill path")
+    args = ap.parse_args()
+
+    # every process (driver + workers) spills under one measurable root
+    spill_root = f"/tmp/rt_bench_spill_{os.getpid()}"
+    os.makedirs(spill_root, exist_ok=True)
+    os.environ["RT_object_spilling_dir"] = spill_root
+    os.environ["RT_memory_store_max_bytes"] = str(args.cap_mb << 20)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rtd
+    from ray_tpu.data.context import DataContext
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    DataContext.get_current().max_inflight_blocks = args.inflight
+
+    payload = 2048
+    n_rows = int(args.gb * (1 << 30) / payload)
+    groups = args.groups
+    num_blocks = max(32, int(args.gb * 48))  # ~20 MB blocks
+
+    def attach(batch):
+        n = len(batch["id"])
+        rng = np.random.default_rng(int(batch["id"][0]))
+        batch["key"] = (batch["id"] % groups).astype(np.int64)
+        batch["val"] = batch["id"].astype(np.float64)
+        batch["payload"] = rng.integers(
+            0, 256, size=(n, payload - 16), dtype=np.uint8)
+        return batch
+
+    t0 = time.perf_counter()
+    ds = rtd.range(n_rows, num_blocks=num_blocks).map_batches(attach)
+
+    def summarize(rows):
+        total = sum(r["val"] for r in rows)
+        pay = sum(int(r["payload"][0]) for r in rows)
+        return {"key": rows[0]["key"], "n": len(rows),
+                "val_sum": total, "payload_probe": pay}
+
+    try:
+        out = ds.groupby("key").map_groups(summarize).take_all()
+    except Exception:
+        # stall forensics: what does the scheduler think is happening?
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        cw = CoreWorker._current
+        sub = cw.submitter
+        print("STALL-DUMP queues:",
+              {k[:1]: len(v) for k, v in sub._queues.items()},
+              "leases:", dict(sub._leases_in_flight),
+              "pushed:", len(sub._pushed),
+              "store entries:", len(cw.memory_store._entries),
+              "pending cbs:", len(cw.memory_store._done_callbacks),
+              file=sys.stderr)
+        raise
+    dt = time.perf_counter() - t0
+
+    n = sum(r["n"] for r in out)
+    val_sum = sum(r["val_sum"] for r in out)
+    assert n == n_rows, (n, n_rows)
+    assert abs(val_sum - n_rows * (n_rows - 1) / 2) < 1e-3 * n_rows, \
+        "shuffle lost or duplicated rows"
+    assert len(out) == groups
+
+    spilled = _spilled_bytes(spill_root)
+    moved_gb = n_rows * payload / (1 << 30)
+    result = {
+        "metric": "groupby_shuffle_gb_per_min",
+        "value": round(moved_gb / (dt / 60.0), 2),
+        "unit": "GB/min",
+        "vs_baseline": None,  # reference publishes no absolute number
+        "rows": {
+            "dataset_gb": round(moved_gb, 2),
+            "wall_s": round(dt, 1),
+            "spilled_bytes": spilled,
+            "spilled_gb": round(spilled / (1 << 30), 2),
+            "store_cap_mb": args.cap_mb,
+            "num_blocks": num_blocks,
+            "groups": groups,
+            "rows": n_rows,
+        },
+    }
+    print(json.dumps(result))
+    ray_tpu.shutdown()
+    if spilled == 0:
+        print("WARNING: no bytes spilled — cap too high for this size",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

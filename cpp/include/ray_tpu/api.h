@@ -52,6 +52,19 @@ std::string RtCreateCppActor(const std::string& cls, ValueList args,
                              const SubmitOptions* opts);
 std::string RtCreatePyActor(const std::string& mod, const std::string& cls,
                             ValueList args, const std::string& name);
+std::string RtCreatePyActorOpts(const std::string& mod,
+                                const std::string& cls, ValueList args,
+                                const std::string& name,
+                                const ValueDict& resources, int max_restarts,
+                                const std::string& pg_id, int bundle_index);
+std::string RtSubmitPyOpts(const std::string& mod, const std::string& name,
+                           ValueList args, const ValueDict& resources,
+                           const std::string& pg_id, int bundle_index);
+std::string RtCreatePg(
+    const std::vector<std::vector<std::pair<std::string, double>>>& bundles,
+    const std::string& strategy, const std::string& name);
+bool RtPgReady(const std::string& pg_id, int timeout_ms);
+void RtRemovePg(const std::string& pg_id);
 std::string RtActorCall(const std::string& actor_id, const std::string& method,
                         ValueList args);
 void RtKillActor(const std::string& actor_id);
@@ -196,6 +209,38 @@ std::vector<ObjectRef<T>> Wait(const std::vector<ObjectRef<T>>& refs,
 
 inline Value ClusterResources() { return internal::RtClusterResources(); }
 
+// ------------------------------------------------------ placement groups
+//
+// Reference parity: cpp/include/ray/api.h CreatePlacementGroup /
+// PlacementGroup::Wait / RemovePlacementGroup, scheduled into via
+// ActorCreator::SetPlacementGroup.
+class PlacementGroup {
+ public:
+  PlacementGroup() = default;
+  explicit PlacementGroup(std::string id) : id_(std::move(id)) {}
+  const std::string& Id() const { return id_; }
+  bool Valid() const { return !id_.empty(); }
+  // True when every bundle is reserved.
+  bool Wait(int timeout_ms = 60000) const {
+    return internal::RtPgReady(id_, timeout_ms);
+  }
+
+ private:
+  std::string id_;
+};
+
+// bundles: one map per bundle, e.g. {{{"CPU", 1.0}}, {{"CPU", 1.0}}}.
+// strategy: "PACK" | "SPREAD" | "STRICT_PACK" | "STRICT_SPREAD".
+inline PlacementGroup CreatePlacementGroup(
+    const std::vector<std::vector<std::pair<std::string, double>>>& bundles,
+    const std::string& strategy = "PACK", const std::string& name = "") {
+  return PlacementGroup(internal::RtCreatePg(bundles, strategy, name));
+}
+
+inline void RemovePlacementGroup(const PlacementGroup& pg) {
+  internal::RtRemovePg(pg.Id());
+}
+
 // ------------------------------------------------------- remote functions
 
 namespace internal {
@@ -294,15 +339,32 @@ class PyTaskCaller {
   PyTaskCaller(std::string module, std::string name)
       : module_(std::move(module)), name_(std::move(name)) {}
 
+  // reference parity: TaskCaller::SetResource / SetPlacementGroup
+  PyTaskCaller& SetResource(const std::string& name, double amount) {
+    resources_.emplace_back(Value::Str(name), Value::Float(amount));
+    return *this;
+  }
+  PyTaskCaller& SetPlacementGroup(const PlacementGroup& pg,
+                                  int bundle_index = 0) {
+    pg_id_ = pg.Id();
+    bundle_index_ = bundle_index;
+    return *this;
+  }
+
   template <typename... Args>
   ObjectRef<R> Remote(Args&&... args) {
     ValueList vs{ToValue(std::forward<Args>(args))...};
-    return ObjectRef<R>(
-        internal::RtSubmitPy(module_, name_, std::move(vs), nullptr));
+    if (resources_.empty() && pg_id_.empty())
+      return ObjectRef<R>(
+          internal::RtSubmitPy(module_, name_, std::move(vs), nullptr));
+    return ObjectRef<R>(internal::RtSubmitPyOpts(
+        module_, name_, std::move(vs), resources_, pg_id_, bundle_index_));
   }
 
  private:
-  std::string module_, name_;
+  std::string module_, name_, pg_id_;
+  ValueDict resources_;
+  int bundle_index_ = 0;
 };
 
 template <typename R = Value>
@@ -391,16 +453,44 @@ class PyActorCreator {
     name_ = std::move(name);
     return *this;
   }
+  // reference parity: ActorCreator::SetResource / SetMaxRestarts /
+  // SetPlacementGroup(bundle)
+  PyActorCreator& SetResource(const std::string& name, double amount) {
+    resources_.emplace_back(Value::Str(name), Value::Float(amount));
+    return *this;
+  }
+  PyActorCreator& SetMaxRestarts(int n) {
+    max_restarts_ = n;
+    return *this;
+  }
+  PyActorCreator& SetPlacementGroup(const PlacementGroup& pg,
+                                    int bundle_index = 0) {
+    pg_id_ = pg.Id();
+    bundle_index_ = bundle_index;
+    return *this;
+  }
 
   template <typename... Args>
   PyActorHandle Remote(Args&&... args);
 
  private:
-  std::string module_, qualname_, name_;
+  std::string module_, qualname_, name_, pg_id_;
+  ValueDict resources_;
+  int max_restarts_ = 0;
+  int bundle_index_ = 0;
 };
 
 inline PyActorCreator PyActor(std::string module, std::string qualname) {
   return PyActorCreator(std::move(module), std::move(qualname));
+}
+
+// Actor handles cross task boundaries as a tagged dict the Python side
+// revives into a live handle (session_main.py _revive_handles) — the
+// cross-language actor-handle-passing contract.
+inline Value ToValue(const PyActorHandle& h) {
+  ValueDict d;
+  d.emplace_back(Value::Str("__rt_actor_handle__"), Value::Bytes(h.Id()));
+  return Value::Dict(std::move(d));
 }
 
 inline PyActorHandle GetNamedActor(const std::string& name) {
@@ -424,8 +514,12 @@ inline PyActorHandle GetNamedActor(const std::string& name) {
 template <typename... Args>
 PyActorHandle PyActorCreator::Remote(Args&&... args) {
   ValueList vs{ToValue(std::forward<Args>(args))...};
-  return PyActorHandle(
-      internal::RtCreatePyActor(module_, qualname_, std::move(vs), name_));
+  if (resources_.empty() && max_restarts_ == 0 && pg_id_.empty())
+    return PyActorHandle(
+        internal::RtCreatePyActor(module_, qualname_, std::move(vs), name_));
+  return PyActorHandle(internal::RtCreatePyActorOpts(
+      module_, qualname_, std::move(vs), name_, resources_, max_restarts_,
+      pg_id_, bundle_index_));
 }
 
 }  // namespace ray_tpu

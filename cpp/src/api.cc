@@ -156,6 +156,41 @@ std::string RtCreatePyActor(const std::string& mod, const std::string& cls,
   return Rt().CreatePyActor(mod, cls, std::move(args), opts);
 }
 
+std::string RtCreatePyActorOpts(const std::string& mod, const std::string& cls,
+                                ValueList args, const std::string& name,
+                                const ValueDict& resources, int max_restarts,
+                                const std::string& pg_id, int bundle_index) {
+  SubmitOptions opts;
+  opts.name = name;
+  opts.resources = resources;
+  opts.max_restarts = max_restarts;
+  opts.placement_group = pg_id;
+  opts.bundle_index = bundle_index;
+  return Rt().CreatePyActor(mod, cls, std::move(args), opts);
+}
+
+std::string RtSubmitPyOpts(const std::string& mod, const std::string& name,
+                           ValueList args, const ValueDict& resources,
+                           const std::string& pg_id, int bundle_index) {
+  SubmitOptions opts;
+  opts.resources = resources;
+  opts.placement_group = pg_id;
+  opts.bundle_index = bundle_index;
+  return Rt().SubmitPy(mod, name, std::move(args), opts);
+}
+
+std::string RtCreatePg(
+    const std::vector<std::vector<std::pair<std::string, double>>>& bundles,
+    const std::string& strategy, const std::string& name) {
+  return Rt().CreatePlacementGroup(bundles, strategy, name);
+}
+
+bool RtPgReady(const std::string& pg_id, int timeout_ms) {
+  return Rt().PlacementGroupReady(pg_id, timeout_ms);
+}
+
+void RtRemovePg(const std::string& pg_id) { Rt().RemovePlacementGroup(pg_id); }
+
 std::string RtActorCall(const std::string& actor_id, const std::string& method,
                         ValueList args) {
   return Rt().ActorCall(actor_id, method, std::move(args), 1).at(0);

@@ -55,7 +55,15 @@ struct SubmitOptions {
   std::string name;                                  // actor name (named actors)
   ValueDict resources;                               // {"CPU": 1.0, "TPU": ...}
   int max_restarts = 0;
+  // placement-group scheduling (reference cpp: ActorCreator::
+  // SetPlacementGroup): the raw pg id from CreatePlacementGroup + the
+  // bundle the task/actor must land in
+  std::string placement_group;
+  int bundle_index = 0;
 };
+
+// One bundle = resource name -> amount (reference cpp BundleSpec).
+using Bundle = std::vector<std::pair<std::string, double>>;
 
 class Runtime {
  public:
@@ -86,6 +94,15 @@ class Runtime {
                                              ValueList args, int num_returns) = 0;
   virtual void KillActor(const std::string& actor_id) = 0;
   virtual std::string GetNamedActor(const std::string& name) = 0;
+
+  // Placement groups (reference cpp: ray::CreatePlacementGroup /
+  // PlacementGroup::Wait / RemovePlacementGroup).
+  virtual std::string CreatePlacementGroup(const std::vector<Bundle>& bundles,
+                                           const std::string& strategy,
+                                           const std::string& name) = 0;
+  virtual bool PlacementGroupReady(const std::string& pg_id,
+                                   int timeout_ms) = 0;
+  virtual void RemovePlacementGroup(const std::string& pg_id) = 0;
 
   virtual void Release(const std::vector<std::string>& ids) = 0;
   virtual Value ClusterResources() = 0;

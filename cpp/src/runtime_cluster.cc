@@ -50,6 +50,14 @@ ValueDict PackOpts(const SubmitOptions& opts) {
     d.emplace_back(Value::Str("max_restarts"), Value::Int(opts.max_restarts));
   if (!opts.resources.empty())
     d.emplace_back(Value::Str("resources"), Value::Dict(opts.resources));
+  if (!opts.placement_group.empty()) {
+    // raw pg id + bundle index; the session driver translates to the
+    // Python scheduling strategy (session_main.py _xlate_opts)
+    d.emplace_back(Value::Str("placement_group"),
+                   Value::Bytes(opts.placement_group));
+    d.emplace_back(Value::Str("bundle_index"),
+                   Value::Int(opts.bundle_index));
+  }
   return d;
 }
 
@@ -211,6 +219,39 @@ class ClusterRuntime final : public Runtime {
                             {Value::Str("namespace"), Value::None()}});
     if (raw.is_none()) throw std::runtime_error("no actor named " + name);
     return raw.as_bytes();
+  }
+
+  std::string CreatePlacementGroup(const std::vector<Bundle>& bundles,
+                                   const std::string& strategy,
+                                   const std::string& name) override {
+    ValueList bl;
+    for (const auto& b : bundles) {
+      ValueDict d;
+      for (const auto& kv : b)
+        d.emplace_back(Value::Str(kv.first), Value::Float(kv.second));
+      bl.push_back(Value::Dict(std::move(d)));
+    }
+    Value raw = session_->Call(
+        "create_placement_group",
+        {{Value::Str("bundles"), Value::List(std::move(bl))},
+         {Value::Str("strategy"), Value::Str(strategy)},
+         {Value::Str("name"),
+          name.empty() ? Value::None() : Value::Str(name)}});
+    return raw.as_bytes();
+  }
+
+  bool PlacementGroupReady(const std::string& pg_id, int timeout_ms) override {
+    Value ok = session_->Call(
+        "placement_group_ready",
+        {{Value::Str("pg_raw"), Value::Bytes(pg_id)},
+         {Value::Str("timeout_s"), Value::Float(timeout_ms / 1000.0)}},
+        timeout_ms + 10000);
+    return ok.as_bool();
+  }
+
+  void RemovePlacementGroup(const std::string& pg_id) override {
+    session_->Call("remove_placement_group",
+                   {{Value::Str("pg_raw"), Value::Bytes(pg_id)}});
   }
 
   void Release(const std::vector<std::string>& ids) override {

@@ -225,6 +225,20 @@ class LocalRuntime final : public Runtime {
     return it->second;
   }
 
+  std::string CreatePlacementGroup(const std::vector<Bundle>& bundles,
+                                   const std::string& strategy,
+                                   const std::string&) override {
+    // Local mode: one process IS the cluster — every bundle trivially
+    // fits, exactly like the reference's local-mode placement groups.
+    (void)strategy;
+    return "local-pg-" + std::to_string(next_pg_++) + "-" +
+           std::to_string(bundles.size());
+  }
+
+  bool PlacementGroupReady(const std::string&, int) override { return true; }
+
+  void RemovePlacementGroup(const std::string&) override {}
+
   void Release(const std::vector<std::string>& ids) override {
     std::lock_guard<std::mutex> lk(mu_);
     for (const auto& id : ids) objects_.erase(id);
@@ -236,6 +250,8 @@ class LocalRuntime final : public Runtime {
   }
 
  private:
+  std::atomic<uint64_t> next_pg_{0};
+
   struct ObjectSlot {
     bool ready;
     Value value;

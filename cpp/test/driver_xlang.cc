@@ -66,6 +66,33 @@ int main(int argc, char** argv) {
   auto ready = ray_tpu::Wait(refs, 4, 60000);
   CHECK(ready.size() == 4);
 
+  // ---- placement groups + options + actor-handle passing (reference:
+  // cpp/include/ray/api.h CreatePlacementGroup + SetPlacementGroup) ----
+  auto pg = ray_tpu::CreatePlacementGroup({{{"CPU", 1.0}}}, "PACK", "cpp-pg");
+  CHECK(pg.Valid());
+  CHECK(pg.Wait(60000));
+
+  // schedule an actor INTO the group, with resource options
+  auto placed = ray_tpu::PyActor("tests.xlang_helpers", "Accumulator")
+                    .SetPlacementGroup(pg, 0)
+                    .SetResource("CPU", 1.0)
+                    .SetMaxRestarts(1)
+                    .Remote(1000);
+  auto p1 = placed.Task("add").Remote<int64_t>(1);
+  CHECK(ray_tpu::Get(p1, 60000) == 1001);
+
+  // pass the actor HANDLE to a second (Python) task, which calls back
+  // through it — the revived handle must address the same actor state
+  auto poked = ray_tpu::PyTask<int64_t>("tests.xlang_helpers",
+                                        "poke_accumulator")
+                   .Remote(placed, int64_t{5});
+  CHECK(ray_tpu::Get(poked, 60000) == 1006);
+  auto after = placed.Task("total").Remote<int64_t>();
+  CHECK(ray_tpu::Get(after, 60000) == 1006);
+
+  placed.Kill();
+  ray_tpu::RemovePlacementGroup(pg);
+
   ray_tpu::Shutdown();
   std::printf("XLANG-OK\n");
   return 0;

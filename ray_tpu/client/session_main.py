@@ -67,13 +67,16 @@ class SessionDriver:
         # counting), the server keeps the object alive meanwhile
         self._refs: Dict[bytes, ObjectRef] = {}
         self._actors: Dict[bytes, ray_tpu.api.ActorHandle] = {}
+        self._pgs: Dict[bytes, object] = {}       # raw pg id -> PG
         self._fns: Dict[bytes, object] = {}       # fn blob hash -> callable
         self._last_heartbeat = time.monotonic()
         for name in ("put", "get", "wait", "submit", "submit_named",
                      "create_actor", "create_named_actor",
                      "actor_call", "kill_actor", "get_named_actor", "cancel",
                      "release", "cluster_resources", "available_resources",
-                     "nodes", "heartbeat"):
+                     "nodes", "heartbeat",
+                     "create_placement_group", "placement_group_ready",
+                     "remove_placement_group"):
             self.server.register(name, getattr(self, f"h_{name}"))
 
     # ------------------------------------------------------------- helpers
@@ -129,8 +132,47 @@ class SessionDriver:
         ready_set = {r.object_id.binary() for r in ready}
         return [r for r in raw_ids if r in ready_set]
 
+    # xlang argument convention: a non-Python driver (cpp api.h) encodes
+    # an actor handle as {"__rt_actor_handle__": raw_id} — rebuilt into a
+    # live handle here so C++ can pass actors to Python tasks/actors
+    # (reference: cross-language actor handle passing).
+    _HANDLE_KEY = "__rt_actor_handle__"
+
+    def _revive_handles(self, x):
+        if isinstance(x, dict):
+            if set(x) == {self._HANDLE_KEY}:
+                raw = x[self._HANDLE_KEY]
+                handle = self._actors.get(raw)
+                if handle is None:
+                    from ray_tpu.common.ids import ActorID
+                    from ray_tpu.core_worker.actor import ActorHandle
+
+                    handle = ActorHandle(ActorID(raw))
+                return handle
+            return {k: self._revive_handles(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            out = [self._revive_handles(v) for v in x]
+            return type(x)(out) if isinstance(x, tuple) else out
+        return x
+
+    def _xlate_opts(self, opts: dict) -> dict:
+        """Translate the xlang opts dict: a raw placement-group id (+
+        bundle_index) becomes the Python scheduling strategy."""
+        opts = dict(opts or {})
+        pg_raw = opts.pop("placement_group", None)
+        if pg_raw is not None:
+            from ray_tpu.common.task_spec import PlacementGroupStrategy
+
+            pg = self._pgs[pg_raw]
+            opts["scheduling_strategy"] = PlacementGroupStrategy(
+                pg.id, int(opts.pop("bundle_index", 0)))
+        return opts
+
     async def _do_submit(self, fn, args_blob: bytes, opts: dict):
         args, kwargs = self._loads(args_blob)
+        args = self._revive_handles(args)
+        kwargs = self._revive_handles(kwargs)
+        opts = self._xlate_opts(opts)
         rf = ray_tpu.remote(fn)
         if opts:
             rf = rf.options(**opts)
@@ -144,6 +186,9 @@ class SessionDriver:
 
     async def _do_create_actor(self, cls, args_blob: bytes, opts: dict):
         args, kwargs = self._loads(args_blob)
+        args = self._revive_handles(args)
+        kwargs = self._revive_handles(kwargs)
+        opts = self._xlate_opts(opts)
         ac = ray_tpu.remote(cls)
         if opts:
             ac = ac.options(**opts)
@@ -188,8 +233,18 @@ class SessionDriver:
 
     async def h_actor_call(self, actor_raw: bytes, method_name: str,
                            args_blob: bytes, num_returns: int):
-        handle = self._actors[actor_raw]
+        handle = self._actors.get(actor_raw)
+        if handle is None:
+            # an id learned xlang (e.g. returned from a Python task to the
+            # C++ driver): serve it anyway
+            from ray_tpu.common.ids import ActorID
+            from ray_tpu.core_worker.actor import ActorHandle
+
+            handle = self._actors[actor_raw] = ActorHandle(
+                ActorID(actor_raw))
         args, kwargs = self._loads(args_blob)
+        args = self._revive_handles(args)
+        kwargs = self._revive_handles(kwargs)
 
         def do():
             out = getattr(handle, method_name).remote(*args, **kwargs)
@@ -197,6 +252,30 @@ class SessionDriver:
             return [self._track(r) for r in refs]
 
         return await asyncio.to_thread(do)
+
+    # ------------------------------------------------ placement groups
+    async def h_create_placement_group(self, bundles, strategy: str,
+                                       name=None):
+        def do():
+            pg = ray_tpu.placement_group(
+                [dict(b) for b in bundles], strategy=strategy,
+                name=name or None)
+            raw = pg.id.binary()
+            self._pgs[raw] = pg
+            return raw
+
+        return await asyncio.to_thread(do)
+
+    async def h_placement_group_ready(self, pg_raw: bytes,
+                                      timeout_s: float = 60.0):
+        pg = self._pgs[pg_raw]
+        return await asyncio.to_thread(lambda: pg.wait(timeout_s))
+
+    async def h_remove_placement_group(self, pg_raw: bytes):
+        pg = self._pgs.pop(pg_raw, None)
+        if pg is not None:
+            await asyncio.to_thread(ray_tpu.remove_placement_group, pg)
+        return True
 
     async def h_cancel(self, raw_id: bytes, force: bool = False):
         ref = self._refs.get(raw_id)

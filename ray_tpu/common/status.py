@@ -71,7 +71,12 @@ class ActorUnavailableError(RtError):
 class ObjectLostError(RtError):
     def __init__(self, object_id=None, reason: str = ""):
         self.object_id = object_id
+        self.reason = reason
         super().__init__(f"object {object_id} lost: {reason}")
+
+    def __reduce__(self):  # default reduce would re-wrap the message as
+        # the object_id on every pickle hop, nesting "object object ..."
+        return (ObjectLostError, (self.object_id, self.reason))
 
 
 class ObjectStoreFullError(RtError):

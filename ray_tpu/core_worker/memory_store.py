@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time as _time
 import tempfile
 import threading
 from dataclasses import dataclass
@@ -49,6 +50,19 @@ class MemoryStore:
         self._bytes_used = 0
         self._done_callbacks: Dict[ObjectID, list] = {}
         self._spill_dir: Optional[str] = None
+        # loss forensics (RT_store_debug=1): per-oid event history so an
+        # "unknown object" reply can say exactly what happened to the
+        # entry instead of inviting guesswork
+        self._debug = bool(os.environ.get("RT_store_debug"))
+        self._history: Dict[ObjectID, list] = {}
+
+    def _note(self, object_id: ObjectID, event: str) -> None:
+        if self._debug:
+            self._history.setdefault(object_id, []).append(
+                (round(_time.monotonic(), 3), event))
+
+    def history(self, object_id: ObjectID) -> list:
+        return self._history.get(object_id, [])
 
     # ------------------------------------------------------------- spilling
     def _ensure_spill_dir(self) -> str:
@@ -123,6 +137,10 @@ class MemoryStore:
             cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
             high = cap * GLOBAL_CONFIG.get("object_spilling_threshold")
             existing = self._entries.get(object_id)
+            self._note(object_id,
+                       f"put(v={value is not None},e={error is not None},"
+                       f"loc={location is not None},"
+                       f"dup={existing is not None and existing.is_ready})")
             if existing is not None and existing.is_ready:
                 return  # idempotent: first write wins (retries may re-store)
             if self._bytes_used + charge > high:
@@ -159,6 +177,7 @@ class MemoryStore:
 
     def mark_pending(self, object_id: ObjectID) -> None:
         with self._cv:
+            self._note(object_id, "mark_pending")
             self._entries.setdefault(object_id, Entry())
 
     def is_pending(self, object_id: ObjectID) -> bool:
@@ -260,8 +279,12 @@ class MemoryStore:
             return e.location if e is not None and e.is_ready else None
 
     def free(self, object_ids: List[ObjectID]) -> None:
+        import traceback
         with self._cv:
             for oid in object_ids:
+                if self._debug:
+                    caller = traceback.extract_stack(limit=4)[0]
+                    self._note(oid, f"free from {caller.name}:{caller.lineno}")
                 e = self._entries.pop(oid, None)
                 if e is not None:
                     if e.value is not None and not e.shm_backed:

@@ -75,7 +75,49 @@ class NormalTaskSubmitter:
             specs, self._pending = self._pending, []
             self._wakeup_scheduled = False
         for spec in specs:
+            if self._gate_on_dependencies(spec):
+                continue
             self._enqueue(spec)
+
+    def _gate_on_dependencies(self, spec: TaskSpec) -> bool:
+        """Reference contract (raylet dependency manager / lease_policy:
+        a task is not DISPATCHED until its args are available): by-ref
+        args we own must be READY before the task becomes lease-eligible.
+
+        Without this, consumers grab every CPU lease and then block
+        INSIDE execution waiting for producer outputs, while the
+        producers starve in the lease queue — a hard scheduling deadlock
+        at data-pipeline scale (round-5 GB-shuffle finding).  Returns
+        True when the spec was parked; it re-enters via the owner store's
+        done callback the moment the last missing arg is ready."""
+        missing = []
+        for arg in spec.args:
+            if arg.is_inline or arg.object_id is None:
+                continue
+            owner_addr = getattr(arg, "owner_address", None)
+            if owner_addr is not None and \
+                    tuple(owner_addr) != self._cw.server.address:
+                continue  # remote owner: resolved at execution (borrow)
+            entry = self._cw.memory_store.get_if_ready(arg.object_id)
+            if entry is None:
+                # error entries count as READY: dispatch and let execution
+                # surface the dependency failure the normal way
+                missing.append(arg.object_id)
+        if not missing:
+            return False
+        remaining = {"n": len(missing)}
+        lock = threading.Lock()
+
+        def on_ready():
+            with lock:
+                remaining["n"] -= 1
+                if remaining["n"] > 0:
+                    return
+            self._io.loop.call_soon_threadsafe(self._enqueue, spec)
+
+        for oid in missing:
+            self._cw.memory_store.add_done_callback(oid, on_ready)
+        return True
 
     def _enqueue(self, spec: TaskSpec):
         key = spec.shape_key()

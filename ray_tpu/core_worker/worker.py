@@ -186,6 +186,7 @@ class CoreWorker:
         # ack/incref.
         self._owned_refs: Dict[ObjectID, dict] = {}
         self._borrowed: Dict[ObjectID, dict] = {}
+        self._free_tombstones: Dict[bytes, float] = {}
         self._ref_lock = threading.Lock()
 
         # execution state (executee side)
@@ -270,6 +271,10 @@ class CoreWorker:
                     try:
                         from ray_tpu.object_store.shm import ShmObjectStore
 
+                        # spill dir DERIVED from the segment name inside
+                        # the store — every handle (workers, tools, the
+                        # teardown unlink) must agree on it, so no caller
+                        # spells it out
                         probed = ShmObjectStore(
                             f"/rtshm_{self.node_id.hex()[:12]}",
                             capacity=GLOBAL_CONFIG.get("shm_store_bytes"))
@@ -281,11 +286,18 @@ class CoreWorker:
     def _shm_read(self, oid: ObjectID) -> Optional[memoryview]:
         """Zero-copy read: the returned view aliases the store's shared
         pages and stays pinned until the last alias (including numpy
-        arrays deserialized over it) is garbage-collected."""
+        arrays deserialized over it) is garbage-collected.  A value the
+        arena demoted to disk under memory pressure (shm.py
+        spill-on-evict) comes back as an owned heap copy — one disk
+        read, no re-admission."""
         store = self.shm
         if store is None:
             return None
-        return store.get_pinned(oid.binary())
+        view = store.get_pinned(oid.binary())
+        if view is not None:
+            return view
+        blob = store.read_spilled(oid.binary())
+        return memoryview(blob) if blob is not None else None
 
     # ------------------------------------------------------------- contexts
     def current_task_id(self) -> TaskID:
@@ -1172,16 +1184,37 @@ class CoreWorker:
                 return
         self._free_owned(oid)
 
+    # The reference's lineage-pinning contract (reference_count.h lineage
+    # pinning + max_lineage_bytes): freeing a consumed intermediate's
+    # VALUE must not discard its SPEC — a downstream task retry may need
+    # to re-execute it (recursively).  Round-5 scale finding: GB shuffles
+    # under memory pressure lose blocks exactly here when a consumer dies
+    # after its args were freed.  The table is capped FIFO instead of
+    # popped-on-free.
+    _LINEAGE_CAP = 20_000
+
     def _free_owned(self, oid: ObjectID):
+        # breadcrumb for loss forensics: a later "unknown object" reply
+        # distinguishes freed-then-needed from never-stored
+        self._free_tombstones[oid.binary()] = time.monotonic()
+        if len(self._free_tombstones) > 50_000:
+            for k in list(self._free_tombstones)[:10_000]:
+                self._free_tombstones.pop(k, None)
         with self._ref_lock:
             self._owned_refs.pop(oid, None)
-        with self._lineage_lock:
-            self.lineage.pop(oid, None)
+        if not GLOBAL_CONFIG.get("lineage_pinning_enabled"):
+            with self._lineage_lock:
+                self.lineage.pop(oid, None)
+        else:
+            with self._lineage_lock:
+                while len(self.lineage) > self._LINEAGE_CAP:
+                    self.lineage.pop(next(iter(self.lineage)), None)
         location = self.memory_store.peek_location(oid)
         self.memory_store.free([oid])
         self.device_store.free(oid.binary())
         if self._shm not in (False, None):
             self._shm.delete(oid.binary())
+            self._shm.drop_spilled(oid.binary())
         if location is not None and tuple(location) != self.server.address:
             # the value lives in the executor's store: tell it to drop
             async def drop():
@@ -1261,11 +1294,32 @@ class CoreWorker:
         h_object_info (holder-facing; reports size for the chunked pull)."""
         oid = ObjectID(object_id)
         loop = asyncio.get_running_loop()
+        recon = "untried"
+        if not self.memory_store.contains(oid):
+            # owner-side recursive reconstruction: a freed intermediate
+            # whose spec is still lineage-pinned is re-executed instead
+            # of reported lost — the link that makes DEEP retry chains
+            # (consumer died after its args were freed) converge
+            with self._lineage_lock:
+                has_lineage = oid in self.lineage
+            if has_lineage:
+                ok = await loop.run_in_executor(
+                    self._executor, lambda: self._try_reconstruct(oid))
+                recon = "resubmitted" if ok else "refused"
+            else:
+                recon = "no-lineage"
         meta = await loop.run_in_executor(
             self._executor,
             lambda: self.memory_store.value_meta_blocking(oid, timeout))
         if meta is None:
-            return {"error": pickle.dumps(ObjectLostError(oid, "unknown object"))}
+            freed_ago = self._free_tombstones.get(oid.binary())
+            freed = (f"freed {time.monotonic() - freed_ago:.1f}s ago"
+                     if freed_ago is not None else "never stored/freed here")
+            hist = self.memory_store.history(oid)
+            return {"error": pickle.dumps(ObjectLostError(
+                oid, f"unknown object (owner={self.server.address}, "
+                     f"mode={self.mode}, {freed}, "
+                     f"reconstruction={recon}, history={hist[-12:]})"))}
         if meta.get("error") is not None:
             return {"error": meta["error"]}
         size = meta.get("size")
@@ -1425,12 +1479,18 @@ class CoreWorker:
     async def h_drop_copy(self, object_id: bytes):
         """Owner freed the object: drop our cached/held copy."""
         oid = ObjectID(object_id)
+        with self._ref_lock:
+            if oid in self._owned_refs:
+                # we ARE the owner: a stray/late drop_copy must not destroy
+                # the canonical entry (the owner frees via _free_owned only)
+                return False
         self.memory_store.free([oid])
         self.device_store.free(object_id)
         with self._device_cache_lock:
             self._device_obj_cache.pop(object_id, None)
         if self._shm not in (False, None):
             self._shm.delete(object_id)
+            self._shm.drop_spilled(object_id)
         return True
 
     async def h_reconstruct_object(self, object_id: bytes):
@@ -1902,21 +1962,48 @@ class CoreWorker:
 
     def _get_dependency(self, arg: TaskArg) -> Any:
         oid = arg.object_id
-        entry = self.memory_store.get_if_ready(oid)
-        if entry is None:
-            owner_address = getattr(arg, "owner_address", None)
-            ref = ObjectRef(oid, arg.owner, owner_address)
-            self._ensure_local(ref, None)
-            entry = self.memory_store.get_blocking(oid, 120.0)
-        if entry.error is not None:
-            raise self.deserialize(entry.error)
-        if entry.value is not None:
-            return self._maybe_device_resolve(self.deserialize(entry.value))
-        if entry.location is not None:
-            ref = ObjectRef(oid, arg.owner, getattr(arg, "owner_address", None))
-            blob = self._fetch_from_location(ref, entry.location, 120.0)
-            return self._maybe_device_resolve(self.deserialize(blob))
-        raise ObjectLostError(oid, "dependency unavailable")
+        last_err = None
+        # A lost dependency is retried: the owner's lineage reconstruction
+        # may be a DEEP chain (the producing task's own args were freed
+        # and are re-executing recursively), and each fetch window only
+        # covers one level.  Bounded — a truly unrecoverable object still
+        # surfaces, just not on the first window.
+        for attempt in range(4):
+            entry = self.memory_store.get_if_ready(oid)
+            if entry is None:
+                owner_address = getattr(arg, "owner_address", None)
+                ref = ObjectRef(oid, arg.owner, owner_address)
+                self._ensure_local(ref, None)
+                entry = self.memory_store.get_blocking(oid, 120.0)
+            if entry.error is not None:
+                err = self.deserialize(entry.error)
+                if isinstance(err, ObjectLostError) and attempt < 3:
+                    last_err = err
+                    self.memory_store.free([oid])
+                    self.memory_store.mark_pending(oid)
+                    time.sleep(2.0 * (attempt + 1))
+                    continue
+                raise err
+            if entry.value is not None:
+                return self._maybe_device_resolve(
+                    self.deserialize(entry.value))
+            if entry.location is not None:
+                ref = ObjectRef(oid, arg.owner,
+                                getattr(arg, "owner_address", None))
+                try:
+                    blob = self._fetch_from_location(ref, entry.location,
+                                                     120.0)
+                except ObjectLostError as err:
+                    if attempt < 3:
+                        last_err = err
+                        self.memory_store.free([oid])
+                        self.memory_store.mark_pending(oid)
+                        time.sleep(2.0 * (attempt + 1))
+                        continue
+                    raise
+                return self._maybe_device_resolve(self.deserialize(blob))
+            break
+        raise last_err or ObjectLostError(oid, "dependency unavailable")
 
     # ------------------------------------------------- streaming generators
     def _as_sync_iter(self, result):
@@ -1959,9 +2046,12 @@ class CoreWorker:
                         self.memory_store.put(oid, value=blob)
                         if self.shm is not None:
                             try:
-                                self.shm.put(oid.binary(), blob)
+                                # node-durable like task returns: a lazily
+                                # consumed stream outlives this worker's
+                                # idle TTL routinely
+                                self.shm.put_or_spill(oid.binary(), blob)
                             except OSError:
-                                pass  # store full → RPC pull still works
+                                pass  # no shm and no spill dir
                         payload = {"location": self.server.address}
                     reply = client.call(
                         "report_generator_item", timeout=None,
@@ -2124,8 +2214,12 @@ class CoreWorker:
                 self.memory_store.put(oid, value=blob)
                 if self.shm is not None:
                     try:
-                        self.shm.put(oid.binary(), blob)
-                    except OSError:  # store full → RPC path still works
+                        # node-durable: arena or node spill dir — the
+                        # primary copy must outlive THIS worker (idle
+                        # reap between produce and fetch is routine in
+                        # long pipelines)
+                        self.shm.put_or_spill(oid.binary(), blob)
+                    except OSError:  # no shm AND no spill dir writable
                         pass
                 results[oid.binary()] = {"location": self.server.address}
         return {"results": results}

@@ -763,22 +763,39 @@ class GroupedDataset:
     def map_groups(self, fn) -> Dataset:
         """Apply ``fn(rows: List[dict]) -> List[dict]`` to each key group
         (reference ``GroupedData.map_groups``). Runs one task per hash
-        partition; output blocks stay distributed."""
+        partition; output blocks stay distributed.
+
+        Grouping is columnar: one stable argsort on the key column, then
+        row views sliced out of numpy columns — never per-cell Arrow
+        ``as_py`` conversion, which made GB-scale groupbys ~20x slower
+        than the shuffle that feeds them."""
         import ray_tpu
 
         key = self._key
 
         @ray_tpu.remote
         def _map_partition(block):
-            groups: Dict[Any, List[Dict]] = {}
-            for row in B.block_to_rows(block):
-                groups.setdefault(row[key], []).append(row)
+            batch = B.block_to_batch(block)
+            if batch and key not in batch:
+                raise KeyError(
+                    f"groupby key {key!r} not in columns {sorted(batch)}")
             out: List[Dict] = []
-            for k in sorted(groups):
-                res = fn(groups[k])
-                if isinstance(res, dict):
-                    res = [res]
-                out.extend(res)
+            if batch and key in batch:
+                keys = np.asarray(batch[key])
+                order = np.argsort(keys, kind="stable")
+                cols = {c: np.asarray(v)[order] for c, v in batch.items()}
+                sorted_keys = cols[key]
+                uniq, starts = np.unique(sorted_keys, return_index=True)
+                bounds = list(starts) + [len(sorted_keys)]
+                names = list(cols)
+                for i in range(len(uniq)):
+                    lo, hi = bounds[i], bounds[i + 1]
+                    rows = [{c: cols[c][j] for c in names}
+                            for j in range(lo, hi)]
+                    res = fn(rows)
+                    if isinstance(res, dict):
+                        res = [res]
+                    out.extend(res)
             return B.block_from_rows(out)
 
         refs = [_map_partition.remote(p) for p in self._partitions()]

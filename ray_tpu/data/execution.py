@@ -288,10 +288,21 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
                     x = x.item()
                 if isinstance(x, (int, np.integer)):
                     return int(x)
+                if isinstance(x, float) and x.is_integer():
+                    # a key column that materializes int64 in one block
+                    # and float64 in another (e.g. Arrow nulls) must
+                    # still route equal keys to ONE partition
+                    return int(x)
                 b = x if isinstance(x, bytes) else str(x).encode()
                 return zlib.crc32(b)
 
-            assign = np.array([stable(x) % n for x in batch[key]], np.int64)
+            col = np.asarray(batch[key])
+            if np.issubdtype(col.dtype, np.integer):
+                # vectorized: the per-row python hash loop dominated
+                # GB-scale shuffles
+                assign = (col.astype(np.int64) % n).astype(np.int64)
+            else:
+                assign = np.array([stable(x) % n for x in col], np.int64)
         elif mode == "sort":
             col = np.asarray(batch[key])
             assign = np.searchsorted(boundaries, col, side="right") \

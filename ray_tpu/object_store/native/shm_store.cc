@@ -72,6 +72,13 @@ struct Store {
   Header* hdr;
   uint8_t* base;     // mapping base
   uint64_t map_size;
+  // Per-process policy: when 0, a full arena fails the allocation with
+  // -ENOSPC instead of silently dropping LRU objects — the caller then
+  // SPILLS victims to disk first (object_store/shm.py spill-on-evict),
+  // so primary copies are demoted, never lost.  Mirrors plasma's
+  // spill-before-evict contract (reference plasma_store_runner +
+  // local_object_manager.cc SpillObjects).
+  int autoevict = 1;
 };
 
 constexpr int kMaxStores = 64;
@@ -302,7 +309,7 @@ int rts_put(int h, const uint8_t* id, uint32_t id_len,
   }
   uint64_t sz = size ? size : 1;  // zero-size objects occupy 1 byte
   uint64_t off = AllocSpan(hdr, sz);
-  if (off == UINT64_MAX) {
+  if (off == UINT64_MAX && st.autoevict) {
     EvictLocked(hdr, sz);
     off = AllocSpan(hdr, sz);
   }
@@ -349,7 +356,7 @@ uint8_t* rts_create_unsealed(int h, const uint8_t* id, uint32_t id_len,
   }
   uint64_t sz = size ? size : 1;
   uint64_t off = AllocSpan(hdr, sz);
-  if (off == UINT64_MAX) {
+  if (off == UINT64_MAX && st.autoevict) {
     EvictLocked(hdr, sz);
     off = AllocSpan(hdr, sz);
   }
@@ -516,6 +523,38 @@ int rts_delete(int h, const uint8_t* id, uint32_t id_len) {
     return 0;
   }
   DeleteEntryLocked(hdr, e);
+  pthread_mutex_unlock(&hdr->lock);
+  return 0;
+}
+
+// Per-process: disable (0) / enable (1) silent LRU drop on full arena.
+// With it disabled the caller runs the spill-before-evict loop (shm.py):
+// rts_lru_candidate -> copy bytes to disk -> rts_delete -> retry.
+int rts_set_autoevict(int h, int enabled) {
+  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  g_stores[h].autoevict = enabled ? 1 : 0;
+  return 0;
+}
+
+// Id of the current LRU sealed refcount-0 object (the next eviction
+// victim).  0 on success; -ENOENT when nothing is evictable.
+int rts_lru_candidate(int h, uint8_t* out_id, uint32_t* out_id_len) {
+  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  Header* hdr = g_stores[h].hdr;
+  if (LockHeld(hdr) != 0) return -EINVAL;
+  Entry* victim = nullptr;
+  for (uint32_t i = 0; i < kTableSize; i++) {
+    Entry& e = hdr->table[i];
+    if (e.used == 1 && e.sealed && !e.pending_delete && e.refcount == 0 &&
+        (!victim || e.lru_tick < victim->lru_tick))
+      victim = &e;
+  }
+  if (!victim) {
+    pthread_mutex_unlock(&hdr->lock);
+    return -ENOENT;
+  }
+  memcpy(out_id, victim->id, victim->id_len);
+  *out_id_len = victim->id_len;
   pthread_mutex_unlock(&hdr->lock);
   return 0;
 }

@@ -66,6 +66,11 @@ def _load():
     lib.rts_stats.argtypes = [ctypes.c_int] + \
         [ctypes.POINTER(ctypes.c_uint64)] * 3
     lib.rts_stats.restype = ctypes.c_int
+    lib.rts_set_autoevict.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.rts_set_autoevict.restype = ctypes.c_int
+    lib.rts_lru_candidate.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32)]
+    lib.rts_lru_candidate.restype = ctypes.c_int
     lib.rts_unlink.argtypes = [ctypes.c_char_p]
     lib.rts_unlink.restype = ctypes.c_int
     _lib = lib
@@ -75,10 +80,20 @@ def _load():
 class ShmObjectStore:
     """One node-local store; any process opening the same name shares it."""
 
+    # sentinel: derive the spill dir from the segment name (the default —
+    # spill-before-evict is a SHARED-ARENA invariant, so every handle to
+    # a segment must agree on it; pass spill_dir=None explicitly for a
+    # pure-LRU store, e.g. unit tests of eviction itself)
+    DERIVE = object()
+
     def __init__(self, name: str, capacity: int = 256 * 1024 * 1024,
-                 create: bool = True):
+                 create: bool = True, spill_dir=DERIVE):
+        import tempfile
+
         self._lib = _load()
         self.name = name.encode() if isinstance(name, str) else name
+        if spill_dir is ShmObjectStore.DERIVE:
+            spill_dir = self._derived_spill_dir(self.name)
         if create:
             h = self._lib.rts_create(self.name, capacity)
         else:
@@ -90,6 +105,108 @@ class ShmObjectStore:
         # name the exact span even after a delete + re-put of the id
         self._pins: dict = {}
         self._pins_lock = threading.Lock()
+        # spill-before-evict (plasma's SpillObjects contract): with a
+        # spill dir, a full arena demotes LRU victims to node-local disk
+        # instead of silently dropping primary copies — the round-5 fix
+        # for GB-scale shuffles losing blocks once the working set passed
+        # the arena size.  All processes on the node share the dir (it is
+        # derived from the segment name), so any process can spill and
+        # any process can read back.
+        self._spill_dir = spill_dir
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._lib.rts_set_autoevict(self._h, 0)
+
+    # ------------------------------------------------------ spill-on-evict
+    @staticmethod
+    def _derived_spill_dir(name: bytes) -> str:
+        """ONE rule for segment-name → spill-dir, shared by every handle
+        AND by unlink() — a mismatch silently splits the arena's durable
+        copies across directories."""
+        import tempfile
+
+        base = os.environ.get("RT_object_spilling_dir") or \
+            tempfile.gettempdir()
+        return os.path.join(base,
+                            "rtshm_spill_" + name.decode().lstrip("/"))
+
+    def _can_ever_fit(self, size: int) -> bool:
+        """Guard the demotion loop: an object bigger than the whole arena
+        would otherwise flush every resident object to disk and STILL
+        fail."""
+        cap, _, _ = self.stats()
+        return size <= cap
+
+    def _spill_path(self, object_id: bytes) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def _spill_one(self) -> bool:
+        """Demote the LRU victim to disk.  False when nothing evictable."""
+        out_id = ctypes.create_string_buffer(32)
+        out_len = ctypes.c_uint32()
+        rc = self._lib.rts_lru_candidate(self._h, out_id,
+                                         ctypes.byref(out_len))
+        if rc != 0:
+            return False
+        oid = out_id.raw[:out_len.value]
+        view = self.get(oid)
+        if view is None:
+            return True  # raced with a delete: space freed either way
+        try:
+            tmp = self._spill_path(oid) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(view)
+            os.replace(tmp, self._spill_path(oid))
+        finally:
+            del view
+            self.release(oid)
+        self._lib.rts_delete(self._h, oid, len(oid))
+        return True
+
+    def put_or_spill(self, object_id: bytes, data) -> bool:
+        """Node-durable put: into the arena if it fits (after demoting LRU
+        victims), else straight to the node spill dir.  Either way the
+        bytes survive this PROCESS — the property primary copies of task
+        returns need (the holding worker may be idle-reaped long before
+        the owner fetches; reference: plasma holds primary copies in the
+        store daemon, not in workers)."""
+        if self._spill_dir is None:
+            return self.put(object_id, data)
+        try:
+            return self.put(object_id, data)
+        except OSError:
+            pass  # nothing evictable (all pinned): demote THIS value
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        tmp = self._spill_path(object_id) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._spill_path(object_id))
+        return True
+
+    def read_spilled(self, object_id: bytes) -> Optional[bytes]:
+        """Bytes of a demoted object, or None.  One disk read; the copy
+        is NOT re-admitted (re-admission would immediately re-trigger
+        pressure — the reference restores lazily too)."""
+        if self._spill_dir is None:
+            return None
+        try:
+            with open(self._spill_path(object_id), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def drop_spilled(self, object_id: bytes) -> None:
+        if self._spill_dir is None:
+            return
+        try:
+            os.unlink(self._spill_path(object_id))
+        except OSError:
+            pass
+
+    def contains_spilled(self, object_id: bytes) -> bool:
+        return (self._spill_dir is not None
+                and os.path.exists(self._spill_path(object_id)))
 
     def put(self, object_id: bytes, data) -> bool:
         """False if it already exists; raises on out-of-space."""
@@ -97,6 +214,12 @@ class ShmObjectStore:
             data = bytes(data)
         rc = self._lib.rts_put(self._h, object_id, len(object_id), data,
                                len(data))
+        while rc == -28 and self._spill_dir is not None \
+                and self._can_ever_fit(len(data)):  # ENOSPC
+            if not self._spill_one():
+                break
+            rc = self._lib.rts_put(self._h, object_id, len(object_id),
+                                   data, len(data))
         if rc == 0:
             return True
         if rc == -17:      # EEXIST
@@ -108,10 +231,17 @@ class ShmObjectStore:
         freshly allocated arena span — serialize directly into it, then
         :meth:`seal`. None if the id exists or space can't be found.
         Unsealed entries are invisible to readers and to eviction."""
-        ptr = self._lib.rts_create_unsealed(self._h, object_id,
-                                            len(object_id), size)
-        if not ptr:
-            return None
+        while True:
+            ptr = self._lib.rts_create_unsealed(self._h, object_id,
+                                                len(object_id), size)
+            if ptr:
+                break
+            # nullptr is EEXIST *or* ENOSPC: distinguish, then spill
+            if self._spill_dir is None or self.contains(object_id) \
+                    or not self._can_ever_fit(size):
+                return None
+            if not self._spill_one():
+                return None
         addr = ctypes.addressof(ptr.contents)
         return memoryview((ctypes.c_ubyte * size).from_address(addr)) \
             .cast("B")
@@ -193,9 +323,16 @@ class ShmObjectStore:
 
 
 def unlink(name) -> bool:
-    """Unlink a segment by name WITHOUT opening it (no handle-slot cost)."""
+    """Unlink a segment by name WITHOUT opening it (no handle-slot cost).
+    Also removes the segment's derived spill dir — demoted objects die
+    with their arena (repeated sessions must not accumulate spilled GBs
+    in /tmp)."""
+    import shutil
+
     if isinstance(name, str):
         name = name.encode()
+    shutil.rmtree(ShmObjectStore._derived_spill_dir(name),
+                  ignore_errors=True)
     try:
         return _load().rts_unlink(name) == 0
     except Exception:  # noqa: BLE001 — lib unbuildable → nothing to unlink

@@ -151,6 +151,8 @@ class Raylet:
         self._leases: Dict[bytes, WorkerID] = {}
         self._bundles: Dict[PlacementGroupID, Dict[int, Bundle]] = {}
         self._pending_leases: List[dict] = []  # queued lease requests (waiters)
+        self._drain_running = False  # single-flight pending-lease drain
+        self._drain_again = False
         self._seq = 0
         self._stopped = False
         self._bg_tasks: List = []
@@ -894,33 +896,58 @@ class Raylet:
     def _try_grant_pending(self):
         if not self._pending_leases:
             return
+        # Single-flight: concurrent drain() tasks interleaving at the
+        # grant await both leaked leases and dropped queue items when each
+        # rebuilt _pending_leases (round-5 review findings).  One drain
+        # runs at a time; triggers during a run coalesce into one rerun.
+        if self._drain_running:
+            self._drain_again = True
+            return
+        self._drain_running = True
 
         async def drain():
-            still: List[dict] = []
-            for item in self._pending_leases:
-                if item["future"].done():
-                    continue
-                if self._local_available(item["request"], item["pg"]):
-                    granted = await self._grant_lease(
-                        item["lease_id"], item["request"], item["pg"],
-                        item.get("runtime_env"), job_id=item.get("job_id"))
-                    if granted is not None:
-                        item["future"].set_result(granted)
-                        continue
-                if item["pg"] is None:
-                    # re-evaluate spilling: a REMOTE node may have freed up
-                    # while we were queued (its gossip triggers this drain)
-                    node = policies.pick_node(
-                        self.view, item["request"], None, local_node=self.node_id)
-                    if node is not None and node.node_id != self.node_id:
-                        item["future"].set_result(
-                            {"status": "spill", "node_id": node.node_id.binary(),
-                             "address": node.address})
-                        continue
-                still.append(item)
-            self._pending_leases[:] = still
+            try:
+                while True:
+                    self._drain_again = False
+                    await self._drain_pending_leases_once()
+                    if not self._drain_again:
+                        return
+            finally:
+                self._drain_running = False
 
         self._io.spawn_threadsafe(drain())
+
+    async def _drain_pending_leases_once(self):
+        still: List[dict] = []
+        for item in self._pending_leases:
+            if item["future"].done():
+                continue
+            if self._local_available(item["request"], item["pg"]):
+                granted = await self._grant_lease(
+                    item["lease_id"], item["request"], item["pg"],
+                    item.get("runtime_env"), job_id=item.get("job_id"))
+                if granted is not None:
+                    if not item["future"].done():
+                        item["future"].set_result(granted)
+                    else:
+                        # the job-finished reclaim resolved the future
+                        # while we granted: give the lease back or it
+                        # (and its worker) leaks forever
+                        await self.h_return_worker(item["lease_id"])
+                    continue
+            if item["pg"] is None:
+                # re-evaluate spilling: a REMOTE node may have freed up
+                # while we were queued (its gossip triggers this drain)
+                node = policies.pick_node(
+                    self.view, item["request"], None, local_node=self.node_id)
+                if node is not None and node.node_id != self.node_id \
+                        and not item["future"].done():
+                    item["future"].set_result(
+                        {"status": "spill", "node_id": node.node_id.binary(),
+                         "address": node.address})
+                    continue
+            still.append(item)
+        self._pending_leases[:] = still
 
     # ---------------------------------------------------------------- actors
     async def h_start_actor(self, creation_spec: bytes):

@@ -68,6 +68,11 @@ from ray_tpu.rl.appo import (  # noqa: F401
     APPOConfig,
     APPOLearner,
 )
+from ray_tpu.rl.dreamerv3 import (  # noqa: F401
+    DreamerV3,
+    DreamerV3Config,
+    DreamerV3Learner,
+)
 
 from ray_tpu.util.usage import record_library_usage as _record_usage
 _record_usage("rl")

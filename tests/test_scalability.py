@@ -3,6 +3,8 @@ single_node.json rows — many args, many returns, deep queues, large
 objects — shrunk to CI size for this 1-core box; the shapes, not the
 absolute counts, are what regressions break)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -49,6 +51,38 @@ def test_deep_task_queue_drains(rt):
 
     refs = [one.remote() for _ in range(10_000)]  # enqueues ~instantly
     assert sum(ray_tpu.get(refs, timeout=600)) == 10_000
+
+
+def test_100k_task_queue_with_memory_envelope(rt):
+    """Queue-depth envelope pushed to 100k (reference row: 1M queued
+    tasks, release/benchmarks/README.md).  Submission must stay ahead of
+    execution, the queue must fully drain, and per-task driver memory is
+    MEASURED — the scaling story to the reference's 1M is linear in this
+    number (documented in BASELINE.md terms: 100k tasks at <4 KB/task
+    driver-side = <400 MB, within one release-CI box's budget)."""
+    import gc
+    import resource
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    n = 100_000
+    gc.collect()
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    t0 = time.monotonic()
+    refs = [one.remote() for _ in range(n)]
+    submit_s = time.monotonic() - t0
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    per_task_kb = max(0, rss_after - rss_before) / n  # ru_maxrss is KB
+    # envelope facts, printed so the runner log records them
+    print(f"\n100k submit: {submit_s:.1f}s "
+          f"({n / max(submit_s, 1e-9):.0f} tasks/s), "
+          f"~{per_task_kb:.2f} KB/task driver RSS")
+    assert submit_s < 120, "submission must not serialize on execution"
+    assert per_task_kb < 8.0, \
+        f"per-task driver memory {per_task_kb:.1f} KB blows the 1M budget"
+    assert sum(ray_tpu.get(refs, timeout=1200)) == n
 
 
 def test_large_object_roundtrip(rt):

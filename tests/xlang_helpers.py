@@ -13,3 +13,18 @@ class Accumulator:
 
     def total(self):
         return self.n
+
+
+def poke_accumulator(handle, k):
+    """xlang actor-HANDLE-passing test target: the C++ driver passes an
+    actor handle as an argument; this Python task calls through it."""
+    import ray_tpu
+
+    return ray_tpu.get(handle.add.remote(k))
+
+
+def which_node():
+    """Node id of the worker executing this task (PG verification)."""
+    import ray_tpu
+
+    return ray_tpu.get_runtime_context().get_node_id()
