@@ -42,6 +42,8 @@ BASELINES = {
     "n_n_async_actor_calls_async": 23674.5,
     "put_gigabytes_per_s": None,
     "get_gigabytes_per_s": None,
+    "large_args_calls_per_second": None,
+    "large_args_calls_per_second_inband": None,
     "actors_per_second": 657.0,
     "pgs_per_second": 13.2,
     "tasks_per_second_10k_pending": 364.0,
@@ -205,6 +207,26 @@ def main():
            lambda: ray_tpu.get(
                [fanout_work.remote(servers, n) for _ in range(m)]), m * n)
 
+    # Large-arg call rate: 4 MB numpy arg per actor call.  Default path is
+    # out-of-band (pickle-5 buffers -> one memcpy into the shm arena, arg
+    # passed by reference, executee reads a zero-copy view); the _inband
+    # row forces the whole array through the pickled RPC payload for the
+    # before/after comparison (PERF_PLAN item 3).
+    from ray_tpu.common.config import GLOBAL_CONFIG
+
+    arr4 = np.random.default_rng(0).integers(
+        0, 255, size=4 * 1024 * 1024, dtype=np.uint8)
+    a = Actor.remote()
+    timeit("large_args_calls_per_second",
+           lambda: ray_tpu.get(a.small_value_arg.remote(arr4)))
+    GLOBAL_CONFIG.set_system_config_value("oob_arg_threshold", 0)
+    try:
+        timeit("large_args_calls_per_second_inband",
+               lambda: ray_tpu.get(a.small_value_arg.remote(arr4)))
+    finally:
+        GLOBAL_CONFIG.set_system_config_value("oob_arg_threshold", 256 * 1024)
+    del arr4
+
     # Object-plane bandwidth through the shm store (100 MiB numpy arrays).
     arr = np.zeros(100 * 1024 * 1024 // 8, dtype=np.int64)
     gb = arr.nbytes / 1e9
@@ -215,6 +237,13 @@ def main():
         # in-process store and measure disk spilling instead of put
         last["ref"] = ray_tpu.put(arr)
 
+    # warm the arena spans first: the very first touches of a fresh shm
+    # mapping pay kernel page faults + zeroing (~100x slower than the
+    # steady-state memcpy) — a one-time cost that must not land inside a
+    # timed trial. Honors the name filter like timeit does.
+    if not FILTER or FILTER in "put_gigabytes_per_s":
+        for _ in range(5):
+            put_large()
     timeit("put_gigabytes_per_s", put_large, gb, trials=2, trial_s=1.5,
            unit="GB/s")
     big = last.get("ref")  # unset when a name filter skipped the put row

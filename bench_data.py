@@ -1,7 +1,7 @@
 """GB-scale shuffle proof: distributed hash-partition groupby moving
 multi-GB payloads through the shm object plane WITH SPILLING ENGAGED.
 
-Prints ONE JSON line:
+Prints ONE JSON line and writes it to ``BENCH_data.json``:
     {"metric": "groupby_shuffle_gb_per_min", "value": ..., "unit": ...,
      "rows": {...}, "spilled_bytes": N}
 
@@ -130,6 +130,10 @@ def main() -> int:
         },
     }
     print(json.dumps(result))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_data.json"), "w") as f:
+        json.dump({"results": [result], "source": "bench_data.py"}, f,
+                  indent=2)
     ray_tpu.shutdown()
     if spilled == 0:
         print("WARNING: no bytes spilled — cap too high for this size",

@@ -210,6 +210,15 @@ _D("shm_store_enabled", bool, True, "node-local shared-memory object store")
 _D("shm_direct_put_threshold", int, 1 << 20,
    "puts >= this many framed bytes serialize directly into the shm arena"
    " (plasma create/seal; single memcpy)")
+_D("oob_arg_threshold", int, 256 * 1024,
+   "task/actor args whose pickle-5 out-of-band buffers total >= this many"
+   " bytes are written straight into the shm arena and passed by"
+   " reference: one memcpy end to end, zero-copy views on the executee"
+   " (0 disables; buffer-less or sub-threshold args stay inline)")
+_D("memory_store_shm_threshold", int, 1 << 20,
+   "in-process store hands byte values >= this size to the node shm"
+   " arena (pinned view, zero heap charge) instead of holding them"
+   " on-heap (0 disables routing)")
 _D("shm_store_bytes", int, 512 * 1024 * 1024, "shm object store capacity")
 _D("tpu_chips_per_host", int, 4, "chips exposed per raylet when unprobed")
 _D("tpu_topology", str, "", "slice topology label, e.g. v5e-32")

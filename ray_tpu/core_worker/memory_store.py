@@ -10,6 +10,16 @@ Spilling (reference: raylet/local_object_manager.h:43): when a put would
 exceed the store cap, ready values are spilled largest-first to the external
 storage dir and restored transparently on access
 (AsyncRestoreSpilledObject:125 equivalent).
+
+Large-value routing: byte values at or above ``memory_store_shm_threshold``
+are handed to the node's shm arena (via the router installed by the
+CoreWorker) and held as pinned zero-copy views — no heap charge, shared
+with every process on the node.  A put that still cannot fit after
+spilling is demoted straight to the spill dir instead of raising
+``ObjectStoreFullError``: the store's contract is that a put never fails
+for capacity, it only gets slower (the round-5 GB-shuffle crash was this
+raise surfacing through a reduce task whose single output exceeded the
+whole cap).
 """
 
 from __future__ import annotations
@@ -50,6 +60,10 @@ class MemoryStore:
         self._bytes_used = 0
         self._done_callbacks: Dict[ObjectID, list] = {}
         self._spill_dir: Optional[str] = None
+        # shm router (installed by the CoreWorker once the node store is
+        # probed): bytes -> pinned read-only memoryview over the arena,
+        # or None when the arena can't admit the value right now
+        self._shm_router = None
         # loss forensics (RT_store_debug=1): per-oid event history so an
         # "unknown object" reply can say exactly what happened to the
         # entry instead of inviting guesswork
@@ -127,12 +141,51 @@ class MemoryStore:
         e.spilled_path = None
         return True
 
+    def set_shm_router(self, router) -> None:
+        """``router(object_id_bytes, bytes) -> Optional[memoryview]`` —
+        admit a large value to the node shm arena and return a pinned
+        zero-copy view over it (``None``: keep the value on-heap)."""
+        self._shm_router = router
+
+    def _demote_incoming_locked(self, object_id: ObjectID, value,
+                                size: int) -> Optional[str]:
+        """Last-resort admission for a value that cannot fit the heap cap
+        even after spilling (e.g. a single value larger than the whole
+        cap): write it straight to the spill dir.  Returns the spill path,
+        or None when the disk write itself failed."""
+        path = os.path.join(self._ensure_spill_dir(), object_id.hex())
+        try:
+            with open(path, "wb") as f:
+                f.write(value)
+        except OSError as err:
+            logger.warning("demotion of incoming %s (%d bytes) failed: %s",
+                           object_id.hex()[:12], size, err)
+            return None
+        self._note(object_id, f"demoted_incoming({size})")
+        return path
+
     def put(self, object_id: ObjectID, value: Optional[bytes] = None,
             error: Optional[bytes] = None,
             location: Optional[Tuple[str, int]] = None) -> None:
         size = len(value) if value is not None else 0
         shm_backed = isinstance(value, memoryview)
+        router = self._shm_router
+        route_at = GLOBAL_CONFIG.get("memory_store_shm_threshold")
+        if (router is not None and value is not None and not shm_backed
+                and 0 < route_at <= size):
+            # hand large byte values to the node arena: zero heap charge,
+            # and same-node consumers read the shared pages directly
+            try:
+                view = router(object_id.binary(), value)
+            except Exception:  # noqa: BLE001 — routing is best-effort
+                logger.debug("shm routing of %s failed",
+                             object_id.hex()[:12], exc_info=True)
+                view = None
+            if view is not None:
+                value = view
+                shm_backed = True
         charge = 0 if shm_backed else size  # shm pages aren't heap
+        spilled_path = None
         with self._cv:
             cap = GLOBAL_CONFIG.get("memory_store_max_bytes")
             high = cap * GLOBAL_CONFIG.get("object_spilling_threshold")
@@ -147,12 +200,23 @@ class MemoryStore:
                 # spill down to the configured fullness ratio so later puts
                 # are less likely to pay the spill on their critical path
                 self._spill_locked(int(self._bytes_used + charge - high))
-            if self._bytes_used + charge > cap:
-                raise ObjectStoreFullError(
-                    f"memory store full: {self._bytes_used + charge} > {cap}")
+            if charge and self._bytes_used + charge > cap:
+                # still over: demote THIS value to disk rather than raise —
+                # a put never fails for capacity, it only gets slower.
+                # (charge == 0 entries — errors, locations, shm views —
+                # add no heap and store normally even when the heap is
+                # transiently over cap, e.g. after a forced restore.)
+                spilled_path = self._demote_incoming_locked(
+                    object_id, value, size)
+                if spilled_path is None:
+                    raise ObjectStoreFullError(
+                        f"memory store full ({self._bytes_used + charge} > "
+                        f"{cap}) and the spill dir is unwritable")
+                value, charge = None, 0
             self._entries[object_id] = Entry(
                 value=value, error=error, location=location, is_ready=True,
-                size=size, shm_backed=shm_backed)
+                size=size, shm_backed=shm_backed,
+                spilled_path=spilled_path)
             self._bytes_used += charge
             callbacks = self._done_callbacks.pop(object_id, [])
             self._cv.notify_all()
@@ -271,6 +335,15 @@ class MemoryStore:
                 return {"location": e.location}
             return {}
 
+    def peek_shm_backed(self, object_id: ObjectID) -> bool:
+        """True when a ready entry holds a pinned shm view — WITHOUT
+        restoring a spilled value (used on the post-task release path,
+        where a restore would be pure wasted I/O)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return (e is not None and e.is_ready and e.shm_backed
+                    and e.value is not None)
+
     def peek_location(self, object_id: ObjectID):
         """Location of a ready entry WITHOUT restoring a spilled value
         (used on free paths, where restoring would be wasted I/O)."""
@@ -296,6 +369,20 @@ class MemoryStore:
                             pass
                 # a freed-before-ready object will never fire its callbacks
                 self._done_callbacks.pop(oid, None)
+
+    def drop_shm_views(self) -> None:
+        """Drop every entry whose value is a pinned shm view. Process-exit
+        path: the arena copy is the durable one, and a dead process's pin
+        can never be released — it would make the span unevictable for the
+        life of the arena. The gc.collect runs the views' release
+        finalizers now rather than at interpreter teardown (os._exit
+        skips that)."""
+        import gc
+
+        with self._cv:
+            for oid in [o for o, e in self._entries.items() if e.shm_backed]:
+                del self._entries[oid]
+        gc.collect()
 
     def stats(self) -> dict:
         with self._cv:

@@ -136,9 +136,33 @@ def plan(value: Any):
     return meta, buffers, views, segs, off
 
 
+# Copy threshold for the numpy memcpy path below: tiny segments are
+# cheaper through the plain slice assignment than through two
+# np.frombuffer wrappers.
+_NP_COPY_MIN = 64 * 1024
+
+
+def _copy_segment(out, np_out, off: int, n: int, v) -> None:
+    # memoryview slice assignment copies at ~40% of memcpy speed (it
+    # walks the buffer-protocol shape machinery); np.copyto on flat
+    # uint8 views hits the real memcpy. Measured 2.1 -> 6.2 GB/s on
+    # the 100 MB put row — the object plane is copy-bound, so this IS
+    # the put bandwidth.
+    if np_out is not None and n >= _NP_COPY_MIN:
+        np_out[off:off + n] = _np.frombuffer(v, dtype=_np.uint8)
+    else:
+        out[off:off + n] = v
+
+
 def pack_into(out, meta: bytes, views, segs) -> None:
     """Write the frame into ``out`` (any writable buffer of the planned
     total size). The ONE copy of the payload bytes happens here."""
+    np_out = None
+    if _np_ndarray != () and segs:
+        try:
+            np_out = _np.frombuffer(out, dtype=_np.uint8)
+        except (ValueError, TypeError):  # read-only / exotic buffer
+            np_out = None
     _HEADER.pack_into(out, 0, MAGIC, len(views), len(meta))
     pos = _HEADER.size
     for seg in segs:
@@ -146,7 +170,14 @@ def pack_into(out, meta: bytes, views, segs) -> None:
         pos += _SEG.size
     out[pos:pos + len(meta)] = meta
     for (o, n), v in zip(segs, views):
-        out[o:o + n] = v
+        _copy_segment(out, np_out, o, n, v)
+
+
+def buffer_bytes(segs) -> int:
+    """Total out-of-band payload bytes of a :func:`plan` layout — the
+    quantity OOB eligibility thresholds compare against (meta and frame
+    headers stay in-band either way)."""
+    return sum(n for _, n in segs)
 
 
 def release_buffers(buffers) -> None:

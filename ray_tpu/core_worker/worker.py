@@ -227,6 +227,11 @@ class CoreWorker:
 
         self._shm = False  # False = not probed yet; None = unavailable
         self._shm_probe_lock = threading.Lock()
+        if mode != MODE_DRIVER:
+            # probe eagerly: executee-side zero-copy arg/dependency reads
+            # (_fetch_async) only consult an ALREADY-probed store, and the
+            # first fetch must not silently fall back to an RPC copy
+            _ = self.shm
         self._task_events: list = []
         self._task_events_lock = threading.Lock()
         self._task_events_stop = threading.Event()
@@ -281,7 +286,24 @@ class CoreWorker:
                     except Exception as e:  # noqa: BLE001 — degrade to RPC
                         logger.warning("shm object store unavailable: %s", e)
                 self._shm = probed
+                if probed is not None:
+                    # large byte values land in the shared arena instead
+                    # of this process's heap (memory_store.put routing)
+                    self.memory_store.set_shm_router(self._shm_route)
         return self._shm
+
+    def _shm_route(self, oid_bytes: bytes, value) -> Optional[memoryview]:
+        """MemoryStore router: admit a large byte value to the node arena
+        and hold it as a pinned zero-copy view (None: arena can't take it
+        right now — all spans pinned, or bigger than the whole arena)."""
+        store = self._shm
+        if store in (False, None):
+            return None
+        try:
+            store.put(oid_bytes, value)
+        except OSError:
+            return None
+        return store.get_pinned(oid_bytes)
 
     def _shm_read(self, oid: ObjectID) -> Optional[memoryview]:
         """Zero-copy read: the returned view aliases the store's shared
@@ -362,39 +384,47 @@ class CoreWorker:
             self._put_serialized(oid, value)
         return ObjectRef(oid, self.worker_id, self.server.address)
 
+    def _shm_write_framed(self, oid: ObjectID, meta, views, segs,
+                          total: int) -> Optional[memoryview]:
+        """Serialize a planned frame (see serialization.plan) DIRECTLY
+        into a shm arena span (plasma create/seal two-phase): one memcpy
+        end to end instead of three (staging bytearray zero-fill + frame
+        copy + shm copy). Returns the sealed pinned read-only view, or
+        None when there is no arena / no admissible space."""
+        shm = self.shm
+        if shm is None:
+            return None
+        try:
+            buf = shm.create(oid.binary(), total)
+        except OSError:
+            buf = None
+        if buf is None:
+            return None
+        sealed = False
+        try:
+            _serialization.pack_into(buf, meta, views, segs)
+            del buf  # drop the writable alias before sealing
+            shm.seal(oid.binary())
+            sealed = True
+        finally:
+            if not sealed:
+                shm.abort(oid.binary())
+        return shm.get_pinned(oid.binary())
+
     def _put_serialized(self, oid: ObjectID, value: Any) -> None:
-        """Store a host value. Large buffer-bearing values serialize
-        DIRECTLY into a shm arena span (plasma create/seal two-phase):
-        one memcpy total instead of three (staging bytearray zero-fill +
-        frame copy + shm copy) — on ~1 GB/s-memcpy hosts that is the
-        difference between ~0.3 and ~1 GB/s put bandwidth."""
+        """Store a host value. Large buffer-bearing values take
+        :meth:`_shm_write_framed` — shm-backed entries carry zero heap
+        charge and same-node reads alias the shared pages."""
         _ser = _serialization
 
-        shm = self.shm
         threshold = GLOBAL_CONFIG.get("shm_direct_put_threshold")
         meta, buffers, views, segs, total = _ser.plan(value)
         try:
-            if shm is not None and buffers and total >= threshold:
-                buf = None
-                try:
-                    buf = shm.create(oid.binary(), total)
-                except OSError:
-                    buf = None
-                if buf is not None:
-                    try:
-                        _ser.pack_into(buf, meta, views, segs)
-                        del buf  # drop the writable alias before sealing
-                        shm.seal(oid.binary())
-                    except Exception:
-                        del buf
-                        shm.abort(oid.binary())
-                        raise
-                    view = shm.get_pinned(oid.binary())
-                    if view is not None:
-                        # shm-backed entry: zero heap charge, reads alias
-                        # the shared pages
-                        self.memory_store.put(oid, value=view)
-                        return
+            if buffers and total >= threshold:
+                view = self._shm_write_framed(oid, meta, views, segs, total)
+                if view is not None:
+                    self.memory_store.put(oid, value=view)
+                    return
             if not buffers:
                 self.memory_store.put(oid, value=meta)
                 return
@@ -682,8 +712,13 @@ class CoreWorker:
             return ObjectRefGenerator(self, spec.task_id)
         return refs
 
-    def _serialize_args(self, args: tuple, kwargs: dict) -> List[TaskArg]:
-        """Inline small values; pass ObjectRefs by reference."""
+    def _serialize_args(self, args: tuple, kwargs: dict,
+                        allow_oob: bool = True) -> List[TaskArg]:
+        """Inline small values; pass ObjectRefs — and large buffer-bearing
+        values (out-of-band promotion, see :meth:`_pack_arg`) — by
+        reference. ``allow_oob=False`` keeps every plain value inline
+        (actor CREATION specs: the GCS replays them on restart at any
+        later time, so they must stay self-contained)."""
         out: List[TaskArg] = []
         plain_args = list(args)
         if kwargs:
@@ -700,9 +735,56 @@ class CoreWorker:
                     self._handoff_begin(value.object_id, value.owner_address,
                                         arg.handoff_token)
                 out.append(arg)
+            elif allow_oob:
+                out.append(self._pack_arg(value))
             else:
                 out.append(TaskArg.inline(self.serialize(value)))
         return out
+
+    def _pack_arg(self, value: Any) -> TaskArg:
+        """Serialize one plain task arg. Values whose pickle-5 out-of-band
+        buffers (numpy/JAX host arrays, arrow blocks, explicit
+        ``pickle.PickleBuffer``s — anything whose reduce exports buffers)
+        total >= ``oob_arg_threshold`` are written ONCE into the shm arena
+        (create/seal, one memcpy) and passed by reference: a same-node
+        executee rebuilds them as read-only zero-copy views over the
+        mapped pages; a remote one fetches through the ordinary object
+        plane. The memcpy happens synchronously at submit, so the caller
+        mutating e.g. the source array afterwards cannot corrupt the
+        in-flight args. Buffer-less, sub-threshold, non-contiguous and
+        object-dtype values (whose pickles export no buffers) stay
+        inline — the unchanged slow path."""
+        _ser = _serialization
+        meta, buffers, views, segs, total = _ser.plan(value)
+        try:
+            if not buffers:
+                return TaskArg.inline(meta)
+            threshold = GLOBAL_CONFIG.get("oob_arg_threshold")
+            if threshold > 0 and _ser.buffer_bytes(segs) >= threshold:
+                oid = ObjectID.for_put(self.current_task_id(),
+                                       self.next_put_index())
+                view = self._shm_write_framed(oid, meta, views, segs, total)
+                if view is not None:
+                    self.memory_store.put(oid, value=view)
+                    return self._oob_ref_arg(oid)
+            out = bytearray(total)
+            _ser.pack_into(out, meta, views, segs)
+            return TaskArg.inline(bytes(out))
+        finally:
+            _ser.release_buffers(buffers)
+
+    def _oob_ref_arg(self, oid: ObjectID) -> TaskArg:
+        """By-ref TaskArg for an implicitly promoted arg value. The owner
+        record starts with local=0 — no user-facing ObjectRef exists, so
+        the handoff guard is the only hold and the value frees exactly
+        when the consuming task completes (terminally)."""
+        arg = TaskArg.by_ref(oid, self.worker_id)
+        arg.owner_address = self.server.address
+        arg.handoff_token = os.urandom(8)
+        with self._ref_lock:
+            self._register_handoff_locked(
+                self._owned_state_for_message(oid), arg.handoff_token)
+        return arg
 
     # --------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, resources=None, label_selector=None,
@@ -720,7 +802,7 @@ class CoreWorker:
             function=FunctionDescriptor(
                 getattr(cls, "__module__", "?"), getattr(cls, "__qualname__", str(cls))),
             serialized_func=cloudpickle.dumps(cls),
-            args=self._serialize_args(args, kwargs),
+            args=self._serialize_args(args, kwargs, allow_oob=False),
             num_returns=0,
             required_resources=ResourceRequest(resources or {}, label_selector),
             scheduling_strategy=scheduling_strategy or DefaultStrategy(),
@@ -750,6 +832,10 @@ class CoreWorker:
         # (args, kwargs) as ONE payload; by-ref args need the TaskArg
         # handoff protocol and take the general path. Streaming tasks take
         # the general path (the fastspec buffer has no streaming field).
+        # Large buffer-bearing bundles promote out-of-band (_pack_arg):
+        # the whole _FastArgs lands in the shm arena and ships by ref —
+        # one memcpy beats pickling MBs through the socket even though it
+        # forfeits the fastloop channel for that call.
         fast_payload = None
         if not streaming and not any(isinstance(v, ObjectRef) for v in args) and \
                 not any(isinstance(v, ObjectRef) for v in kwargs.values()):
@@ -759,10 +845,12 @@ class CoreWorker:
                 if fast_payload is None:
                     fast_payload = self._empty_args_payload = \
                         self.serialize(_FastArgs((), {}))
+                task_args = [TaskArg.inline(fast_payload)]
             else:
-                fast_payload = self.serialize(
-                    _FastArgs(tuple(args), dict(kwargs)))
-            task_args = [TaskArg.inline(fast_payload)]
+                arg = self._pack_arg(_FastArgs(tuple(args), dict(kwargs)))
+                if arg.is_inline:
+                    fast_payload = arg.value
+                task_args = [arg]
         else:
             task_args = self._serialize_args(args, kwargs)
         spec = TaskSpec(
@@ -1283,6 +1371,12 @@ class CoreWorker:
     async def h_exit_worker(self):
         def die():
             time.sleep(0.1)
+            try:
+                # release shm pins (the arena copies stay; only the pins
+                # must not outlive this process)
+                self.memory_store.drop_shm_views()
+            except Exception:  # noqa: BLE001 — exit anyway
+                pass
             os._exit(0)
         threading.Thread(target=die, daemon=True).start()
         return True
@@ -1698,6 +1792,7 @@ class CoreWorker:
                     "the actor call was cancelled while running"))
             except Exception as e:  # noqa: BLE001 - user method error
                 reply = self._error_reply(task, e)
+        self._release_arg_copies(task)
         self._seq_finish(caller, seq, reply)
         return reply
 
@@ -1725,6 +1820,7 @@ class CoreWorker:
                     self._actor_has_async = any(
                         inspect.iscoroutinefunction(getattr(inst, m, None))
                         for m in dir(inst) if not m.startswith("__"))
+                self._release_arg_copies(task)
                 return None
             except Exception as e:  # noqa: BLE001
                 return (e, traceback.format_exc())
@@ -1776,6 +1872,7 @@ class CoreWorker:
                     reply = self._execute_fn_task(task)
         finally:
             self._running_tasks.pop(tid, None)
+            self._release_arg_copies(task)
         self._record_task_event(task, start, time.time(), reply)
         return reply
 
@@ -1960,6 +2057,26 @@ class CoreWorker:
                 args.append(value)
         return args, kwargs
 
+    def _release_arg_copies(self, task: TaskSpec) -> None:
+        """Executee side, post-execution: drop the same-node shm views this
+        process fetched for the task's by-ref args. The store pin must not
+        outlive the call — the owner's later delete cannot reclaim a span
+        some worker still pins, and accumulated dead pins eventually eat
+        the whole arena (each re-get is just a map + pin, no copy, so
+        dropping the cache costs ~µs on a repeat arg). Arrays the user
+        kept alive keep their own per-alias pins; heap copies (fetched
+        from REMOTE nodes over RPC) stay cached — re-fetching those is a
+        network copy, not a map."""
+        for arg in task.args:
+            if arg.is_inline or arg.object_id is None:
+                continue
+            owner_addr = getattr(arg, "owner_address", None)
+            if owner_addr is not None and \
+                    tuple(owner_addr) == self.server.address:
+                continue  # we own it: canonical entry, not a fetched copy
+            if self.memory_store.peek_shm_backed(arg.object_id):
+                self.memory_store.free([arg.object_id])
+
     def _get_dependency(self, arg: TaskArg) -> Any:
         oid = arg.object_id
         last_err = None
@@ -2033,26 +2150,16 @@ class CoreWorker:
         backpressures this loop — and therefore the user generator —
         with no extra protocol."""
         client = RpcClient(tuple(task.caller_address))
-        threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
         index = 0
         try:
             try:
                 for item in self._as_sync_iter(result):
-                    blob = self.serialize(item)
-                    if len(blob) <= threshold:
-                        payload = {"value": blob}
-                    else:
-                        oid = ObjectID.from_index(task.task_id, index + 1)
-                        self.memory_store.put(oid, value=blob)
-                        if self.shm is not None:
-                            try:
-                                # node-durable like task returns: a lazily
-                                # consumed stream outlives this worker's
-                                # idle TTL routinely
-                                self.shm.put_or_spill(oid.binary(), blob)
-                            except OSError:
-                                pass  # no shm and no spill dir
-                        payload = {"location": self.server.address}
+                    # same storage path as ordinary task returns (small
+                    # inline; large into the arena / node spill dir — a
+                    # lazily consumed stream outlives this worker's idle
+                    # TTL routinely)
+                    payload = self._pack_result(
+                        ObjectID.from_index(task.task_id, index + 1), item)
                     reply = client.call(
                         "report_generator_item", timeout=None,
                         task_id=task.task_id.binary(), index=index,
@@ -2189,7 +2296,6 @@ class CoreWorker:
                 "expected 'device'"))
         results = {}
         stored_device: List[ObjectID] = []
-        threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
         for oid, value in zip(task.return_ids(), values):
             if tensor_transport == "device":
                 # keep the tensors in THIS process's HBM; ship a marker.
@@ -2207,22 +2313,43 @@ class CoreWorker:
                 stored_device.append(oid)
                 results[oid.binary()] = {"location": self.server.address}
                 continue
-            blob = self.serialize(value)
-            if len(blob) <= threshold:
-                results[oid.binary()] = {"value": blob}
-            else:
-                self.memory_store.put(oid, value=blob)
-                if self.shm is not None:
-                    try:
-                        # node-durable: arena or node spill dir — the
-                        # primary copy must outlive THIS worker (idle
-                        # reap between produce and fetch is routine in
-                        # long pipelines)
-                        self.shm.put_or_spill(oid.binary(), blob)
-                    except OSError:  # no shm AND no spill dir writable
-                        pass
-                results[oid.binary()] = {"location": self.server.address}
+            results[oid.binary()] = self._pack_result(oid, value)
         return {"results": results}
+
+    def _pack_result(self, oid: ObjectID, value: Any) -> dict:
+        """Store one task output; returns its reply payload. Small frames
+        ship inline in the reply. Large buffer-bearing values serialize
+        DIRECTLY into the shm arena (one memcpy, zero heap, node-durable
+        — same path as ray.put and OOB args, so GB-scale data blocks ride
+        it too); large buffer-less values keep the heap + put_or_spill
+        fallback (the primary copy must outlive THIS worker: idle reap
+        between produce and fetch is routine in long pipelines)."""
+        _ser = _serialization
+        threshold = GLOBAL_CONFIG.get("max_direct_call_object_size")
+        meta, buffers, views, segs, total = _ser.plan(value)
+        try:
+            if buffers and total > threshold:
+                view = self._shm_write_framed(oid, meta, views, segs, total)
+                if view is not None:
+                    self.memory_store.put(oid, value=view)
+                    return {"location": self.server.address}
+            if buffers:
+                out = bytearray(total)
+                _ser.pack_into(out, meta, views, segs)
+                blob = bytes(out)
+            else:
+                blob = meta
+        finally:
+            _ser.release_buffers(buffers)
+        if len(blob) <= threshold:
+            return {"value": blob}
+        self.memory_store.put(oid, value=blob)
+        if self.shm is not None:
+            try:
+                self.shm.put_or_spill(oid.binary(), blob)
+            except OSError:  # no shm AND no spill dir writable
+                pass
+        return {"location": self.server.address}
 
     def _error_reply(self, task: TaskSpec, exc: Exception) -> dict:
         tb = traceback.format_exc()
@@ -2243,6 +2370,18 @@ class CoreWorker:
         CoreWorker._current = None
         install_release_sink(None)
         install_borrow_sinks(None, None)
+        # drop pinned arena views, then unmap and free the handle slot:
+        # the per-process handle table is fixed-size, and a process that
+        # init/shutdown-cycles the runtime (test suites) must not leak a
+        # slot per session
+        if self._shm not in (False, None):
+            store, self._shm = self._shm, None
+            try:
+                self.memory_store.drop_shm_views()
+                store.close()
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
+        self.memory_store.set_shm_router(None)
         self._task_events_stop.set()
         try:
             self._flush_task_events()

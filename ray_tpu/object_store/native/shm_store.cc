@@ -85,6 +85,12 @@ constexpr int kMaxStores = 64;
 Store g_stores[kMaxStores];
 int g_num_stores = 0;
 
+// A slot whose hdr is null was closed (rts_close) and may be reused by
+// the next rts_create; every accessor must reject it.
+bool ValidHandle(int h) {
+  return h >= 0 && h < g_num_stores && g_stores[h].hdr != nullptr;
+}
+
 uint64_t HashId(const uint8_t* id, uint8_t len) {
   // FNV-1a
   uint64_t h = 1469598103934665603ULL;
@@ -224,8 +230,18 @@ int EvictLocked(Header* hdr, uint64_t need) {
 extern "C" {
 
 // Create (or open existing) store; returns handle >= 0, or -errno.
+// Handle slots freed by rts_close are reused — long-lived processes
+// that repeatedly open/close arenas (test harnesses, notebooks) must
+// not exhaust the fixed table.
 int rts_create(const char* name, uint64_t capacity) {
-  if (g_num_stores >= kMaxStores) return -ENOMEM;
+  int slot = -1;
+  for (int i = 0; i < g_num_stores; i++) {
+    if (g_stores[i].hdr == nullptr) {
+      slot = i;
+      break;
+    }
+  }
+  if (slot < 0 && g_num_stores >= kMaxStores) return -ENOMEM;
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0666);
   bool creator = fd >= 0;
   if (!creator) {
@@ -283,7 +299,7 @@ int rts_create(const char* name, uint64_t capacity) {
     __sync_synchronize();
     hdr->magic = kMagic;
   }
-  int h = g_num_stores++;
+  int h = slot >= 0 ? slot : g_num_stores++;
   g_stores[h] = {hdr, (uint8_t*)mem + kDataOffset, map_size};
   return h;
 }
@@ -296,10 +312,25 @@ int rts_open(const char* name) {
   return rts_create(name, 0);
 }
 
+// Unmap this process's view of the store and free the handle slot for
+// reuse. The shared segment itself (and other processes' mappings) are
+// untouched — pair with rts_unlink to destroy the segment. Any pins
+// this process still holds are abandoned; callers release them first.
+int rts_close(int h) {
+  if (!ValidHandle(h)) return -EINVAL;
+  Store& st = g_stores[h];
+  munmap((void*)st.hdr, st.map_size);
+  st.hdr = nullptr;
+  st.base = nullptr;
+  st.map_size = 0;
+  st.autoevict = 1;
+  return 0;
+}
+
 // 0 ok; -EEXIST; -ENOSPC (even after eviction); -EINVAL.
 int rts_put(int h, const uint8_t* id, uint32_t id_len,
             const uint8_t* data, uint64_t size) {
-  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return -EINVAL;
+  if (!ValidHandle(h) || id_len > kIdBytes) return -EINVAL;
   Store& st = g_stores[h];
   Header* hdr = st.hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
@@ -346,7 +377,7 @@ int rts_put(int h, const uint8_t* id, uint32_t id_len,
 // frees the span of a failed write.
 uint8_t* rts_create_unsealed(int h, const uint8_t* id, uint32_t id_len,
                              uint64_t size) {
-  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return nullptr;
+  if (!ValidHandle(h) || id_len > kIdBytes) return nullptr;
   Store& st = g_stores[h];
   Header* hdr = st.hdr;
   if (LockHeld(hdr) != 0) return nullptr;
@@ -388,7 +419,7 @@ uint8_t* rts_create_unsealed(int h, const uint8_t* id, uint32_t id_len,
 }
 
 int rts_seal(int h, const uint8_t* id, uint32_t id_len) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
@@ -403,7 +434,7 @@ int rts_seal(int h, const uint8_t* id, uint32_t id_len) {
 }
 
 int rts_abort(int h, const uint8_t* id, uint32_t id_len) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
@@ -419,7 +450,7 @@ int rts_abort(int h, const uint8_t* id, uint32_t id_len) {
 // Returns pointer into this process's mapping (pinned), or NULL.
 const uint8_t* rts_get(int h, const uint8_t* id, uint32_t id_len,
                        uint64_t* size_out) {
-  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return nullptr;
+  if (!ValidHandle(h) || id_len > kIdBytes) return nullptr;
   Store& st = g_stores[h];
   Header* hdr = st.hdr;
   if (LockHeld(hdr) != 0) return nullptr;
@@ -437,7 +468,7 @@ const uint8_t* rts_get(int h, const uint8_t* id, uint32_t id_len,
 }
 
 int rts_release(int h, const uint8_t* id, uint32_t id_len) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
@@ -475,7 +506,7 @@ int rts_release(int h, const uint8_t* id, uint32_t id_len) {
 // lookup a hash-chain probe rather than a table scan.
 int rts_release_addr(int h, const uint8_t* id, uint32_t id_len,
                      const uint8_t* ptr) {
-  if (h < 0 || h >= g_num_stores || id_len > kIdBytes) return -EINVAL;
+  if (!ValidHandle(h) || id_len > kIdBytes) return -EINVAL;
   Store& st = g_stores[h];
   Header* hdr = st.hdr;
   if (ptr < st.base) return -EINVAL;
@@ -498,7 +529,7 @@ int rts_release_addr(int h, const uint8_t* id, uint32_t id_len,
 }
 
 int rts_contains(int h, const uint8_t* id, uint32_t id_len) {
-  if (h < 0 || h >= g_num_stores) return 0;
+  if (!ValidHandle(h)) return 0;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return 0;
   int found = FindEntry(hdr, id, (uint8_t)id_len) != nullptr;
@@ -507,7 +538,7 @@ int rts_contains(int h, const uint8_t* id, uint32_t id_len) {
 }
 
 int rts_delete(int h, const uint8_t* id, uint32_t id_len) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* e = FindEntry(hdr, id, (uint8_t)id_len);
@@ -531,7 +562,7 @@ int rts_delete(int h, const uint8_t* id, uint32_t id_len) {
 // With it disabled the caller runs the spill-before-evict loop (shm.py):
 // rts_lru_candidate -> copy bytes to disk -> rts_delete -> retry.
 int rts_set_autoevict(int h, int enabled) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   g_stores[h].autoevict = enabled ? 1 : 0;
   return 0;
 }
@@ -539,7 +570,7 @@ int rts_set_autoevict(int h, int enabled) {
 // Id of the current LRU sealed refcount-0 object (the next eviction
 // victim).  0 on success; -ENOENT when nothing is evictable.
 int rts_lru_candidate(int h, uint8_t* out_id, uint32_t* out_id_len) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   Entry* victim = nullptr;
@@ -561,7 +592,7 @@ int rts_lru_candidate(int h, uint8_t* out_id, uint32_t* out_id_len) {
 
 int rts_stats(int h, uint64_t* capacity, uint64_t* used,
               uint64_t* num_objects) {
-  if (h < 0 || h >= g_num_stores) return -EINVAL;
+  if (!ValidHandle(h)) return -EINVAL;
   Header* hdr = g_stores[h].hdr;
   if (LockHeld(hdr) != 0) return -EINVAL;
   *capacity = hdr->capacity;

@@ -73,6 +73,8 @@ def _load():
     lib.rts_lru_candidate.restype = ctypes.c_int
     lib.rts_unlink.argtypes = [ctypes.c_char_p]
     lib.rts_unlink.restype = ctypes.c_int
+    lib.rts_close.argtypes = [ctypes.c_int]
+    lib.rts_close.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -101,6 +103,10 @@ class ShmObjectStore:
         if h < 0:
             raise OSError(-h, f"shm store {name!r}: {os.strerror(-h)}")
         self._h = h
+        # liveness cell shared with get_pinned finalizers: once close()
+        # flips it, stale finalizers become no-ops instead of releasing
+        # by address against whatever NEW arena reused this handle slot
+        self._alive = [True]
         # pins taken via get(): id -> mapped addresses, so release() can
         # name the exact span even after a delete + re-put of the id
         self._pins: dict = {}
@@ -286,8 +292,16 @@ class ShmObjectStore:
             return None
         addr = ctypes.addressof(ptr.contents)
         owner = (ctypes.c_ubyte * size.value).from_address(addr)
-        weakref.finalize(owner, self._lib.rts_release_addr, self._h,
-                         bytes(object_id), len(object_id), addr)
+
+        def _release(lib=self._lib, h=self._h, oid=bytes(object_id),
+                     a=addr, alive=self._alive):
+            # guard against handle-slot reuse: after close() this handle
+            # may name a DIFFERENT arena, and a by-address release there
+            # would decrement an unrelated live object's pin
+            if alive[0]:
+                lib.rts_release_addr(h, oid, len(oid), a)
+
+        weakref.finalize(owner, _release)
         return memoryview(owner).cast("B").toreadonly()
 
     def release(self, object_id: bytes) -> None:
@@ -317,6 +331,21 @@ class ShmObjectStore:
         self._lib.rts_stats(self._h, ctypes.byref(cap), ctypes.byref(used),
                             ctypes.byref(num))
         return cap.value, used.value, num.value
+
+    def close(self) -> None:
+        """Unmap this process's view and free the handle slot for reuse.
+        The shared segment (and other processes) are untouched. The
+        per-process handle table is FIXED SIZE (64): a long-lived process
+        that repeatedly opens arenas without closing them — e.g. a test
+        harness init/shutdown-cycling the runtime — exhausts it and every
+        later session silently loses its object plane. Pins still held by
+        surviving views are abandoned (their finalizers are disarmed via
+        the liveness cell, so slot reuse can never misroute a by-address
+        release into a different arena)."""
+        self._alive[0] = False
+        h, self._h = self._h, -1
+        if h >= 0:
+            self._lib.rts_close(h)
 
     def unlink(self):
         self._lib.rts_unlink(self.name)

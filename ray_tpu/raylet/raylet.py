@@ -321,6 +321,13 @@ class Raylet:
 
     def stop(self):
         self._stopped = True
+        store = getattr(self, "_shm_stats_store", None)
+        if store is not None:
+            self._shm_stats_store = None
+            try:
+                store.close()  # free the fixed-size per-process handle slot
+            except Exception:  # noqa: BLE001
+                pass
         for t in self._bg_tasks:
             t.cancel()
         for w in list(self._workers.values()):

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Rebuild the native extensions when their C/C++ sources are newer than
+# the cached .so files, and FAIL LOUDLY if a build breaks.
+#
+# The runtime loaders (rpc/native/__init__.py, object_store/shm.py) also
+# rebuild on stale mtimes, but they swallow compile errors and fall back
+# to pure-Python paths — which silently masks codec changes: a test run
+# against a stale or unbuildable .so measures the wrong code.  This
+# script is the loud front door: invoked from the tier-1 conftest (and
+# usable standalone) so a broken native build fails the session instead
+# of degrading it.
+#
+# Usage: scripts/build_natives.sh   (exit 0 = all natives fresh and loadable)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python - <<'EOF'
+import os
+import sys
+
+# The loaders compare source vs .so mtimes and rebuild as needed; they
+# cache failures as None.  Import and demand success for every native
+# the runtime ships.
+failures = []
+
+from ray_tpu.rpc import native as rpc_native
+
+for name, loader, so in (
+        ("fastspec", rpc_native.load_fastspec, rpc_native._SO),
+        ("fastloop", rpc_native.load_fastloop, rpc_native._FL_SO)):
+    mod = loader()
+    if mod is None:
+        failures.append(name)
+    else:
+        print(f"ok: {name} -> {os.path.basename(so)} "
+              f"(mtime {os.path.getmtime(so):.0f})")
+
+try:
+    from ray_tpu.object_store import shm as shm_mod
+
+    so = shm_mod._ensure_built()  # raises CalledProcessError on a bad build
+    shm_mod._load()
+    print(f"ok: shm_store -> {os.path.basename(so)} "
+          f"(mtime {os.path.getmtime(so):.0f})")
+except Exception as e:  # noqa: BLE001
+    failures.append(f"shm_store ({e})")
+
+if failures:
+    print("FAILED natives:", ", ".join(failures), file=sys.stderr)
+    sys.exit(1)
+EOF

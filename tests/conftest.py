@@ -26,6 +26,27 @@ assert jax.device_count() == 8
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _fresh_natives():
+    """Rebuild stale native extensions BEFORE any test imports them.
+
+    The runtime loaders rebuild on mtime staleness but swallow compile
+    errors and fall back to pure-Python paths — a session running against
+    a stale or unbuildable .so silently measures the wrong codec.  The
+    script fails loudly instead; a broken native build should fail the
+    session, not degrade it."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "build_natives.sh")
+    proc = subprocess.run(["bash", script], capture_output=True, text=True,
+                          timeout=300)
+    if proc.returncode != 0:
+        pytest.exit(f"native extension build failed:\n{proc.stdout}"
+                    f"\n{proc.stderr}", returncode=3)
+    yield
+
+
 @pytest.fixture
 def local_cluster():
     """A started single-node framework instance, shut down after the test."""

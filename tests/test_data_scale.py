@@ -20,28 +20,44 @@ from ray_tpu.common.config import GLOBAL_CONFIG
 
 @pytest.fixture()
 def capped_cluster(tmp_path):
-    """Cluster whose in-process store cap is tiny and whose spill dir is
-    observable."""
+    """Cluster whose object plane is tiny and whose spill dir is
+    observable. BOTH stores are capped: large values now live in the shm
+    arena (zero heap charge — memory_store routing + arena-direct task
+    returns), so heap-cap pressure alone no longer forces any spilling;
+    the arena cap is what drives the spill-before-evict path this test
+    exists to exercise."""
     spill_root = str(tmp_path / "spill")
     os.makedirs(spill_root, exist_ok=True)
     os.environ["RT_object_spilling_dir"] = spill_root
     os.environ["RT_memory_store_max_bytes"] = str(24 << 20)
+    os.environ["RT_shm_store_bytes"] = str(32 << 20)
     GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", spill_root)
     GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes", 24 << 20)
+    GLOBAL_CONFIG.set_system_config_value("shm_store_bytes", 32 << 20)
+    GLOBAL_CONFIG.reset_cache()
     ray_tpu.init(num_cpus=4, num_tpus=0)
     yield ray_tpu, spill_root
     ray_tpu.shutdown()
     os.environ.pop("RT_object_spilling_dir", None)
     os.environ.pop("RT_memory_store_max_bytes", None)
+    os.environ.pop("RT_shm_store_bytes", None)
     GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", "")
     GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes",
                                           512 * 1024 * 1024)
+    GLOBAL_CONFIG.set_system_config_value("shm_store_bytes",
+                                          512 * 1024 * 1024)
+    GLOBAL_CONFIG.reset_cache()
 
 
 def _spilled_bytes(root: str) -> int:
-    return sum(os.path.getsize(p)
-               for pat in ("rt_spill_*", "rtshm_spill_*")
-               for p in glob.glob(os.path.join(root, pat, "*")))
+    total = 0
+    for pat in ("rt_spill_*", "rtshm_spill_*"):
+        for p in glob.glob(os.path.join(root, pat, "*")):
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass  # freed objects drop their spill files concurrently
+    return total
 
 
 def test_groupby_shuffle_with_spilling(capped_cluster):

@@ -1,0 +1,139 @@
+"""Out-of-band (pickle-5 → shm arena) task/actor args + large-value
+memory-store routing (PERF_PLAN item 3).
+
+Contract under test:
+- args whose out-of-band buffers exceed ``oob_arg_threshold`` are written
+  once into the shm arena and passed by reference; the executee rebuilds
+  them as READ-ONLY zero-copy views over the mapped pages;
+- the memcpy into the arena happens at submit time, so mutating the
+  caller's array after ``.remote(...)`` cannot corrupt the in-flight args;
+- buffer-less / non-contiguous / object-dtype values (no pickle-5
+  buffers) keep the inline slow path and still round-trip;
+- the in-process store demotes a value it cannot hold to disk instead of
+  raising ObjectStoreFullError.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.common.config import GLOBAL_CONFIG
+
+
+@pytest.fixture()
+def cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0)
+class Probe:
+    def inspect(self, arr):
+        return {
+            "writeable": bool(arr.flags.writeable),
+            "sum": float(arr.sum()),
+            "kind": arr.dtype.kind,
+        }
+
+    def sum_arg(self, arr):
+        return float(arr.sum())
+
+
+class TestArgPromotion:
+    def test_large_array_promotes_to_by_ref(self, cluster):
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        cw = CoreWorker.current_or_raise()
+        big = np.ones(2 * 1024 * 1024, dtype=np.uint8)  # 2 MB > threshold
+        small = np.ones(64, dtype=np.uint8)
+        args = cw._serialize_args((big,), {})
+        assert not args[0].is_inline
+        assert args[0].handoff_token is not None
+        args = cw._serialize_args((small,), {})
+        assert args[0].is_inline
+
+    def test_noncontiguous_and_object_dtype_stay_inline(self, cluster):
+        from ray_tpu.core_worker.worker import CoreWorker
+
+        cw = CoreWorker.current_or_raise()
+        big = np.ones((2048, 2048), dtype=np.uint8)
+        # non-contiguous slice: numpy's pickle exports no out-of-band
+        # buffer, so it cannot promote — must stay inline
+        assert cw._serialize_args((big[::2, ::2],), {})[0].is_inline
+        objs = np.array([{"k": 1}] * 10, dtype=object)
+        assert cw._serialize_args((objs,), {})[0].is_inline
+
+    def test_executee_view_is_read_only_and_correct(self, cluster):
+        p = Probe.remote()
+        arr = np.arange(1 << 20, dtype=np.int64)  # 8 MB: promoted
+        out = ray_tpu.get(p.inspect.remote(arr), timeout=60)
+        assert out["sum"] == float(arr.sum())
+        # zero-copy views over the arena are read-only (plasma property)
+        assert out["writeable"] is False
+
+    def test_caller_mutation_after_submit_is_isolated(self, cluster):
+        p = Probe.remote()
+        arr = np.ones(1 << 21, dtype=np.uint8)  # 2 MB
+        expect = float(arr.sum())
+        refs = [p.sum_arg.remote(arr) for _ in range(4)]
+        arr[:] = 0  # mutate while calls are in flight
+        for r in refs:
+            assert ray_tpu.get(r, timeout=60) == expect
+
+    def test_fallback_noncontiguous_roundtrip(self, cluster):
+        p = Probe.remote()
+        base = np.arange(4 * 1024 * 1024, dtype=np.int64).reshape(2048, -1)
+        view = base[::2, ::2]  # big but non-contiguous: slow path
+        out = ray_tpu.get(p.inspect.remote(view), timeout=60)
+        assert out["sum"] == float(view.sum())
+
+    def test_kwargs_promote_too(self, cluster):
+        p = Probe.remote()
+        arr = np.full(1 << 20, 3, dtype=np.int64)
+        assert ray_tpu.get(p.sum_arg.remote(arr=arr),
+                           timeout=60) == float(arr.sum())
+
+
+class TestZeroCopyGet:
+    def test_two_gets_alias_the_same_arena_pages(self, cluster):
+        arr = np.arange(1 << 21, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        got1 = ray_tpu.get(ref)
+        got2 = ray_tpu.get(ref)
+        np.testing.assert_array_equal(got1, arr)
+        # both reads alias the SAME shared pages — the zero-copy property
+        assert np.shares_memory(got1, got2)
+        assert not got1.flags.writeable
+
+    def test_mutation_requires_explicit_copy(self, cluster):
+        ref = ray_tpu.put(np.zeros(1 << 21, dtype=np.uint8))
+        got = ray_tpu.get(ref)
+        with pytest.raises(ValueError):
+            got[0] = 1
+        cop = got.copy()
+        cop[0] = 1  # promote-to-copy is explicit and works
+        assert cop[0] == 1 and got[0] == 0
+
+
+class TestStoreDemotion:
+    def test_put_larger_than_cap_demotes_instead_of_raising(self, tmp_path):
+        from ray_tpu.common.ids import ObjectID
+        from ray_tpu.core_worker.memory_store import MemoryStore
+
+        GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes",
+                                              1 << 20)
+        GLOBAL_CONFIG.set_system_config_value("object_spilling_dir",
+                                              str(tmp_path))
+        try:
+            store = MemoryStore()
+            oid = ObjectID(b"x" * ObjectID.SIZE)
+            blob = b"v" * (2 << 20)  # single value 2x the whole cap
+            store.put(oid, value=blob)  # must NOT raise
+            entry = store.get_if_ready(oid)
+            assert entry is not None and entry.value == blob
+        finally:
+            GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes",
+                                                  512 * 1024 * 1024)
+            GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", "")

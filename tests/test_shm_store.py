@@ -174,3 +174,37 @@ os._exit(9)
         view = store.get(b"after-crash" + b"0" * 17)
         assert bytes(view) == b"ok"
         store.release(b"after-crash" + b"0" * 17)
+
+
+class TestHandleRecycling:
+    def test_close_frees_handle_slot_for_reuse(self):
+        """The per-process handle table is fixed at 64 slots; close()
+        must recycle them — a process that open/close-cycles arenas
+        (init/shutdown loops in one test run) used to exhaust the table
+        and silently lose its object plane for every later session."""
+        from ray_tpu.object_store.shm import ShmObjectStore, unlink
+
+        name = "/rt_test_slot_recycle"
+        for i in range(80):  # > kMaxStores
+            unlink(name)
+            store = ShmObjectStore(name, capacity=1 << 20)
+            try:
+                assert store.put(b"k" * 8, b"payload-%d" % i)
+                view = store.get(b"k" * 8)
+                assert bytes(view).startswith(b"payload-")
+                store.release(b"k" * 8)
+            finally:
+                store.close()
+        unlink(name)
+
+    def test_closed_handle_operations_are_rejected(self):
+        from ray_tpu.object_store.shm import ShmObjectStore, unlink
+
+        name = "/rt_test_closed"
+        unlink(name)
+        store = ShmObjectStore(name, capacity=1 << 20)
+        store.close()
+        assert store.get(b"k" * 8) is None
+        with pytest.raises(OSError):
+            store.put(b"k" * 8, b"v")
+        unlink(name)
