@@ -62,9 +62,18 @@ class GcsStandby:
         self._ever_synced = False  # at least one successful poll
         self.leader_epoch: Optional[int] = None  # set at promotion
         self._failures = 0
+        # compaction refill: while the primary's post-compaction log is
+        # being refetched, new-generation bytes land in a SIDE file and
+        # the last complete generation stays promotable at _log_path
+        self._next_path = self._log_path + ".next"
+        self._refilling = False
         # test hook: simulate a standby↔primary partition (polls fail while
         # the primary stays up and reachable by everyone else)
         self._testing_drop_polls = False
+        # test hook: threading.Event the replication loop blocks on right
+        # after observing a compaction restart marker — lets tests kill
+        # the primary deterministically inside the refetch window
+        self._testing_refill_gate = None
         self._stop = threading.Event()
         self.promoted = threading.Event()
         self.server = None  # the promoted GcsServer
@@ -91,9 +100,10 @@ class GcsStandby:
 
     # ------------------------------------------------------------ replication
     def _run(self):
-        # fresh replica: drop any stale log from a previous incarnation
-        if os.path.exists(self._log_path):
-            os.unlink(self._log_path)
+        # fresh replica: drop any stale logs from a previous incarnation
+        for path in (self._log_path, self._next_path):
+            if os.path.exists(path):
+                os.unlink(path)
         log = open(self._log_path, "ab")
         client = RetryableRpcClient(self.primary_address, deadline_s=2.0)
         try:
@@ -112,11 +122,23 @@ class GcsStandby:
                             "primary GCS has no persistence; standby can "
                             "only fail over to an empty control plane")
                     elif chunk.get("restart"):
-                        # primary compacted: restart the stream
+                        # Primary compacted: restart the stream — into a
+                        # SIDE file. Truncating the replica in place would
+                        # open a window (compaction observed → first new
+                        # chunk landed) where a primary death promotes an
+                        # EMPTY control plane, losing acknowledged writes.
+                        # The last complete generation stays promotable at
+                        # _log_path until the new one has fully landed.
                         log.close()
-                        log = open(self._log_path, "wb")
+                        log = open(self._next_path, "wb")
+                        self._refilling = True
                         self._offset = 0
                         self._generation = chunk["generation"]
+                        gate = self._testing_refill_gate
+                        if gate is not None:
+                            while not gate.is_set() \
+                                    and not self._stop.is_set():
+                                gate.wait(0.05)
                         continue  # refetch immediately from 0
                     else:
                         self._generation = chunk["generation"]
@@ -125,8 +147,25 @@ class GcsStandby:
                             log.write(data)
                             log.flush()
                             self._offset += len(data)
-                            if len(data) == (1 << 20):
-                                continue  # more buffered: drain fast
+                        if self._refilling and len(data) < (1 << 20) \
+                                and self._offset > 0:
+                            # caught up with the live end of the new
+                            # generation: atomically swap it in. The
+                            # offset>0 guard keeps a transient empty
+                            # chunk (primary-side read hiccup) from
+                            # swapping in an EMPTY replica — the exact
+                            # hole this path exists to close. (A
+                            # genuinely empty compacted log stays
+                            # unswapped: promoting the retained
+                            # generation may resurrect recently deleted
+                            # keys, which async replication tolerates;
+                            # promoting emptiness loses everything.)
+                            log.close()
+                            os.replace(self._next_path, self._log_path)
+                            log = open(self._log_path, "ab")
+                            self._refilling = False
+                        if len(data) == (1 << 20):
+                            continue  # more buffered: drain fast
                 except Exception:  # noqa: BLE001 — probe failure
                     self._failures += 1
                     logger.info("standby: primary probe failed (%d/%d)",
@@ -145,6 +184,20 @@ class GcsStandby:
                             self._stop.wait(self._poll_interval_s)
                             continue
                         log.close()
+                        if self._refilling:
+                            # Refuse to promote the half-refilled next
+                            # generation (a partial compacted log is a
+                            # SUBSET of committed keys); fall back to the
+                            # retained last-complete generation.
+                            logger.warning(
+                                "standby: primary died mid-compaction "
+                                "refill; promoting from the retained "
+                                "previous generation")
+                            try:
+                                os.unlink(self._next_path)
+                            except OSError:
+                                pass
+                            self._refilling = False
                         self._promote()
                         return
                 self._stop.wait(self._poll_interval_s)
@@ -159,9 +212,15 @@ class GcsStandby:
 
         host, port = self.address
         self.leader_epoch = self._primary_epoch + 1
+        try:
+            # actual promoted-log size: after a mid-refill fallback,
+            # self._offset counts the DISCARDED partial next generation
+            log_bytes = os.path.getsize(self._log_path)
+        except OSError:
+            log_bytes = 0
         logger.warning("standby promoting to GCS leader on %s:%d epoch %d "
                        "(replica log: %d bytes)", host, port,
-                       self.leader_epoch, self._offset)
+                       self.leader_epoch, log_bytes)
         # free the pinned port, then boot the real control plane on it
         self._placeholder.stop()
         deadline = time.monotonic() + 30.0

@@ -17,7 +17,7 @@ import numpy as np
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
 from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.rl.learner import PPOLearner, compute_gae
-from ray_tpu.rl.module import init_policy_params
+from ray_tpu.rl.module import init_lstm_policy_params, init_policy_params
 
 
 @dataclasses.dataclass
@@ -221,9 +221,18 @@ class Algorithm:
 class PPO(Algorithm):
     def __init__(self, config: "PPOConfig"):
         super().__init__(config)
-        params = init_policy_params(
-            self._env_probe["obs_size"], self._env_probe["num_actions"],
-            hidden=tuple(config.hidden), seed=config.seed)
+        if getattr(config, "module", "mlp") == "lstm":
+            # recurrent policy (rl/module.py stateful contract); width is
+            # the first entry of `hidden` — one cell, not a stack
+            params = init_lstm_policy_params(
+                self._env_probe["obs_size"],
+                self._env_probe["num_actions"],
+                hidden=int(config.hidden[0]), seed=config.seed)
+        else:
+            params = init_policy_params(
+                self._env_probe["obs_size"],
+                self._env_probe["num_actions"],
+                hidden=tuple(config.hidden), seed=config.seed)
         self.learner = PPOLearner(
             params, lr=config.lr, clip=config.clip,
             vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
@@ -245,15 +254,37 @@ class PPO(Algorithm):
             advs.append(a)
             targets.append(vt)
             returns.extend(f["episode_returns"])
-        batch = {
-            "obs": np.concatenate([f["obs"] for f in fragments]),
-            "actions": np.concatenate([f["actions"] for f in fragments]),
-            "logp_old": np.concatenate([f["logp"] for f in fragments]),
-            "advantages": np.concatenate(advs),
-            "value_targets": np.concatenate(targets),
-        }
+        stateful = "state_in" in fragments[0]
+        if stateful:
+            # keep time structure: (F, T, ...) columns, GAE per fragment
+            # as above, then cut into (B, L) windows with the recorded
+            # state at window starts (burn-in-free injection)
+            batch = {
+                "obs": np.stack([f["obs"] for f in fragments]),
+                "actions": np.stack([f["actions"] for f in fragments]),
+                "logp_old": np.stack([f["logp"] for f in fragments]),
+                "advantages": np.stack(advs),
+                "value_targets": np.stack(targets),
+                "is_first": np.stack([f["is_first"] for f in fragments]),
+            }
+            for k in fragments[0]["state_in"]:
+                batch["state_in_" + k] = np.stack(
+                    [f["state_in"][k] for f in fragments])
+        else:
+            batch = {
+                "obs": np.concatenate([f["obs"] for f in fragments]),
+                "actions": np.concatenate(
+                    [f["actions"] for f in fragments]),
+                "logp_old": np.concatenate([f["logp"] for f in fragments]),
+                "advantages": np.concatenate(advs),
+                "value_targets": np.concatenate(targets),
+            }
         adv = batch["advantages"]
         batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        if stateful:
+            from ray_tpu.rl.connectors import window_sequences
+
+            batch = window_sequences(batch, self.config.seq_len)
         batch = self._learner_pipeline(batch)
         metrics = self.learner.update(batch)
         self._weights_version += 1
@@ -279,6 +310,10 @@ class PPOConfig(AlgorithmConfig):
     entropy_coeff: float = 0.01
     num_epochs: int = 4
     minibatch_size: int = 128
+    # module family: "mlp" (feedforward twin towers) or "lstm" (stateful
+    # recurrent policy; training then uses (B, seq_len) windows)
+    module: str = "mlp"
+    seq_len: int = 16
     algo_class = PPO
 
 

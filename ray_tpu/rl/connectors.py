@@ -259,3 +259,43 @@ class RewardClip(LearnerConnector):
             batch = dict(batch)
             batch[self.key] = np.clip(batch[self.key], self.lo, self.hi)
         return batch
+
+
+# --------------------------------------------------------------- sequences
+def window_sequences(batch: Dict[str, np.ndarray], seq_len: int
+                     ) -> Dict[str, np.ndarray]:
+    """Cut a time-major batch of fragments into fixed-length training
+    windows for recurrent learners (reference: the AddStatesFromEpisodes
+    learner-connector piece + RNNSequencing).
+
+    Input columns are (F, T, ...) — F whole rollout fragments of T steps —
+    except ``state_in_*`` columns, which are the PER-STEP recorded
+    recurrent state (F, T, ...). Output: every non-state column becomes
+    (B, L, ...) with B = F * (T // L); each ``state_in_*`` column is
+    sliced AT WINDOW STARTS only → (B, ...), so the learner injects the
+    exact carried state the policy acted with (burn-in-free) and replays
+    mid-window resets from the ``is_first`` column. A trailing remainder
+    of T % L steps is dropped."""
+    F, T = next(iter(batch.values())).shape[:2]
+    L = int(seq_len)
+    W = T // L
+    if W == 0:
+        raise ValueError(f"seq_len {L} exceeds fragment length {T}")
+    out: Dict[str, np.ndarray] = {}
+    for k, v in batch.items():
+        v = np.asarray(v)[:, :W * L]
+        if k.startswith("state_in_"):
+            out[k] = v[:, ::L].reshape((F * W,) + v.shape[2:])
+        else:
+            out[k] = v.reshape((F * W, L) + v.shape[2:])
+    return out
+
+
+class SequenceWindower(LearnerConnector):
+    """``window_sequences`` as a composable learner-connector piece."""
+
+    def __init__(self, seq_len: int = 16):
+        self.seq_len = seq_len
+
+    def __call__(self, batch):
+        return window_sequences(batch, self.seq_len)

@@ -15,32 +15,30 @@ under ``utils/``).  The defining machinery is reproduced in JAX:
   resets.
 
 TPU framing: the ENTIRE update — world-model unroll (lax.scan over L),
-imagination rollout (lax.scan over H), both heads, and the actor
-distillation below — is ONE jitted function; every matmul is batched
-(B×L collapsed) for the MXU, and the python loop never touches a
-per-step value.
+imagination rollout (lax.scan over H), and both heads — is ONE jitted
+function; every matmul is batched (B×L collapsed) for the MXU, and the
+python loop never touches a per-step value.
 
-One deliberate divergence from the reference, recorded here: env runners
-in this framework drive a fixed feedforward policy schema (rl/module.py)
-with no recurrent state.  DreamerV3's actor conditions on the RSSM
-latent, so acting uses an obs-conditioned DISTILLATE of the actor: a
-small MLP trained (inside the same jitted update) to match the actor's
-action distribution at the posterior latents of replayed real steps.
-On fully-observable tasks (the CartPole-class tests) the posterior is a
-function of the current observation, so the distillate is exact in the
-limit; on POMDPs it is an amortization.  The actor/critic themselves
-train purely in imagination, as in the reference.
+Acting runs on the TRUE RSSM posterior latent through the stateful-module
+channel (rl/module.py): :meth:`DreamerV3Learner.get_runner_weights`
+exports the inference slice of the world model (GRU advance + encoder +
+posterior + actor) as a numpy param dict, env runners carry the
+(h, z, a) latent per episode and reset it on ``is_first`` exactly as the
+trainer does, and replayed fragments record the per-step latent so
+sequence windows inject the ACTED state at window starts instead of
+burning in from zeros.  The actor/critic themselves train purely in
+imagination, as in the reference.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List
 
 import numpy as np
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rl.module import init_policy_params
+from ray_tpu.rl.replay import SequenceReplay  # noqa: F401  (re-export)
 
 # ---------------------------------------------------------------- helpers
 
@@ -109,7 +107,7 @@ def _mlp(params, prefix, x, n_layers):
 
 
 class DreamerV3Learner:
-    """Jitted world-model + imagination actor-critic + distillate update."""
+    """Jitted world-model + imagination actor-critic update."""
 
     def __init__(self, obs_size: int, num_actions: int,
                  cfg: "DreamerV3Config"):
@@ -143,20 +141,23 @@ class DreamerV3Learner:
         add("gru_h", H, 3 * H)
         add("dec0", H + Z, U)
         add("dec_out", U, obs_size, 0.01)
-        add("rew0", H + Z, U)
+        # Reward/continue heads are ACTION-conditioned — r(s, a), c(s, a)
+        # — a deliberate divergence from the reference's state-only heads:
+        # this framework's fragments key rewards[t]/terminated[t] to the
+        # OUTGOING transition (obs_t, a_t) and never record the terminal
+        # arrival observation (runners reset in place), so a state-only
+        # head cannot see which action ends the episode. Without the
+        # action input the continue head stays uniformly optimistic,
+        # imagination never terminates, and the actor gets no
+        # differential signal (the observed 24-return plateau).
+        add("rew0", H + Z + num_actions, U)
         add("rew_logits", U, _NUM_BINS, 0.0)  # zero-init (reference)
-        add("cont0", H + Z, U)
+        add("cont0", H + Z + num_actions, U)
         add("cont_logit", U, 1, 0.01)
         add("actor0", H + Z, U)
         add("actor_logits", U, num_actions, 0.01)
         add("critic0", H + Z, U)
         add("critic_logits", U, _NUM_BINS, 0.0)
-        # obs-conditioned distillate for the (feedforward) env runners —
-        # same schema as rl/module.py so runners need zero special casing
-        self._dist_params = init_policy_params(
-            obs_size, num_actions, hidden=tuple(cfg.distill_hidden),
-            seed=cfg.seed + 1)
-        p.update(self._dist_params)
 
         self._params = jax.device_put(p)
         self._critic_ema = jax.device_put(
@@ -226,12 +227,13 @@ class DreamerV3Learner:
                 p1 * (jax.nn.log_softmax(l1, -1)
                       - jax.nn.log_softmax(l2, -1)), axis=(-2, -1))
 
-        def heads(p, h, z):
+        def heads(p, h, z, a_oh):
             hz = jnp.concatenate([h, z], -1)
+            hza = jnp.concatenate([hz, a_oh], -1)
             dec = _mlp(p, "dec", hz, 1) @ p["dec_out_w"] + p["dec_out_b"]
-            rew = _mlp(p, "rew", hz, 1) @ p["rew_logits_w"] \
+            rew = _mlp(p, "rew", hza, 1) @ p["rew_logits_w"] \
                 + p["rew_logits_b"]
-            cont = (_mlp(p, "cont", hz, 1) @ p["cont_logit_w"]
+            cont = (_mlp(p, "cont", hza, 1) @ p["cont_logit_w"]
                     + p["cont_logit_b"])[..., 0]
             return dec, rew, cont
 
@@ -257,9 +259,11 @@ class DreamerV3Learner:
             def wm_step(carry, t):
                 h, z = carry
                 # action a_{t-1} advances the state, then posterior sees
-                # obs_t (reference sequence model contract)
+                # obs_t (reference sequence model contract); at the
+                # window start the replayed pre-window action applies —
+                # the same advance the acting tower performed
                 a_prev = jnp.where(
-                    t == 0, jnp.zeros((B, A)), a_oh[:, t - 1])
+                    t == 0, batch["state_in_a"], a_oh[:, t - 1])
                 h = self._gru(p, h, z, a_prev)
                 h = jnp.where(batch["is_first"][:, t, None], 0.0, h)
                 e = _mlp(p, "enc", obs[:, t], 1)
@@ -274,15 +278,18 @@ class DreamerV3Learner:
                 z = self._sample_z(keys[t], post)
                 return (h, z), (h, z, post, prior)
 
-            h0 = jnp.zeros((B, H))
-            z0 = jnp.zeros((B, Z))
+            # burn-in-free window starts: the replay ships the latent the
+            # policy ACTED with (zeros when unavailable, e.g. hand-built
+            # batches), so mid-episode windows resume, not restart
+            h0 = batch["state_in_h"]
+            z0 = batch["state_in_z"]
             (_, _), (hs, zs, posts, priors) = jax.lax.scan(
                 wm_step, (h0, z0), jnp.arange(L))
             # scan stacks on axis 0: (L, B, ·) -> (B, L, ·)
             hs, zs = hs.swapaxes(0, 1), zs.swapaxes(0, 1)
             posts, priors = posts.swapaxes(0, 1), priors.swapaxes(0, 1)
 
-            dec, rew_logits, cont_logit = heads(p, hs, zs)
+            dec, rew_logits, cont_logit = heads(p, hs, zs, a_oh)
             recon = jnp.mean(jnp.sum((dec - obs) ** 2, -1))
             rew_target = _twohot(_symlog(batch["rewards"]))
             rew_nll = -jnp.mean(jnp.sum(
@@ -322,7 +329,8 @@ class DreamerV3Learner:
             (_, _), (ih, iz, ialog, ia) = jax.lax.scan(
                 img_step, (flat_h, flat_z), ikeys)
             # (Hor, BL, ·)
-            _, irew_logits, icont_logit = heads(p, ih, iz)
+            _, irew_logits, icont_logit = heads(p, ih, iz,
+                                                jax.nn.one_hot(ia, A))
             irew = _twohot_mean(irew_logits)
             icont = jax.nn.sigmoid(icont_logit)
             ival = _twohot_mean(critic_logits(p, ih, iz))
@@ -365,21 +373,20 @@ class DreamerV3Learner:
                 -jax.lax.stop_gradient(adv / scale) * taken
                 - cfg.entropy_coeff * ent)
 
-            # ------------- runner-policy distillation (see module doc) -
-            # trained on RAW observations — exactly what env runners feed
-            from ray_tpu.rl.module import jax_forward
-
-            dlogits, _ = jax_forward(p, batch["obs"].reshape(B * L, -1))
-            alogits_post = jax.lax.stop_gradient(
-                actor_logits(p, flat_h, flat_z))
-            dist_ce = -jnp.mean(jnp.sum(
-                jax.nn.softmax(alogits_post, -1)
-                * jax.nn.log_softmax(dlogits, -1), -1))
-
-            total = wm_loss + critic_loss + actor_loss + dist_ce
+            total = wm_loss + critic_loss + actor_loss
+            # continue-head calibration diagnostics: a healthy model
+            # separates these; both near 1.0 means imagination never
+            # terminates and the actor trains against a delusion
+            cont_p = jax.nn.sigmoid(cont_logit)
+            term = batch["terminated"]
+            p_term = jnp.sum(cont_p * term) / jnp.maximum(term.sum(), 1.0)
+            p_alive = jnp.sum(cont_p * (1 - term)) / jnp.maximum(
+                (1 - term).sum(), 1.0)
             aux = {"wm_loss": wm_loss, "recon": recon, "rew_nll": rew_nll,
                    "kl_dyn": dyn, "critic_loss": critic_loss,
-                   "actor_loss": actor_loss, "distill_ce": dist_ce,
+                   "actor_loss": actor_loss,
+                   "cont_p_at_term": p_term, "cont_p_alive": p_alive,
+                   "imag_disc_mean": icont.mean(),
                    "imagined_return_mean": rets.mean()}
             return total, aux
 
@@ -405,6 +412,15 @@ class DreamerV3Learner:
         jb["rewards"] = jb["rewards"].astype(jnp.float32)
         jb["terminated"] = jb["terminated"].astype(jnp.float32)
         jb["is_first"] = jb["is_first"].astype(jnp.bool_)
+        # window-start latent injection; zero fallback for batches built
+        # without recorded acting state (unit tests, external data)
+        B = jb["actions"].shape[0]
+        for k, dim in (("state_in_h", self.deter), ("state_in_z", self.zdim),
+                       ("state_in_a", self.num_actions)):
+            if k in jb:
+                jb[k] = jb[k].astype(jnp.float32)
+            else:
+                jb[k] = jnp.zeros((B, dim), jnp.float32)
         self._params, self._critic_ema, self._opt_state, loss, aux = \
             self._step(self._params, self._critic_ema, self._opt_state,
                        sub, jb)
@@ -412,81 +428,33 @@ class DreamerV3Learner:
         return {"loss": float(loss),
                 **{k: float(v) for k, v in aux.items()}}
 
+    # the inference-only slice of the world model a runner needs to act
+    # on the true posterior latent (rl/module.py RSSM family)
+    _ACTING_KEYS = ("enc0_w", "enc0_b", "post0_w", "post0_b",
+                    "post_logits_w", "post_logits_b",
+                    "gru_x_w", "gru_x_b", "gru_h_w", "gru_h_b",
+                    "actor0_w", "actor0_b",
+                    "actor_logits_w", "actor_logits_b")
+
     def get_runner_weights(self) -> Dict[str, np.ndarray]:
-        """The distilled feedforward policy in the rl/module.py schema —
-        trained on raw observations, so runners feed it exactly what it
-        saw in training."""
-        out = {}
-        for k in self._dist_params:
-            out[k] = np.asarray(self._params[k])
+        """The RSSM acting tower in the rl/module.py stateful schema:
+        env runners thread the (h, z, a) latent through
+        ``np_stateful_sample_batch`` and act on the actor's true
+        posterior-conditioned distribution — no distillate."""
+        out = {k: np.asarray(self._params[k]) for k in self._ACTING_KEYS}
+        out["rssm_meta"] = np.asarray([self.cats, self.classes], np.int32)
         return out
 
 
-# -------------------------------------------------------------- sequences
-
-
-class SequenceReplay:
-    """Fragment-preserving replay sampling (B, L) windows with is_first
-    markers (reference: DreamerV3's episodic replay)."""
-
-    def __init__(self, capacity_steps: int, seq_len: int, seed: int = 0):
-        self._frags: List[Dict[str, np.ndarray]] = []
-        self._steps = 0
-        self._cap = capacity_steps
-        self._L = seq_len
-        self._rng = np.random.default_rng(seed)
-
-    def __len__(self):
-        return self._steps
-
-    def add_fragment(self, frag: Dict[str, Any]) -> None:
-        n = len(frag["obs"])
-        if n < 2:
-            return
-        keep = {
-            "obs": np.asarray(frag["obs"], np.float32),
-            "actions": np.asarray(frag["actions"]),
-            "rewards": np.asarray(frag["rewards"], np.float32),
-            "terminated": np.asarray(
-                frag.get("terminated", frag["dones"]), np.float32),
-            "is_first": np.zeros(n, bool),
-        }
-        # episode starts inside the fragment: step AFTER a done
-        dones = np.asarray(frag["dones"], bool)
-        keep["is_first"][0] = True
-        keep["is_first"][1:] |= dones[:-1]
-        self._frags.append(keep)
-        self._steps += n
-        while self._steps - len(self._frags[0]["obs"]) >= self._cap \
-                and len(self._frags) > 1:
-            self._steps -= len(self._frags.pop(0)["obs"])
-
-    def sample(self, batch: int) -> Dict[str, np.ndarray]:
-        L = self._L
-        cols = {k: [] for k in
-                ("obs", "actions", "rewards", "terminated", "is_first")}
-        sizes = np.array([len(f["obs"]) for f in self._frags])
-        ok = np.flatnonzero(sizes >= L)
-        probs = sizes[ok] / sizes[ok].sum()
-        for _ in range(batch):
-            f = self._frags[ok[self._rng.choice(len(ok), p=probs)]]
-            n = len(f["obs"])
-            s = int(self._rng.integers(0, n - L + 1))
-            for k in cols:
-                cols[k].append(f[k][s:s + L])
-        return {k: np.stack(v) for k, v in cols.items()}
-
-    def has_sequences(self, batch: int) -> bool:
-        return any(len(f["obs"]) >= self._L for f in self._frags) \
-            and self._steps >= batch * self._L
-
-
 # -------------------------------------------------------------- algorithm
+# (SequenceReplay lives in rl/replay.py — shared with other sequence
+# learners — and is re-exported above for back-compat.)
 
 
 class DreamerV3(Algorithm):
-    """Sample real steps → sequence replay → world-model + imagination
-    updates → broadcast the distilled acting policy."""
+    """Sample real steps (acting on the RSSM posterior latent) → sequence
+    replay with recorded latents → world-model + imagination updates →
+    broadcast the refreshed acting tower."""
 
     def __init__(self, config: "DreamerV3Config"):
         super().__init__(config)
@@ -532,7 +500,10 @@ class DreamerV3(Algorithm):
 
 @dataclasses.dataclass
 class DreamerV3Config(AlgorithmConfig):
-    lr: float = 4e-4
+    # 1e-3 (vs the reference's ~4e-4 for far bigger nets): at this tiny
+    # scale the world model is the wall-clock bottleneck for CI-budget
+    # learning, and the smaller nets tolerate the hotter rate
+    lr: float = 1e-3
     gamma: float = 0.997
     lmbda: float = 0.95
     horizon: int = 15
@@ -542,11 +513,9 @@ class DreamerV3Config(AlgorithmConfig):
     deter: int = 64
     latent_categoricals: int = 8
     latent_classes: int = 8
-    distill_hidden: Tuple[int, ...] = (64, 64)
     entropy_coeff: float = 3e-3
     critic_ema_reg: float = 1.0
     replay_capacity: int = 100_000
     learning_starts: int = 500
     updates_per_iteration: int = 8
-    record_next_obs: bool = True
     algo_class = DreamerV3

@@ -4,6 +4,13 @@
 Numpy-only process: steps its env with the inference copy of the policy,
 keeps env state across sample() calls (truncation-free stitching), returns
 fixed-size rollout fragments plus completed-episode returns for metrics.
+
+Stateful modules (rl/module.py contract): the runner carries per-episode
+recurrent state across sample() calls, flags ``is_first`` rows so the
+module resets exactly at episode starts, and emits per-step ``state_in``
+columns (the PRE-step carried state) plus ``is_first`` in every fragment —
+sequence learners inject the recorded state at window starts instead of
+burning in.
 """
 
 from __future__ import annotations
@@ -66,6 +73,10 @@ class EnvRunner:
         self._episode_return = 0.0
         self._episode_returns_vec = np.zeros(self.num_envs)
         self._weights_version = -1
+        # recurrent-module state: carried across sample() calls, reset
+        # per env on is_first (lazily sized once params are known)
+        self._policy_state = None
+        self._is_first_vec = np.ones(self.num_envs, bool)
 
     def get_connector_state(self):
         if self._m2e.connectors:
@@ -95,19 +106,49 @@ class EnvRunner:
     def sample(self, num_steps: int):
         """One fragment dict for num_envs == 1 (back-compat), else a LIST
         of per-env fragment dicts — each a normal fragment, so every
-        consumer (GAE, aggregators, v-trace) is unchanged."""
-        if self.num_envs > 1:
-            return self._sample_vector(num_steps)
-        return self._sample_single(num_steps)
+        consumer (GAE, aggregators, v-trace) is unchanged. Stateful
+        modules always take the vector path (state is batched per env)."""
+        from ray_tpu.rl.module import is_stateful
+
+        stateful = self._params is not None and is_stateful(self._params)
+        if self.num_envs > 1 or stateful:
+            if self.num_envs == 1:
+                self._episode_returns_vec[0] = self._episode_return
+            frags = self._sample_vector(num_steps)
+            if self.num_envs == 1:
+                # keep the single-env aliases fresh in case a later
+                # weights broadcast switches back to a feedforward module
+                self._obs = self._obs_vec[0]
+                self._episode_return = float(self._episode_returns_vec[0])
+                return frags[0]
+            return frags
+        frag = self._sample_single(num_steps)
+        self._obs_vec[0] = self._obs
+        self._episode_returns_vec[0] = self._episode_return
+        return frag
+
+    def _ensure_policy_state(self):
+        """(Re)allocate carried state when params first arrive or change
+        family/shape; fresh state restarts every env as is_first."""
+        from ray_tpu.rl.module import get_initial_state
+
+        init = get_initial_state(self._params, self.num_envs)
+        cur = self._policy_state
+        if (cur is None or set(cur) != set(init)
+                or any(cur[k].shape != init[k].shape for k in init)):
+            self._policy_state = init
+            self._is_first_vec = np.ones(self.num_envs, bool)
 
     def _sample_vector(self, num_steps: int):
         from ray_tpu.rl.module import (
-            action_spec, is_continuous, np_forward,
-            np_sample_actions_batch, np_sample_continuous_batch)
+            action_spec, is_continuous, is_stateful, np_forward,
+            np_sample_actions_batch, np_sample_continuous_batch,
+            np_stateful_sample_batch, np_stateful_values)
 
         assert self._params is not None, "set_weights first"
         N = self.num_envs
         cont = is_continuous(self._params)
+        stateful = is_stateful(self._params)
         a_shape, a_dtype = action_spec(self._params)
         sampler = (np_sample_continuous_batch if cont
                    else np_sample_actions_batch)
@@ -122,10 +163,30 @@ class EnvRunner:
         logp_buf = np.empty((N, num_steps), np.float32)
         val_buf = np.empty((N, num_steps), np.float32)
         episode_returns = [[] for _ in range(N)]
+        state_bufs = first_buf = None
+        if stateful:
+            self._ensure_policy_state()
+            state_bufs = {
+                k: np.empty((N, num_steps) + v.shape[1:], np.float32)
+                for k, v in self._policy_state.items()}
+            first_buf = np.empty((N, num_steps), np.bool_)
 
         for t in range(num_steps):
-            actions, logps, values = sampler(
-                self._params, self._obs_vec, self._rng)
+            if stateful:
+                # record the PRE-step carried state + is_first flag; the
+                # module applies its own reset internally, and sequence
+                # learners replay the exact same reset from these columns
+                first_buf[:, t] = self._is_first_vec
+                for k, v in self._policy_state.items():
+                    state_bufs[k][:, t] = v
+                actions, logps, values, self._policy_state = \
+                    np_stateful_sample_batch(
+                        self._params, self._obs_vec, self._policy_state,
+                        self._is_first_vec, self._rng)
+                self._is_first_vec[:] = False
+            else:
+                actions, logps, values = sampler(
+                    self._params, self._obs_vec, self._rng)
             obs_buf[:, t] = self._obs_vec
             act_buf[:, t] = actions
             logp_buf[:, t] = logps
@@ -146,11 +207,24 @@ class EnvRunner:
                     episode_returns[i].append(
                         float(self._episode_returns_vec[i]))
                     self._episode_returns_vec[i] = 0.0
+                    if self.num_envs == 1:
+                        # single-env semantics (stateful modules route
+                        # here too): episodic connectors flush exactly
+                        # as in _sample_single. With N > 1 the pipeline
+                        # is shared across envs, so per-env resets stay
+                        # undefined (pre-existing vector behavior).
+                        self._pipeline.reset()
+                        self._m2e.reset()
                     raw, _ = env.reset()
                     self._obs_vec[i] = self._pipeline(raw)
+                    self._is_first_vec[i] = True
 
         if cont:     # off-policy consumers bootstrap from their critics
             last_vals = np.zeros(N, np.float32)
+        elif stateful:
+            last_vals = np_stateful_values(
+                self._params, self._obs_vec, self._policy_state,
+                self._is_first_vec)
         else:
             _, last_vals = np_forward(self._params, self._obs_vec)
         out = []
@@ -164,6 +238,9 @@ class EnvRunner:
                     "weights_version": self._weights_version}
             if next_obs_buf is not None:
                 frag["next_obs"] = next_obs_buf[i]
+            if stateful:
+                frag["state_in"] = {k: v[i] for k, v in state_bufs.items()}
+                frag["is_first"] = first_buf[i]
             out.append(frag)
         return out
 

@@ -68,6 +68,33 @@ class CartPoleEnv:
                 truncated, {})
 
 
+class CartPoleMaskedVelocityEnv(CartPoleEnv):
+    """CartPole POMDP: observations expose only the POSITIONS (x, θ) —
+    velocities are masked. The standard memory benchmark for recurrent
+    policies (Duan et al. '16 "masked-velocity" control suite): a
+    feedforward policy cannot distinguish a pole swinging left from one
+    swinging right through the upright, so it cannot stabilize; a
+    stateful policy recovers the velocities from two consecutive
+    observations. Initial VELOCITIES are drawn wider than stock CartPole
+    so the hidden state genuinely varies and cannot be assumed zero."""
+
+    observation_size = 2
+
+    def _mask(self, obs: np.ndarray) -> np.ndarray:
+        return obs[[0, 2]]
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        obs, info = super().reset(seed=seed)
+        # re-draw velocities from a wider range (positions stay stock)
+        self._state[1] = self._rng.uniform(-0.5, 0.5)
+        self._state[3] = self._rng.uniform(-0.5, 0.5)
+        return self._mask(self._state.astype(np.float32)), info
+
+    def step(self, action: int):
+        obs, reward, terminated, truncated, info = super().step(action)
+        return self._mask(obs), reward, terminated, truncated, info
+
+
 class PendulumEnv:
     """Classic underactuated pendulum swing-up (gym Pendulum-v1
     dynamics): obs (cosθ, sinθ, θ̇), one continuous torque in
@@ -130,6 +157,7 @@ def _coordination_factory(seed=None):
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {
     "CartPole-v1": CartPoleEnv,
+    "CartPoleMaskedVelocity-v1": CartPoleMaskedVelocityEnv,
     "Pendulum-v1": PendulumEnv,
     "coordination": _coordination_factory,
 }

@@ -4,6 +4,14 @@ The reference Learner wraps torch DDP; here the update is a pure jitted
 function — on a TPU learner the same code pjit-s over a mesh (batch axis
 data-parallel) with zero wiring, and multi-learner groups allreduce
 through the collective library instead of NCCL.
+
+Stateful modules: when the param pytree carries a recurrent family
+marker (rl/module.py), PPO batches arrive as (B, L) sequence windows
+with injected window-start state (``state_in_*``) and ``is_first``
+flags; the whole window unrolls under ONE jitted ``lax.scan`` (no
+python per-step work) and the clipped-surrogate loss is taken over the
+flattened (B·L) steps. Minibatching permutes WHOLE windows so state
+injection stays aligned with its window.
 """
 
 from __future__ import annotations
@@ -12,7 +20,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from ray_tpu.rl.module import jax_forward
+from ray_tpu.rl.module import jax_forward, jax_lstm_forward_seq
 
 
 class PPOLearner:
@@ -47,18 +55,37 @@ class PPOLearner:
         optimizer = self._optimizer
 
         def loss_fn(params, batch):
-            logits, values = jax_forward(params, batch["obs"])
+            if "lstm_wx" in params:
+                # sequence window batch: (B, L, ...) + window-start state.
+                # ONE scan over L, then the standard surrogate on the
+                # flattened steps.
+                state = {k[len("state_in_"):]: v
+                         for k, v in batch.items()
+                         if k.startswith("state_in_")}
+                logits, values = jax_lstm_forward_seq(
+                    params, batch["obs"], state, batch["is_first"])
+                logits = logits.reshape(-1, logits.shape[-1])
+                values = values.reshape(-1)
+                actions = batch["actions"].reshape(-1)
+                logp_old = batch["logp_old"].reshape(-1)
+                adv = batch["advantages"].reshape(-1)
+                value_targets = batch["value_targets"].reshape(-1)
+            else:
+                logits, values = jax_forward(params, batch["obs"])
+                actions = batch["actions"]
+                logp_old = batch["logp_old"]
+                adv = batch["advantages"]
+                value_targets = batch["value_targets"]
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                logp_all, actions[:, None].astype(jnp.int32),
                 axis=1)[:, 0]
-            ratio = jnp.exp(logp - batch["logp_old"])
-            adv = batch["advantages"]
+            ratio = jnp.exp(logp - logp_old)
             surr = jnp.minimum(
                 ratio * adv,
                 jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
             pi_loss = -surr.mean()
-            vf_loss = jnp.mean((values - batch["value_targets"]) ** 2)
+            vf_loss = jnp.mean((values - value_targets) ** 2)
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
             total = pi_loss + vf_c * vf_loss - ent_c * entropy
@@ -101,15 +128,46 @@ class PPOLearner:
     # ------------------------------------------------------------- update
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """Minibatched multi-epoch PPO update. Batch keys: obs, actions,
-        logp_old, advantages, value_targets."""
+        logp_old, advantages, value_targets — flat (N, ...) for
+        feedforward modules, (B, L, ...) windows + state_in_*/is_first
+        for stateful ones (minibatches then index whole windows, and
+        minibatch_size counts STEPS, so B·L per minibatch stays
+        comparable across module families)."""
         import jax.numpy as jnp
 
+        from ray_tpu.rl.module import get_initial_state, is_stateful
+
         n = len(batch["obs"])
+        mb_size = self.minibatch_size
+        if is_stateful(self._params):
+            if "lstm_wx" not in self._params:
+                raise ValueError(
+                    "PPOLearner supports the LSTM stateful family only; "
+                    "RSSM acting towers are inference-only exports "
+                    "(trained by DreamerV3Learner), not PPO-trainable")
+            # loss_fn branches on the PARAMS marker, so the batch must be
+            # sequence-shaped — fail loudly here rather than with a
+            # KeyError inside the jitted step
+            obs = np.asarray(batch["obs"])
+            if obs.ndim != 3:
+                raise ValueError(
+                    "stateful module requires a (B, L, obs) sequence "
+                    f"batch (see window_sequences); got shape {obs.shape}")
+            # zero-state fallback for externally built windows without
+            # recorded acting state (mirrors DreamerV3Learner.update)
+            batch = dict(batch)
+            if "is_first" not in batch:
+                first = np.zeros(obs.shape[:2], bool)
+                first[:, 0] = True
+                batch["is_first"] = first
+            for k, v in get_initial_state(self._params, n).items():
+                batch.setdefault("state_in_" + k, np.asarray(v))
+            mb_size = max(1, self.minibatch_size // obs.shape[1])
         metrics = {}
         for _ in range(self.num_epochs):
             perm = self._rng.permutation(n)
-            for lo in range(0, n, self.minibatch_size):
-                idx = perm[lo:lo + self.minibatch_size]
+            for lo in range(0, n, mb_size):
+                idx = perm[lo:lo + mb_size]
                 mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
                 self._params, self._opt_state, aux = self._step(
                     self._params, self._opt_state, mb)

@@ -22,6 +22,20 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 
+def _num_steps(batch) -> int:
+    """Env steps in a train batch: B for flat batches, B·L for (B, L)
+    sequence windows of a stateful module (rl/module.py contract).
+    Sequence windows are identified by their marker columns, NOT by obs
+    rank — a flat batch of image observations is also ndim >= 3."""
+    if hasattr(batch, "get") and batch.get("obs") is not None and (
+            "is_first" in batch
+            or any(str(k).startswith("state_in_") for k in batch)):
+        obs = np.asarray(batch["obs"])
+        if obs.ndim >= 3:
+            return int(obs.shape[0] * obs.shape[1])
+    return len(next(iter(batch.values())))
+
+
 class LearnerWorker:
     """One learner actor: local jitted learner + collective gradient sync."""
 
@@ -76,7 +90,7 @@ class LearnerWorker:
         ]
         self._learner.apply_gradients(jax.tree.unflatten(treedef, reduced))
         out = {k: float(v) for k, v in aux.items()}
-        out["num_env_steps_trained"] = len(next(iter(batch.values())))
+        out["num_env_steps_trained"] = _num_steps(batch)
         return out
 
     def get_weights(self) -> Dict[str, np.ndarray]:
@@ -127,6 +141,10 @@ class LearnerGroup:
     @staticmethod
     def _shard(batch: Dict[str, np.ndarray], n: int
                ) -> List[Dict[str, np.ndarray]]:
+        """Slice along axis 0. For (B, L) sequence batches this is
+        sequence-aware by construction: whole windows move together, so
+        every rank's ``state_in_*`` rows stay aligned with their
+        windows."""
         if n == 1:
             return [batch]
         size = len(next(iter(batch.values())))

@@ -1,4 +1,4 @@
-"""RLModule equivalent: policy + value MLPs with twin implementations.
+"""RLModule equivalent: policy + value networks with twin implementations.
 
 Reference: ``rllib/core/rl_module/`` — one module definition used in two
 roles: inference-only copies on env runners, trainable copy on learners.
@@ -9,6 +9,31 @@ share one param pytree (dict of numpy arrays at the boundary).
 Policy and value are separate towers (no shared trunk): the value
 regression's large early losses otherwise dominate the shared features and
 stall policy learning at this scale.
+
+Stateful-module contract (reference ``RLModule.get_initial_state``,
+``rllib/core/rl_module/rl_module.py:653``): modules that carry recurrent
+state expose
+
+- ``get_initial_state(params, batch_size)`` → dict of per-env state
+  arrays (``{}`` for feedforward modules);
+- ``np_stateful_sample_batch(params, obs, state, is_first, rng)`` →
+  ``(actions, logps, values, next_state)`` — the numpy acting step. The
+  module owns its OWN reset semantics for ``is_first`` rows (an LSTM
+  zeroes ``h``/``c`` before the step; an RSSM zeroes the deterministic
+  state after the GRU advance, exactly as its trainer does), so env
+  runners never special-case per family;
+- a matching jittable sequence forward for the learner (e.g.
+  ``jax_lstm_forward_seq``) that re-applies the same resets inside one
+  ``lax.scan`` over the window, with the carried state injected at the
+  window start (burn-in-free).
+
+Env runners record the PRE-step carried state per step (``state_in``
+columns) plus the ``is_first`` flag; sequence windows then ship the
+recorded state at window starts and replay resets from the flags.
+Module families are detected by marker keys in the one shared param
+pytree: ``lstm_wx`` (LSTM policy), ``gru_x_w`` (RSSM acting tower),
+``mu_w`` (continuous squashed-Gaussian), else feedforward-discrete —
+so dispatch needs no per-algorithm branching anywhere.
 """
 
 from __future__ import annotations
@@ -84,6 +109,17 @@ def np_sample_action(params: Params, obs: np.ndarray,
     return action, float(np.log(p[action] + 1e-20)), float(value[0])
 
 
+def _np_categorical_sample(p: np.ndarray, rng: np.random.Generator):
+    """Vectorized categorical draw over probs (..., K) → (idx (...,),
+    logp (...,)). Gumbel-max: one vectorized draw instead of N
+    rng.choice calls."""
+    g = rng.gumbel(size=p.shape)
+    idx = (np.log(p + 1e-20) + g).argmax(axis=-1)
+    logp = np.log(np.take_along_axis(
+        p, idx[..., None], axis=-1)[..., 0] + 1e-20)
+    return idx, logp
+
+
 def np_sample_actions_batch(params: Params, obs: np.ndarray,
                             rng: np.random.Generator):
     """Vectorized categorical sample over a batch of observations:
@@ -91,13 +127,8 @@ def np_sample_actions_batch(params: Params, obs: np.ndarray,
     for the whole env vector — the point of vectorized env runners
     (reference rllib/env/vector/)."""
     logits, values = np_forward(params, obs)
-    logits = logits - logits.max(axis=1, keepdims=True)
-    p = np.exp(logits)
-    p /= p.sum(axis=1, keepdims=True)
-    # Gumbel-max: one vectorized draw instead of N rng.choice calls
-    g = rng.gumbel(size=p.shape)
-    actions = (np.log(p + 1e-20) + g).argmax(axis=1)
-    logps = np.log(p[np.arange(len(p)), actions] + 1e-20)
+    actions, logps = _np_categorical_sample(
+        _np_softmax(logits, axis=1), rng)
     return actions.astype(np.int32), logps.astype(np.float32), \
         values.astype(np.float32)
 
@@ -176,3 +207,246 @@ def action_spec(params: Params):
     if is_continuous(params):
         return (params["mu_b"].shape[0],), np.float32
     return (), np.int32
+
+
+# ------------------------------------------------------------- stateful
+# Recurrent policy schema (see module docstring). Two families:
+#
+# - LSTM policy ("lstm_wx" marker): TWIN recurrent towers — obs
+#   embedding -> LSTM cell -> head, separately for policy and value
+#   (same twin-tower rationale as the MLP above: a shared trunk lets the
+#   value regression's large early losses dominate the recurrent
+#   features and stall policy learning at this scale — observed as a
+#   flat return curve).  State: {"h","c"} policy tower, {"hv","cv"}
+#   value tower, each (B, H).
+# - RSSM acting tower ("gru_x_w" marker): the inference-only slice of a
+#   DreamerV3 world model (GRU advance + posterior + actor), shipped by
+#   rl/dreamerv3.py so env runners act on the TRUE latent.
+#   State: {"h": (B, H), "z": (B, Z), "a": (B, A) one-hot prev action}.
+
+
+def is_stateful(params: Params) -> bool:
+    return "lstm_wx" in params or "gru_x_w" in params
+
+
+def init_lstm_policy_params(obs_size: int, num_actions: int,
+                            hidden: int = 64, seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    params: Params = {}
+
+    def dense(name, fan_in, fan_out, scale):
+        params[f"{name}_w"] = (rng.standard_normal((fan_in, fan_out))
+                               * scale).astype(np.float32)
+        params[f"{name}_b"] = np.zeros(fan_out, np.float32)
+
+    def lstm(prefix):
+        params[f"{prefix}wx"] = (
+            rng.standard_normal((hidden, 4 * hidden))
+            * np.sqrt(1.0 / hidden)).astype(np.float32)
+        params[f"{prefix}wh"] = (
+            rng.standard_normal((hidden, 4 * hidden))
+            * np.sqrt(1.0 / hidden)).astype(np.float32)
+        b = np.zeros(4 * hidden, np.float32)
+        b[hidden:2 * hidden] = 1.0      # forget-gate bias: remember early
+        params[f"{prefix}b"] = b
+
+    dense("emb", obs_size, hidden, np.sqrt(2.0 / obs_size))
+    lstm("lstm_")                       # policy tower (family marker)
+    dense("vemb", obs_size, hidden, np.sqrt(2.0 / obs_size))
+    lstm("lstm_v_")                     # value tower
+    # small-init policy head → near-uniform initial policy (as above)
+    dense("pi", hidden, num_actions, 0.01)
+    dense("vh", hidden, 1, np.sqrt(1.0 / hidden))
+    return params
+
+
+def get_initial_state(params: Params, batch_size: int = 1
+                      ) -> Dict[str, np.ndarray]:
+    """Zero state sized for ``batch_size`` envs; ``{}`` if feedforward."""
+    if "lstm_wx" in params:
+        H = params["lstm_wh"].shape[0]
+        z = np.zeros((batch_size, H), np.float32)
+        return {"h": z, "c": z.copy(), "hv": z.copy(), "cv": z.copy()}
+    if "gru_x_w" in params:
+        H = params["gru_h_w"].shape[0]
+        Z = params["post_logits_w"].shape[1]
+        A = params["actor_logits_w"].shape[1]
+        return {"h": np.zeros((batch_size, H), np.float32),
+                "z": np.zeros((batch_size, Z), np.float32),
+                "a": np.zeros((batch_size, A), np.float32)}
+    return {}
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm_cell(wx, wh, b, x, h, c):
+    H = h.shape[1]
+    z = x @ wx + h @ wh + b
+    i = _np_sigmoid(z[:, :H])
+    f = _np_sigmoid(z[:, H:2 * H])
+    g = np.tanh(z[:, 2 * H:3 * H])
+    o = _np_sigmoid(z[:, 3 * H:])
+    c2 = f * c + i * g
+    return o * np.tanh(c2), c2
+
+
+def np_lstm_step(params: Params, obs: np.ndarray,
+                 state: Dict[str, np.ndarray], is_first: np.ndarray):
+    """One batched twin-tower LSTM step: (B, obs) → (logits, values,
+    next_state). Rows flagged ``is_first`` restart from zero state
+    BEFORE the step."""
+    first = np.asarray(is_first, bool)[:, None]
+
+    def tower(emb, prefix, hk, ck):
+        h = np.where(first, 0.0, state[hk]).astype(np.float32)
+        c = np.where(first, 0.0, state[ck]).astype(np.float32)
+        x = np.tanh(obs @ params[f"{emb}_w"] + params[f"{emb}_b"])
+        return _np_lstm_cell(params[f"{prefix}wx"], params[f"{prefix}wh"],
+                             params[f"{prefix}b"], x, h, c)
+
+    hp, cp = tower("emb", "lstm_", "h", "c")
+    hv, cv = tower("vemb", "lstm_v_", "hv", "cv")
+    logits = hp @ params["pi_w"] + params["pi_b"]
+    values = (hv @ params["vh_w"] + params["vh_b"])[:, 0]
+    return (logits, values.astype(np.float32),
+            {"h": hp.astype(np.float32), "c": cp.astype(np.float32),
+             "hv": hv.astype(np.float32), "cv": cv.astype(np.float32)})
+
+
+def jax_lstm_step(params, obs, state, is_first):
+    """The same twin-tower cell in jnp (single step; used by the scan).
+    ``state`` is a dict {"h","c","hv","cv"} of (B, H) arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    first = is_first[:, None]
+    H = params["lstm_wh"].shape[0]
+
+    def cell(wx, wh, b, x, h, c):
+        z = x @ wx + h @ wh + b
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H:2 * H])
+        g = jnp.tanh(z[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H:])
+        c2 = f * c + i * g
+        return o * jnp.tanh(c2), c2
+
+    def tower(emb, prefix, hk, ck):
+        h = jnp.where(first, 0.0, state[hk])
+        c = jnp.where(first, 0.0, state[ck])
+        x = jnp.tanh(obs @ params[f"{emb}_w"] + params[f"{emb}_b"])
+        return cell(params[f"{prefix}wx"], params[f"{prefix}wh"],
+                    params[f"{prefix}b"], x, h, c)
+
+    hp, cp = tower("emb", "lstm_", "h", "c")
+    hv, cv = tower("vemb", "lstm_v_", "hv", "cv")
+    logits = hp @ params["pi_w"] + params["pi_b"]
+    values = (hv @ params["vh_w"] + params["vh_b"])[:, 0]
+    return logits, values, {"h": hp, "c": cp, "hv": hv, "cv": cv}
+
+
+def jax_lstm_forward_seq(params, obs, state, is_first):
+    """Learner-side sequence forward: (B, L, obs) + injected window-start
+    state dict → (logits (B, L, A), values (B, L)) under ONE ``lax.scan``
+    over L, re-applying the acting-time ``is_first`` resets mid-window."""
+    import jax
+
+    def step(carry, xs):
+        o_t, first_t = xs
+        logits, values, carry2 = jax_lstm_step(params, o_t, carry, first_t)
+        return carry2, (logits, values)
+
+    xs = (obs.swapaxes(0, 1), is_first.swapaxes(0, 1))
+    _, (logits, values) = jax.lax.scan(step, dict(state), xs)
+    return logits.swapaxes(0, 1), values.swapaxes(0, 1)
+
+
+# -------- RSSM acting tower (numpy mirror of DreamerV3Learner's model)
+
+def _np_symlog(x):
+    return np.sign(x) * np.log1p(np.abs(x))
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_rssm_advance(params: Params, obs: np.ndarray,
+                    state: Dict[str, np.ndarray], is_first: np.ndarray):
+    """Deterministic half of the RSSM acting step: GRU advance on
+    (h, z_prev, a_prev), post-advance ``is_first`` reset (matching the
+    trainer, which zeroes h AFTER the GRU), then posterior logits with
+    1% unimix over the symlog'd observation. Returns (h2, post_probs
+    (B, cats, classes))."""
+    h, z, a = state["h"], state["z"], state["a"]
+    D = params["gru_h_w"].shape[0]
+    meta = params["rssm_meta"]
+    cats, classes = int(meta[0]), int(meta[1])
+    x = np.concatenate([z, a], axis=-1)
+    gx = x @ params["gru_x_w"] + params["gru_x_b"]
+    gh = h @ params["gru_h_w"] + params["gru_h_b"]
+    r = _np_sigmoid(gx[:, :D] + gh[:, :D])
+    u = _np_sigmoid(gx[:, D:2 * D] + gh[:, D:2 * D])
+    cand = np.tanh(gx[:, 2 * D:] + r * gh[:, 2 * D:])
+    h2 = u * cand + (1.0 - u) * h
+    h2 = np.where(np.asarray(is_first, bool)[:, None], 0.0, h2)
+    e = np.tanh(_np_symlog(obs) @ params["enc0_w"] + params["enc0_b"])
+    pl = (np.tanh(np.concatenate([h2, e], -1) @ params["post0_w"]
+                  + params["post0_b"])
+          @ params["post_logits_w"] + params["post_logits_b"])
+    probs = _np_softmax(pl.reshape(len(obs), cats, classes), -1)
+    probs = 0.99 * probs + 0.01 / classes
+    return h2.astype(np.float32), probs
+
+
+def _np_rssm_sample_batch(params: Params, obs: np.ndarray,
+                          state: Dict[str, np.ndarray],
+                          is_first: np.ndarray, rng: np.random.Generator):
+    B = len(obs)
+    A = params["actor_logits_w"].shape[1]
+    h2, post = np_rssm_advance(params, obs, state, is_first)
+    cats, classes = post.shape[1], post.shape[2]
+    idx, _ = _np_categorical_sample(post, rng)   # per-categorical draw
+    z2 = np.eye(classes, dtype=np.float32)[idx].reshape(
+        B, cats * classes)
+    alog = (np.tanh(np.concatenate([h2, z2], -1) @ params["actor0_w"]
+                    + params["actor0_b"])
+            @ params["actor_logits_w"] + params["actor_logits_b"])
+    ap = 0.99 * _np_softmax(alog, -1) + 0.01 / A   # trainer's action unimix
+    actions, logps = _np_categorical_sample(ap, rng)
+    a2 = np.eye(A, dtype=np.float32)[actions]
+    # values are zeros: the Dreamer critic lives in imagination, runners
+    # never estimate values (same contract as the continuous sampler)
+    return (actions.astype(np.int32), logps.astype(np.float32),
+            np.zeros(B, np.float32),
+            {"h": h2, "z": z2, "a": a2})
+
+
+def np_stateful_sample_batch(params: Params, obs: np.ndarray,
+                             state: Dict[str, np.ndarray],
+                             is_first: np.ndarray,
+                             rng: np.random.Generator):
+    """Vectorized stateful acting step: (N, obs) + carried state →
+    (actions (N,), logps (N,), values (N,), next_state). Dispatches on
+    the module family's marker key; each family applies its own
+    ``is_first`` reset semantics internally."""
+    if "gru_x_w" in params:
+        return _np_rssm_sample_batch(params, obs, state, is_first, rng)
+    logits, values, next_state = np_lstm_step(params, obs, state, is_first)
+    actions, logps = _np_categorical_sample(_np_softmax(logits, -1), rng)
+    return (actions.astype(np.int32), logps.astype(np.float32),
+            values, next_state)
+
+
+def np_stateful_values(params: Params, obs: np.ndarray,
+                       state: Dict[str, np.ndarray],
+                       is_first: np.ndarray) -> np.ndarray:
+    """Value estimates WITHOUT advancing the carried state (bootstrap at
+    fragment ends). RSSM runners return zeros (no runner-side critic)."""
+    if "gru_x_w" in params:
+        return np.zeros(len(obs), np.float32)
+    _, values, _ = np_lstm_step(params, obs, state, is_first)
+    return values

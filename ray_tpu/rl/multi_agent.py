@@ -24,7 +24,10 @@ import numpy as np
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.learner import PPOLearner, compute_gae
-from ray_tpu.rl.module import init_policy_params, np_forward, np_sample_action
+from ray_tpu.rl.module import (get_initial_state, init_policy_params,
+                               is_stateful, np_forward, np_sample_action,
+                               np_stateful_sample_batch,
+                               np_stateful_values)
 
 
 class CoordinationGameEnv:
@@ -69,7 +72,14 @@ class CoordinationGameEnv:
 class MultiAgentEnvRunner:
     """Rollout actor for multi-agent envs: per-agent trajectories are
     routed to per-POLICY buffers through the policy mapping (reference
-    ``rllib/env/multi_agent_env_runner.py``)."""
+    ``rllib/env/multi_agent_env_runner.py``).
+
+    Stateful modules (rl/module.py contract) are supported on the acting
+    path: each AGENT carries its own recurrent state (agents sharing a
+    policy still have distinct histories), reset via ``is_first`` at
+    episode boundaries. The bundled MultiAgentPPO trainer builds
+    feedforward modules, so the recurrent plumbing here serves externally
+    trained stateful policies (evaluation / league play)."""
 
     def __init__(self, env_spec, policy_mapping: Dict[str, str],
                  seed: int = 0, worker_index: int = 0):
@@ -82,6 +92,11 @@ class MultiAgentEnvRunner:
         self._obs, _ = self.env.reset(seed=seed + worker_index)
         self._ep_return = 0.0
         self._weights_version = -1
+        self._agent_state: Dict[str, Dict[str, np.ndarray]] = {}
+        self._agent_first: Dict[str, bool] = {}
+        # per-policy zero-state template, rebuilt only on set_weights —
+        # _act runs per agent per step and must not re-derive it there
+        self._state_templates: Dict[str, Dict[str, np.ndarray]] = {}
 
     def ping(self) -> bool:
         return True
@@ -90,7 +105,32 @@ class MultiAgentEnvRunner:
                     version: int = 0) -> bool:
         self._params.update(params_by_policy)
         self._weights_version = version
+        self._state_templates = {
+            pid: get_initial_state(p, 1)
+            for pid, p in self._params.items() if is_stateful(p)}
         return True
+
+    def _act(self, agent_id: str, obs) -> Tuple[int, float, float]:
+        """One action for one agent, carrying per-agent recurrent state
+        for stateful policy modules."""
+        pid = self._mapping[agent_id]
+        params = self._params[pid]
+        if not is_stateful(params):
+            a, logp, value = np_sample_action(params, obs, self._rng)
+            return int(a), logp, value
+        tmpl = self._state_templates[pid]
+        state = self._agent_state.get(agent_id)
+        if state is None or set(state) != set(tmpl) or any(
+                state[k].shape != tmpl[k].shape for k in tmpl):
+            state = {k: v.copy() for k, v in tmpl.items()}
+            self._agent_first[agent_id] = True
+        first = np.array([self._agent_first.get(agent_id, True)], bool)
+        a_b, lp_b, v_b, state = np_stateful_sample_batch(
+            params, np.asarray(obs, np.float32)[None], state, first,
+            self._rng)
+        self._agent_state[agent_id] = state
+        self._agent_first[agent_id] = False
+        return int(a_b[0]), float(lp_b[0]), float(v_b[0])
 
     def sample(self, num_steps: int) -> Dict[str, Any]:
         # Buffers are PER AGENT, not per policy: agents sharing one policy
@@ -102,9 +142,7 @@ class MultiAgentEnvRunner:
         for _ in range(num_steps):
             actions, per_agent = {}, {}
             for agent_id, obs in self._obs.items():
-                pid = self._mapping[agent_id]
-                a, logp, value = np_sample_action(
-                    self._params[pid], obs, self._rng)
+                a, logp, value = self._act(agent_id, obs)
                 actions[agent_id] = int(a)
                 per_agent[agent_id] = (obs, a, logp, value)
             next_obs, rewards, terms, truncs, _ = self.env.step(actions)
@@ -126,6 +164,12 @@ class MultiAgentEnvRunner:
                 episode_returns.append(self._ep_return)
                 self._ep_return = 0.0
                 self._obs, _ = self.env.reset()
+                # drop (not just re-flag) per-agent recurrent state:
+                # next _act restarts from the zero template anyway, and
+                # envs that mint fresh agent ids per episode must not
+                # accumulate dead agents' state forever
+                self._agent_state.clear()
+                self._agent_first.clear()
             else:
                 self._obs = next_obs
         out = {}
@@ -133,9 +177,19 @@ class MultiAgentEnvRunner:
             pid = self._mapping[agent_id]
             last_val = 0.0
             if agent_id in self._obs:
-                _, v = np_forward(self._params[pid],
-                                  np.asarray(self._obs[agent_id])[None])
-                last_val = float(v[0])
+                params = self._params[pid]
+                obs1 = np.asarray(self._obs[agent_id],
+                                  np.float32)[None]
+                if is_stateful(params):
+                    last_val = float(np_stateful_values(
+                        params, obs1,
+                        self._agent_state.get(agent_id)
+                        or get_initial_state(params, 1),
+                        np.array([self._agent_first.get(agent_id, True)],
+                                 bool))[0])
+                else:
+                    _, v = np_forward(params, obs1)
+                    last_val = float(v[0])
             out[agent_id] = {
                 "policy_id": pid,
                 "obs": np.asarray(b["obs"], np.float32),

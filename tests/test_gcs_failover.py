@@ -159,8 +159,9 @@ def test_raylet_rejoins_promoted_standby(tmp_path, monkeypatch):
                     poll_interval_s=0.1, failure_threshold=3).start()
     try:
         assert c.wait_for_nodes(1)
-        _wait(lambda: sb._offset >= 0 and sb._failures == 0,
-              msg="standby attached")
+        # _ever_synced flips only on a SUCCESSFUL poll — "offset >= 0 and
+        # no failures yet" was trivially true at construction time
+        _wait(lambda: sb._ever_synced, msg="standby attached")
         c.kill_gcs()
         _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
         # raylet report loop rotates to the standby and re-registers
@@ -294,6 +295,42 @@ def test_never_synced_standby_refuses_promotion(tmp_path):
         sb.stop()
 
 
+def test_acknowledged_put_survives_kill_in_compaction_window(
+        primary, tmp_path):
+    """THE empty-log promotion hole: the standby observes a compaction
+    restart marker, truncates its stream, and the primary dies BEFORE the
+    first post-compaction chunk lands. The replica must promote from the
+    retained previous generation — an acknowledged, replicated kv_put
+    must survive, never an empty control plane."""
+    import threading
+
+    c = GcsClient(primary.address)
+    sb = GcsStandby(primary.address, str(tmp_path / "replica"),
+                    poll_interval_s=0.05, failure_threshold=3).start()
+    try:
+        c.kv_put("ns", b"durable", b"yes")     # acknowledged write
+        _wait(lambda: sb._ever_synced and sb._offset > 0,
+              msg="replication")
+        # hold the replication loop right after it processes the restart
+        # marker: the refetch of the new generation never happens
+        gate = threading.Event()
+        sb._testing_refill_gate = gate
+        primary.storage._COMPACT_MIN_OPS = 1
+        for i in range(5):
+            c.kv_put("ns", b"hot", str(i).encode())
+        _wait(lambda: sb._refilling, msg="compaction restart observed")
+        primary.stop()                          # dies inside the window
+        gate.set()
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        c2 = GcsClient(sb.address)
+        assert c2.kv_get("ns", b"durable") == b"yes"
+        c2.close()
+    finally:
+        sb._testing_refill_gate = None
+        sb.stop()
+        c.close()
+
+
 def test_compaction_restarts_replication(primary, tmp_path):
     """When the primary compacts its log, the standby restarts the
     stream from offset 0 of the new generation instead of appending
@@ -304,12 +341,18 @@ def test_compaction_restarts_replication(primary, tmp_path):
     try:
         c.kv_put("ns", b"a", b"1")
         _wait(lambda: sb._offset > 0, msg="initial replication")
+        gen0 = sb._generation
         # force a compaction under the replica's feet
         primary.storage._COMPACT_MIN_OPS = 1
         for i in range(30):
             c.kv_put("ns", b"hot", str(i).encode())
-        _wait(lambda: sb._generation is not None and sb._generation > 0,
-              msg="generation bump observed")
+        # wait for an actual generation CHANGE (the initial generation may
+        # already be > 0 after an open-time compaction — the old `> 0`
+        # predicate could pass before the bump) AND for the refill swap to
+        # complete, so promotion serves the new generation's data
+        _wait(lambda: sb._generation not in (None, gen0)
+              and not sb._refilling,
+              msg="post-compaction resync")
         primary.stop()
         _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
         c2 = GcsClient(sb.address)

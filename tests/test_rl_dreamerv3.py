@@ -79,14 +79,32 @@ class TestPieces:
         assert last["wm_loss"] < first["wm_loss"]
         assert np.isfinite(last["loss"])
 
-    def test_runner_weights_match_module_schema(self):
-        from ray_tpu.rl.module import np_forward
+    def test_runner_weights_match_stateful_module_schema(self):
+        """The exported acting tower is an rl/module.py RSSM stateful
+        module: runners carry (h, z, a) and act on the true latent."""
+        from ray_tpu.rl.module import (get_initial_state, is_stateful,
+                                       np_stateful_sample_batch)
 
         cfg = DreamerV3Config(seed=0)
         lrn = DreamerV3Learner(obs_size=4, num_actions=2, cfg=cfg)
         w = lrn.get_runner_weights()
-        logits, value = np_forward(w, np.zeros((3, 4), np.float32))
-        assert logits.shape == (3, 2) and value.shape == (3,)
+        assert is_stateful(w)
+        state = get_initial_state(w, 3)
+        assert state["h"].shape == (3, cfg.deter)
+        assert state["z"].shape == (3, cfg.latent_categoricals
+                                    * cfg.latent_classes)
+        rng = np.random.default_rng(0)
+        obs = np.zeros((3, 4), np.float32)
+        first = np.array([True, True, False])
+        actions, logps, values, state2 = np_stateful_sample_batch(
+            w, obs, state, first, rng)
+        assert actions.shape == (3,) and actions.dtype == np.int32
+        assert np.all(logps <= 0.0) and np.all(values == 0.0)
+        # reset semantics: is_first rows restart the deterministic state
+        # from zero (post-GRU), non-first rows advance it
+        assert state2["h"].shape == (3, cfg.deter)
+        # one-hot action feedback for the next GRU advance
+        np.testing.assert_allclose(state2["a"].sum(-1), 1.0)
 
 
 class TestDreamerV3Learns:
@@ -108,7 +126,10 @@ class TestDreamerV3Learns:
                 .build())
         best = 0.0
         try:
-            for i in range(40):
+            # 55 iterations: the bar is typically crossed near iter 36
+            # on this box; the extra headroom absorbs run-to-run drift
+            # from fragment-RPC timing under CPU contention
+            for i in range(55):
                 r = algo.train()
                 best = max(best,
                            r["env_runners"]["episode_return_mean"] or 0.0)

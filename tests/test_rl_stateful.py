@@ -1,0 +1,296 @@
+"""Stateful policy subsystem (rl/module.py recurrent contract):
+state reset on is_first, state threading across env-runner sample()
+boundaries, numpy-vs-JAX tower equivalence, sequence windowing with
+state injection — and the capability proof: an LSTM policy solves a
+memory task (masked-velocity CartPole POMDP) that the feedforward
+module fails at the same budget.
+
+Reference: ``RLModule.get_initial_state``
+(rllib/core/rl_module/rl_module.py:653) and the Podracer pattern of
+carried policy state as a first-class rollout/learner concern
+(PAPERS.md: arXiv:2104.06272).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.connectors import window_sequences
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.module import (
+    get_initial_state,
+    init_lstm_policy_params,
+    init_policy_params,
+    is_stateful,
+    np_lstm_step,
+    np_stateful_sample_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class _DriftEnv:
+    """Deterministic never-terminating env (sinusoidal obs): lets tests
+    assert exact state threading without episode-boundary noise."""
+
+    observation_size = 3
+    num_actions = 2
+    max_episode_steps = 10_000
+
+    def __init__(self, seed=None):
+        self._t = 0
+
+    def _obs(self):
+        t = self._t / 7.0
+        return np.array([np.sin(t), np.cos(t), 0.1 * (self._t % 5)],
+                        np.float32)
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        return self._obs(), 1.0, False, self._t >= 10_000, {}
+
+
+class _EveryKEnv(_DriftEnv):
+    """Terminates deterministically every K steps."""
+
+    K = 4
+
+    def step(self, action):
+        obs, rew, _, trunc, info = super().step(action)
+        return obs, rew, self._t % self.K == 0, trunc, {}
+
+
+class TestModuleContract:
+    def test_feedforward_module_is_stateless(self):
+        p = init_policy_params(4, 2, seed=0)
+        assert not is_stateful(p)
+        assert get_initial_state(p, 3) == {}
+
+    def test_lstm_state_reset_on_is_first(self):
+        """An is_first row must behave exactly as a fresh zero state —
+        whatever garbage the carried slot holds."""
+        p = init_lstm_policy_params(3, 2, hidden=8, seed=1)
+        rng = np.random.default_rng(0)
+        obs = rng.standard_normal((4, 3)).astype(np.float32)
+        garbage = {k: rng.standard_normal((4, 8)).astype(np.float32)
+                   for k in ("h", "c", "hv", "cv")}
+        lg_first, v_first, st_first = np_lstm_step(
+            p, obs, garbage, np.ones(4, bool))
+        lg_zero, v_zero, st_zero = np_lstm_step(
+            p, obs, get_initial_state(p, 4), np.zeros(4, bool))
+        np.testing.assert_allclose(lg_first, lg_zero, rtol=1e-6)
+        np.testing.assert_allclose(v_first, v_zero, rtol=1e-6)
+        np.testing.assert_allclose(st_first["h"], st_zero["h"], rtol=1e-6)
+        # ...and a NON-first row keeps its carried state (different out)
+        lg_keep, _, _ = np_lstm_step(p, obs, garbage, np.zeros(4, bool))
+        assert not np.allclose(lg_keep, lg_zero)
+
+    def test_np_vs_jax_tower_state_step_equivalence(self):
+        """The numpy acting tower and the JAX training scan are the SAME
+        network: stepping a sequence one step at a time in numpy matches
+        one jitted scan over the window, including mid-window resets."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.module import jax_lstm_forward_seq
+
+        p = init_lstm_policy_params(3, 2, hidden=8, seed=2)
+        rng = np.random.default_rng(3)
+        B, L = 3, 12
+        obs = rng.standard_normal((B, L, 3)).astype(np.float32)
+        is_first = rng.random((B, L)) < 0.2
+        is_first[:, 0] = [True, False, True]
+        state = {k: rng.standard_normal((B, 8)).astype(np.float32)
+                 for k in ("h", "c", "hv", "cv")}
+        np_logits = np.zeros((B, L, 2), np.float32)
+        np_values = np.zeros((B, L), np.float32)
+        st = {k: v.copy() for k, v in state.items()}
+        for t in range(L):
+            np_logits[:, t], np_values[:, t], st = np_lstm_step(
+                p, obs[:, t], st, is_first[:, t])
+        jlogits, jvalues = jax_lstm_forward_seq(
+            p, jnp.asarray(obs),
+            {k: jnp.asarray(v) for k, v in state.items()},
+            jnp.asarray(is_first))
+        np.testing.assert_allclose(np.asarray(jlogits), np_logits,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jvalues), np_values,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_stateful_sampler_shapes(self):
+        p = init_lstm_policy_params(3, 2, hidden=8, seed=4)
+        rng = np.random.default_rng(0)
+        st = get_initial_state(p, 5)
+        a, lp, v, st2 = np_stateful_sample_batch(
+            p, np.zeros((5, 3), np.float32), st, np.ones(5, bool), rng)
+        assert a.shape == (5,) and a.dtype == np.int32
+        assert lp.shape == (5,) and v.shape == (5,)
+        assert st2["h"].shape == (5, 8) and st2["c"].shape == (5, 8)
+
+
+class TestRunnerStateThreading:
+    def _params(self, env=_DriftEnv):
+        return init_lstm_policy_params(env.observation_size,
+                                       env.num_actions, hidden=8, seed=0)
+
+    def test_state_threads_across_sample_calls(self, rt):
+        """Two sample() calls must be indistinguishable from one long
+        one: same actions, same recorded state columns — the carried
+        state crosses the batch boundary instead of resetting."""
+        r1 = EnvRunner(_DriftEnv, seed=0, num_envs=2)
+        r1.set_weights(self._params(), 1)
+        f_a, f_b = r1.sample(6), r1.sample(6)
+        r2 = EnvRunner(_DriftEnv, seed=0, num_envs=2)
+        r2.set_weights(self._params(), 1)
+        f_full = r2.sample(12)
+        for i in range(2):
+            np.testing.assert_array_equal(
+                np.concatenate([f_a[i]["actions"], f_b[i]["actions"]]),
+                f_full[i]["actions"])
+            np.testing.assert_allclose(
+                np.concatenate([f_a[i]["state_in"]["h"],
+                                f_b[i]["state_in"]["h"]]),
+                f_full[i]["state_in"]["h"], rtol=1e-6)
+            # the second fragment resumes mid-episode: NOT is_first, and
+            # its first recorded state is the live (nonzero) carry
+            assert f_a[i]["is_first"][0]
+            assert not f_b[i]["is_first"][0]
+            assert np.abs(f_b[i]["state_in"]["h"][0]).sum() > 0
+
+    def test_state_resets_at_episode_boundaries(self, rt):
+        r = EnvRunner(_EveryKEnv, seed=0, num_envs=1)
+        r.set_weights(self._params(_EveryKEnv), 1)
+        frag = r.sample(13)
+        # terminates every 4 steps → is_first at 0, 4, 8, 12
+        np.testing.assert_array_equal(
+            np.flatnonzero(frag["is_first"]), [0, 4, 8, 12])
+        np.testing.assert_array_equal(
+            np.flatnonzero(frag["dones"]), [3, 7, 11])
+        # the module ignores carried state at is_first rows: replaying
+        # step 4 with zero state gives the same logits it acted with
+        p = self._params(_EveryKEnv)
+        lg_a, _, _ = np_lstm_step(
+            p, frag["obs"][4][None],
+            {k: v[4][None] for k, v in frag["state_in"].items()},
+            np.array([True]))
+        lg_b, _, _ = np_lstm_step(
+            p, frag["obs"][4][None], get_initial_state(p, 1),
+            np.array([False]))
+        np.testing.assert_allclose(lg_a, lg_b, rtol=1e-6)
+
+    def test_single_env_runner_returns_dict_fragment(self, rt):
+        """num_envs == 1 back-compat shape holds for stateful modules."""
+        r = EnvRunner(_DriftEnv, seed=0, num_envs=1)
+        r.set_weights(self._params(), 7)
+        f = r.sample(5)
+        assert isinstance(f, dict)
+        assert f["obs"].shape == (5, 3)
+        assert f["state_in"]["h"].shape == (5, 8)
+        assert f["weights_version"] == 7
+
+
+class TestWindowing:
+    def test_window_sequences_state_at_window_starts(self):
+        F, T, L = 2, 12, 4
+        batch = {
+            "obs": np.arange(F * T * 3, dtype=np.float32).reshape(F, T, 3),
+            "actions": np.arange(F * T).reshape(F, T),
+            "is_first": np.zeros((F, T), bool),
+            "state_in_h": np.arange(F * T * 5,
+                                    dtype=np.float32).reshape(F, T, 5),
+        }
+        out = window_sequences(batch, L)
+        B = F * (T // L)
+        assert out["obs"].shape == (B, L, 3)
+        assert out["actions"].shape == (B, L)
+        assert out["state_in_h"].shape == (B, 5)
+        # window k of fragment f starts at step k*L: its state row is the
+        # recorded per-step state at exactly that step
+        np.testing.assert_array_equal(out["state_in_h"][1],
+                                      batch["state_in_h"][0, L])
+        np.testing.assert_array_equal(out["obs"][1], batch["obs"][0, L:2 * L])
+
+    def test_window_sequences_drops_remainder(self):
+        batch = {"obs": np.zeros((1, 10, 2), np.float32)}
+        out = window_sequences(batch, 4)
+        assert out["obs"].shape == (2, 4, 2)
+
+    def test_sequence_replay_ships_state_at_window_starts(self):
+        from ray_tpu.rl.replay import SequenceReplay
+
+        rep = SequenceReplay(1000, seq_len=4, seed=0)
+        n = 20
+        state_h = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        rep.add_fragment({
+            "obs": np.arange(n * 2, dtype=np.float32).reshape(n, 2),
+            "actions": np.zeros(n, np.int32),
+            "rewards": np.ones(n), "dones": np.zeros(n, bool),
+            "terminated": np.zeros(n, bool),
+            "is_first": np.eye(1, n, 0, dtype=bool)[0],
+            "state_in": {"h": state_h},
+        })
+        s = rep.sample(8)
+        assert s["state_in_h"].shape == (8, 3)
+        for b in range(8):
+            # the flat state row is the per-step state at the window start
+            start = int(s["obs"][b, 0, 0] // 2)
+            np.testing.assert_array_equal(s["state_in_h"][b],
+                                          state_h[start])
+
+
+class TestMemoryTask:
+    """The capability proof: masked-velocity CartPole is unsolvable
+    without memory. Same algorithm, same budget, same seeds — only the
+    module family differs."""
+
+    # empirics on this box (deterministic seeds): feedforward converges
+    # by ~iter 25 and plateaus at ~48 best over 80 iters; the LSTM
+    # crosses 85 around iter 55 and keeps climbing
+    BAR = 85.0
+    ITERS = 80
+
+    def _run(self, module: str, rt) -> float:
+        from ray_tpu.rl import PPOConfig
+
+        algo = PPOConfig(seed=1, hidden=(32, 32), module=module,
+                         env="CartPoleMaskedVelocity-v1",
+                         num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=128, seq_len=16,
+                         lr=1e-3).build()
+        best = 0.0
+        try:
+            # iteration-bounded, no wall-clock deadline: a slow box must
+            # not turn a capability assertion into a timing flake (~20s
+            # for 80 iters on the reference box)
+            for _ in range(self.ITERS):
+                res = algo.train()
+                er = res["env_runners"]["episode_return_mean"]
+                if er == er:           # NaN-safe
+                    best = max(best, er)
+                if best >= self.BAR:
+                    break
+        finally:
+            algo.stop()
+        return best
+
+    def test_lstm_solves_memory_task_feedforward_cannot(self, rt):
+        lstm_best = self._run("lstm", rt)
+        assert lstm_best >= self.BAR, \
+            f"LSTM policy failed the memory task: best {lstm_best}"
+        ff_best = self._run("mlp", rt)
+        # negative learning assertion, so the margin is deliberately
+        # huge: the memoryless plateau is ~48 (it CONVERGES there — more
+        # iterations don't help, the velocity information isn't in the
+        # observation), while the bar is 85; run-to-run drift from
+        # fragment-RPC timing moves the plateau by a few points, not 37
+        assert ff_best < self.BAR, \
+            f"feedforward unexpectedly solved the POMDP: {ff_best} — " \
+            "the task no longer demonstrates that state is required"
