@@ -20,6 +20,7 @@
 #include <map>
 #include <memory>
 #include <string>
+#include <tuple>
 #include <type_traits>
 #include <utility>
 #include <vector>
@@ -76,6 +77,42 @@ Value RtClusterResources();
 
 // ------------------------------------------------------- value conversion
 
+// User-struct task-boundary serialization (reference parity: the
+// msgpack adaptor in cpp/include/ray/api/serializer.h +
+// MSGPACK_DEFINE). Two forms:
+//
+//   // intrusive — list the fields inside the struct:
+//   struct Point { double x; std::vector<int> tags;
+//                  RAY_TPU_SERIALIZE(x, tags) };
+//
+//   // non-intrusive — specialize for foreign types:
+//   template <> struct ray_tpu::Serializer<lib::Point> {
+//     static ray_tpu::Value Dump(const lib::Point& p);
+//     static lib::Point Load(const ray_tpu::Value& v);
+//   };
+//
+// Either way the struct crosses task/actor boundaries as a plain tuple
+// (positional, like a msgpack array) and surfaces in Python as a tuple;
+// fields recurse through ToValue/FromValue, so nested structs, vectors
+// of structs, and string-keyed maps of structs all work.
+template <typename T, typename = void>
+struct Serializer;  // primary undefined: no adaptor for T
+
+namespace internal {
+template <typename T, typename = void>
+struct has_intrusive : std::false_type {};
+template <typename T>
+struct has_intrusive<
+    T, std::void_t<decltype(std::declval<const T&>().RayTpuDump())>>
+    : std::true_type {};
+template <typename T, typename = void>
+struct has_serializer : std::false_type {};
+template <typename T>
+struct has_serializer<
+    T, std::void_t<decltype(Serializer<T>::Dump(std::declval<const T&>()))>>
+    : std::true_type {};
+}  // namespace internal
+
 template <typename T>
 struct is_vector : std::false_type {};
 template <typename E>
@@ -108,10 +145,16 @@ Value ToValue(const T& v) {
     for (const auto& kv : v)
       d.emplace_back(Value::Str(kv.first), ToValue(kv.second));
     return Value::Dict(std::move(d));
+  } else if constexpr (internal::has_intrusive<D>::value) {
+    return v.RayTpuDump();
+  } else if constexpr (internal::has_serializer<D>::value) {
+    return Serializer<D>::Dump(v);
   } else {
     static_assert(sizeof(D) == 0,
                   "unsupported task-boundary type: use plain data "
-                  "(numbers/strings/vectors/maps) or ray_tpu::Value");
+                  "(numbers/strings/vectors/maps), ray_tpu::Value, or "
+                  "declare fields with RAY_TPU_SERIALIZE / specialize "
+                  "ray_tpu::Serializer<T>");
   }
 }
 
@@ -140,10 +183,63 @@ T FromValue(const Value& v) {
     for (const auto& kv : v.dict())
       out[kv.first.as_str()] = FromValue<typename D::mapped_type>(kv.second);
     return out;
+  } else if constexpr (internal::has_intrusive<D>::value) {
+    D out{};
+    out.RayTpuLoad(v);
+    return out;
+  } else if constexpr (internal::has_serializer<D>::value) {
+    return Serializer<D>::Load(v);
   } else {
     static_assert(sizeof(D) == 0, "unsupported task-boundary type");
   }
 }
+
+namespace internal {
+
+template <typename Tuple, size_t... Is>
+Value PackTupleImpl(const Tuple& t, std::index_sequence<Is...>) {
+  ValueList items;
+  items.reserve(sizeof...(Is));
+  (items.push_back(ToValue(std::get<Is>(t))), ...);
+  return Value::Tuple(std::move(items));
+}
+
+template <typename... Ts>
+Value PackTuple(const std::tuple<Ts...>& t) {
+  return PackTupleImpl(t, std::index_sequence_for<Ts...>{});
+}
+
+template <typename Tuple, size_t... Is>
+void UnpackTupleImpl(const Value& v, Tuple refs,
+                     std::index_sequence<Is...>) {
+  const ValueList& items = v.items();  // accepts Tuple or List (Python)
+  if (items.size() != sizeof...(Is))
+    throw std::runtime_error(
+        "struct field count mismatch crossing a task boundary: got " +
+        std::to_string(items.size()) + " fields, struct declares " +
+        std::to_string(sizeof...(Is)));
+  ((std::get<Is>(refs) =
+        FromValue<std::decay_t<std::tuple_element_t<Is, Tuple>>>(items[Is])),
+   ...);
+}
+
+template <typename... Ts>
+void UnpackTuple(const Value& v, std::tuple<Ts...> refs) {
+  UnpackTupleImpl(v, refs, std::index_sequence_for<Ts...>{});
+}
+
+}  // namespace internal
+
+// msgpack-style field declaration (MSGPACK_DEFINE analog): place inside
+// the struct with its serializable fields. Requires the struct to be
+// default-constructible on the receiving side.
+#define RAY_TPU_SERIALIZE(...)                                          \
+  ::ray_tpu::Value RayTpuDump() const {                                 \
+    return ::ray_tpu::internal::PackTuple(std::tie(__VA_ARGS__));       \
+  }                                                                     \
+  void RayTpuLoad(const ::ray_tpu::Value& _rt_v) {                      \
+    ::ray_tpu::internal::UnpackTuple(_rt_v, std::tie(__VA_ARGS__));     \
+  }
 
 // --------------------------------------------------------------- ObjectRef
 

@@ -21,6 +21,16 @@
     }                                                           \
   } while (0)
 
+// user struct crossing task boundaries via the msgpack-style adaptor
+// (RAY_TPU_SERIALIZE — positional tuple on the wire, tuple in Python)
+struct TaskRecord {
+  int64_t id{};
+  double score{};
+  std::string tag;
+  std::vector<int> parts;
+  RAY_TPU_SERIALIZE(id, score, tag, parts)
+};
+
 int main(int argc, char** argv) {
   if (argc != 3) {
     std::fprintf(stderr, "usage: driver_xlang <host> <port>\n");
@@ -92,6 +102,34 @@ int main(int argc, char** argv) {
 
   placed.Kill();
   ray_tpu::RemovePlacementGroup(pg);
+
+  // ---- user-struct serialization (msgpack-style adaptor) ----
+  TaskRecord rec{7, 1.5, "alpha", {1, 2, 3}};
+
+  // cluster object round-trip (C++ -> pickle tuple -> C++)
+  auto rref = ray_tpu::Put(rec);
+  TaskRecord rback = ray_tpu::Get(rref, 30000);
+  CHECK(rback.id == 7 && rback.score == 1.5 && rback.tag == "alpha" &&
+        rback.parts == (std::vector<int>{1, 2, 3}));
+
+  // struct through Python task args AND returns
+  auto bumped = ray_tpu::PyTask<TaskRecord>("tests.xlang_helpers",
+                                            "bump_record")
+                    .Remote(rec);
+  TaskRecord out = ray_tpu::Get(bumped, 60000);
+  CHECK(out.id == 8 && out.score == 3.0 && out.tag == "alpha!" &&
+        out.parts == (std::vector<int>{1, 2, 3, 9}));
+
+  // struct through a Python ACTOR call (stored, mutated, returned)
+  auto store = ray_tpu::PyActor("tests.xlang_helpers", "RecordStore")
+                   .Remote();
+  auto n = store.Task("put").Remote<int64_t>(rec);
+  CHECK(ray_tpu::Get(n, 60000) == 1);
+  auto latest = store.Task("latest").Remote<TaskRecord>();
+  TaskRecord stored = ray_tpu::Get(latest, 60000);
+  CHECK(stored.id == 7 && stored.parts.size() == 4 &&
+        stored.parts.back() == 6);  // actor appends sum(parts)
+  store.Kill();
 
   ray_tpu::Shutdown();
   std::printf("XLANG-OK\n");

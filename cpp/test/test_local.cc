@@ -21,6 +21,27 @@ RAY_REMOTE(Norm)
 std::string Greet(std::string who) { return "hello " + who; }
 RAY_REMOTE(Greet)
 
+// user struct with the msgpack-style field adaptor; nested inside a
+// vector to exercise recursive conversion
+struct Span {
+  int64_t lo{};
+  int64_t hi{};
+  RAY_TPU_SERIALIZE(lo, hi)
+};
+
+struct Shape {
+  std::string label;
+  std::vector<Span> spans;
+  RAY_TPU_SERIALIZE(label, spans)
+};
+
+Shape Widen(Shape s, int64_t by) {
+  for (auto& sp : s.spans) sp.hi += by;
+  s.label += "+";
+  return s;
+}
+RAY_REMOTE(Widen)
+
 class Counter {
  public:
   explicit Counter(int start) : n_(start) {}
@@ -63,6 +84,17 @@ int main() {
   CHECK(ray_tpu::Get(t2) == 25.0);
   auto t3 = ray_tpu::Task(Greet).Remote("tpu");
   CHECK(ray_tpu::Get(t3) == "hello tpu");
+
+  // user structs: put/get + through remote-function args and returns
+  Shape shape{"box", {{1, 4}, {10, 12}}};
+  auto rs = ray_tpu::Put(shape);
+  Shape sback = ray_tpu::Get(rs);
+  CHECK(sback.label == "box" && sback.spans.size() == 2 &&
+        sback.spans[1].hi == 12);
+  auto widened = ray_tpu::Task(Widen).Remote(shape, int64_t{5});
+  Shape wide = ray_tpu::Get(widened);
+  CHECK(wide.label == "box+" && wide.spans[0].hi == 9 &&
+        wide.spans[1].hi == 17);
 
   // wait
   std::vector<ray_tpu::ObjectRef<int>> refs;

@@ -89,8 +89,11 @@ class XlaGroup:
             out_spec = P(axis)
         else:
             raise AssertionError(kind)
-        fn = jax.jit(jax.shard_map(body, mesh=self.mesh,
-                                   in_specs=P(axis), out_specs=out_spec))
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # jax < 0.5
+            from jax.experimental.shard_map import shard_map
+        fn = jax.jit(shard_map(body, mesh=self.mesh,
+                               in_specs=P(axis), out_specs=out_spec))
         self._fn_cache[(kind, lax_name)] = fn
         return fn
 

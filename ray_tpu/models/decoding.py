@@ -300,65 +300,83 @@ def make_chunked_prefill(params: Params, config: LlamaConfig):
     return call
 
 
-def make_spec_verify(params: Params, config: LlamaConfig):
-    """Speculative-decoding verify step (vLLM prompt-lookup / ngram
-    flavor): evaluate k+1 candidate tokens starting at the slot's
-    current length in ONE forward, returning logits for EVERY position —
-    the engine accepts the longest proposal prefix whose argmax chain
-    matches and takes one bonus token from the first divergence.
+def make_batched_spec_verify(params: Params, config: LlamaConfig):
+    """Speculative-decoding verify: score K+1 candidate tokens for EVERY
+    slot in ONE forward (the speculation subsystem's target-model step —
+    :mod:`ray_tpu.models.speculation` owns proposers and acceptance).
 
-    verify(cache, tokens (1, C), true_len, start_pos, slot) →
-        (cache, all_logits (C, vocab) f32)
+    verify(cache, tokens (B, C), true_lens (B,), start_pos (B,)) →
+        (cache, all_logits (B, C, vocab) f32)
 
-    Cache rows for ALL C tokens are written (rejected rows sit beyond
-    the final length and are overwritten by later writes; attention
-    masks by length, so they are invisible). The caller fixes
-    ``cache["length"]`` to the accepted length afterwards."""
+    B must equal the cache's slot count. Per slot, ``tokens[b, :true_lens
+    [b]]`` is the window [pending_token, proposals...] written at rows
+    [start_pos[b], start_pos[b] + true_lens[b]); ``true_lens[b] == 1`` is
+    a plain decode step for that slot and ``true_lens[b] == 0`` leaves it
+    untouched (inactive) — one compiled program serves speculating,
+    non-speculating, and idle slots alike under continuous batching.
+
+    Cache rows for every valid window position are written; rejected
+    rows sit beyond the accepted length the caller installs afterwards
+    (the engine overwrites ``cache["length"]`` wholesale) and are
+    overwritten by later writes — attention masks by position, so they
+    are invisible."""
     c = config
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
 
     @functools.partial(jax.jit, donate_argnums=(0,),
                        static_argnames=("pad_len",))
-    def verify(cache: Cache, tokens: jax.Array, true_len: jax.Array,
-               start_pos: jax.Array, slot: jax.Array, pad_len: int):
+    def verify(cache: Cache, tokens: jax.Array, true_lens: jax.Array,
+               start_pos: jax.Array, pad_len: int):
         S = cache["k"].shape[2]
-        x = params["embed"].astype(c.dtype)[tokens]          # (1, C, E)
-        rel = jnp.arange(pad_len)
-        positions = (start_pos + rel)[None, :]
-        mask_valid = rel < true_len
+        B = tokens.shape[0]
+        x = params["embed"].astype(c.dtype)[tokens]          # (B, C, E)
+        rel = jnp.arange(pad_len)                            # (C,)
+        positions = start_pos[:, None] + rel[None, :]        # (B, C)
+        valid = rel[None, :] < true_lens[:, None]            # (B, C)
+        # gather-side clamp only: invalid rows may index past S. The
+        # scatter below uses the UNCLAMPED positions so out-of-range
+        # updates are dropped (jax scatter default) instead of clamping
+        # onto S-1 — a clamped duplicate would race the last valid row's
+        # write (scatter order with duplicate indices is undefined)
+        row_idx = jnp.minimum(positions, S - 1)
+        rope_pos = jnp.minimum(positions, cos.shape[0] - 1)
+        bidx = jnp.arange(B)[:, None]                        # (B, 1)
 
         def body(x, scanned):
-            layer, kc_all, vc_all = scanned
+            layer, kc, vc = scanned                          # (B, S, KV, D)
             h = rmsnorm(x, layer["attn_norm"], c.norm_eps)
             q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(h.dtype))
             k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(h.dtype))
             v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
-            q = apply_rope(q, cos, sin, positions)
-            k = apply_rope(k, cos, sin, positions)
-            kc_all = jax.lax.dynamic_update_slice(
-                kc_all, jnp.where(mask_valid[None, :, None, None], k,
-                                  0.0).astype(kc_all.dtype),
-                (slot, start_pos, 0, 0))
-            vc_all = jax.lax.dynamic_update_slice(
-                vc_all, jnp.where(mask_valid[None, :, None, None], v,
-                                  0.0).astype(vc_all.dtype),
-                (slot, start_pos, 0, 0))
-            ks = kc_all[slot]
-            vs = vc_all[slot]
-            KV = ks.shape[1]
+            q = apply_rope(q, cos, sin, rope_pos)
+            k = apply_rope(k, cos, sin, rope_pos)
+            # scatter each slot's window rows at its own offset; in-range
+            # invalid rows re-write their current contents, out-of-range
+            # rows are dropped (positions unclamped — no duplicates)
+            old_k = kc[bidx, row_idx]                        # (B, C, KV, D)
+            old_v = vc[bidx, row_idx]
+            sel = valid[..., None, None]
+            kc = kc.at[bidx, positions].set(
+                jnp.where(sel, k, old_k).astype(kc.dtype))
+            vc = vc.at[bidx, positions].set(
+                jnp.where(sel, v, old_v).astype(vc.dtype))
+            # attend over the slot's full row set: key j visible to
+            # window query i iff j <= start_pos + i (grouped einsum, KV
+            # never head-repeated — same layout as _attend_cached)
+            KV = kc.shape[2]
             H = q.shape[2]
             group = H // KV
-            qg = (q[0].astype(jnp.float32)
-                  .reshape(pad_len, KV, group, -1))
-            s = jnp.einsum("ckgd,skd->kgcs", qg,
-                           ks.astype(jnp.float32)) * (c.head_dim ** -0.5)
-            allowed = (jnp.arange(S)[None, :]
-                       <= (start_pos + rel)[:, None])
-            s = jnp.where(allowed[None, None], s, -1e30)
+            qg = (q.astype(jnp.float32)
+                  .reshape(B, pad_len, KV, group, -1))       # (B,C,KV,g,D)
+            s = jnp.einsum("bckgd,bskd->bkgcs", qg,
+                           kc.astype(jnp.float32)) * (c.head_dim ** -0.5)
+            allowed = (jnp.arange(S)[None, None, :]
+                       <= positions[:, :, None])             # (B, C, S)
+            s = jnp.where(allowed[:, None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum("kgcs,skd->ckgd", p,
-                             vs.astype(jnp.float32))
-            out = out.reshape(1, pad_len, H, -1).astype(x.dtype)
+            out = jnp.einsum("bkgcs,bskd->bckgd", p,
+                             vc.astype(jnp.float32))
+            out = out.reshape(B, pad_len, H, -1).astype(x.dtype)
             x = x + jnp.einsum("bshd,hde->bse", out,
                                layer["wo"].astype(x.dtype))
             h2 = rmsnorm(x, layer["mlp_norm"], c.norm_eps)
@@ -367,43 +385,28 @@ def make_spec_verify(params: Params, config: LlamaConfig):
             u = jnp.einsum("bse,em->bsm", h2, layer["w_up"].astype(h2.dtype))
             x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(g) * u,
                                layer["w_down"].astype(h2.dtype))
-            return x, (kc_all, vc_all)
+            return x, (kc, vc)
 
         x, (new_k, new_v) = jax.lax.scan(
             body, x, (params["layers"], cache["k"], cache["v"]))
         x = rmsnorm(x, params["final_norm"], c.norm_eps)
         head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-        all_logits = jnp.einsum("ce,ev->cv", x[0].astype(jnp.float32),
+        all_logits = jnp.einsum("bce,ev->bcv", x.astype(jnp.float32),
                                 head.astype(jnp.float32))
-        # length is provisionally start+true_len; the engine overwrites
-        # it with the accepted length right after
-        new_len = cache["length"].at[slot].set(start_pos + true_len)
+        # provisional: start + window length for touched slots; the
+        # engine installs the accepted lengths right after
+        new_len = jnp.where(true_lens > 0,
+                            (start_pos + true_lens).astype(jnp.int32),
+                            cache["length"])
         return ({"k": new_k, "v": new_v, "length": new_len}, all_logits)
 
-    def call(cache, tokens, true_len, start_pos, slot):
+    def call(cache, tokens, true_lens, start_pos):
         pad_len = tokens.shape[1]
-        return verify(cache, tokens, jnp.asarray(true_len, jnp.int32),
-                      jnp.asarray(start_pos, jnp.int32),
-                      jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+        return verify(cache, tokens,
+                      jnp.asarray(true_lens, jnp.int32),
+                      jnp.asarray(start_pos, jnp.int32), pad_len=pad_len)
 
     return call
-
-
-def propose_ngram(context: list, k: int, ngram: int = 2):
-    """Prompt-lookup proposal (vLLM "[ngram]" speculative method): find
-    the most recent earlier occurrence of the trailing ``ngram`` tokens
-    and propose the k tokens that followed it. None if no match."""
-    if len(context) < ngram + 1:
-        return None
-    tail = context[-ngram:]
-    # scan right-to-left, excluding the trailing occurrence itself
-    for i in range(len(context) - ngram - 1, -1, -1):
-        if context[i:i + ngram] == tail:
-            nxt = context[i + ngram:i + ngram + k]
-            if nxt:
-                return list(nxt)
-            return None
-    return None
 
 
 def make_inject(config: LlamaConfig):

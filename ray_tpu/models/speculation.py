@@ -1,0 +1,428 @@
+"""Speculative decoding subsystem: pluggable proposers + acceptance rule.
+
+The serve engine (``ray_tpu.serve.llm``) turns every decode iteration
+into a *verify* step over a K+1-token window per slot (see
+``make_batched_spec_verify`` in :mod:`ray_tpu.models.decoding`): a
+proposer guesses up to K next tokens per active slot, the target model
+scores the whole window in one forward, and the standard rejection-
+sampling rule accepts a prefix + one bonus token. Slots with no
+proposal degenerate to a 1-token window — i.e. a plain decode step —
+so speculation composes with continuous batching (per-slot windows,
+admission/eviction between iterations) instead of the old
+lone-greedy-stream special case.
+
+Proposers (vLLM ``speculative_config`` parity, reference:
+``python/ray/llm/_internal/serve/.../vllm_models.py``):
+
+- ``ngram`` — prompt lookup: propose the k tokens that followed the most
+  recent earlier occurrence of the trailing n-gram. No extra model, no
+  device state.
+- ``draft`` — a small Llama-family draft model runs in lockstep with the
+  target: its own slot cache is prefilled on admission, advanced K
+  greedy decode steps per proposal round, and rolled back to the
+  accepted prefix after each verify (rows past the length are invisible,
+  the same contract as the target cache). A slot the draft fell behind
+  on (all-K acceptance consumes one token the draft never cached)
+  catches up through the draft's own batched verify before proposing.
+
+Acceptance (``accept_speculative``): proposals are deterministic given
+the proposer state, i.e. a delta distribution q. For temperature 0 the
+rule reduces to the argmax-chain comparison (token-identical to
+non-speculative greedy decoding). For temperature > 0 the target
+distribution is preserved exactly: token x is accepted with probability
+p(x); on rejection the bonus token is resampled from the residual
+max(0, p - q) — p with the rejected token zeroed out, renormalized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_METHODS = ("ngram", "draft")
+# NB: no "enabled" here — disabling engine-level speculation is spelled
+# speculation=None; per-request opt-out ({"enabled": False}) is a
+# different surface (serve.llm._parse_req_spec)
+_DICT_KEYS = {"method", "k", "ngram", "draft_model", "draft_config",
+              "draft_params", "draft_seed"}
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculationConfig:
+    """Canonical speculation config (engine kwarg / declarative spec).
+
+    Accepted user forms (``parse``): a method string (``"ngram"`` /
+    ``"draft"``) or a dict ``{"method": ..., "k": ..., "draft_model":
+    ...}``. ``draft_model`` names a config in
+    ``ray_tpu.models.llama.CONFIGS``; explicit ``draft_config`` /
+    ``draft_params`` override it (tests and checkpoint loaders pass the
+    real objects — they are not JSON-serializable, so declarative
+    configs use ``draft_model``).
+    """
+
+    method: str = "ngram"
+    k: int = 4
+    ngram: int = 2
+    draft_model: Optional[str] = None
+    draft_config: Any = None
+    draft_params: Any = None
+    draft_seed: int = 1
+
+    @classmethod
+    def parse(cls, spec, default_k: int = 4) -> "SpeculationConfig":
+        if isinstance(spec, SpeculationConfig):
+            return spec
+        if isinstance(spec, str):
+            spec = {"method": spec}
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"speculation must be a method string or dict, got "
+                f"{type(spec).__name__}")
+        unknown = set(spec) - _DICT_KEYS
+        if unknown:
+            raise ValueError(
+                f"speculation has unknown fields {sorted(unknown)}; "
+                f"known: {sorted(_DICT_KEYS)}")
+        method = spec.get("method", "ngram")
+        if method not in _METHODS:
+            raise ValueError(
+                f"speculation method {method!r}: one of {_METHODS}")
+        k = int(spec.get("k", default_k))
+        if k <= 0:
+            raise ValueError("speculation k must be positive")
+        ngram = int(spec.get("ngram", 2))
+        if ngram <= 0:
+            raise ValueError("speculation ngram must be positive")
+        out = cls(method=method, k=k, ngram=ngram,
+                  draft_model=spec.get("draft_model"),
+                  draft_config=spec.get("draft_config"),
+                  draft_params=spec.get("draft_params"),
+                  draft_seed=int(spec.get("draft_seed", 1)))
+        if method == "draft" and out.draft_model is None \
+                and out.draft_config is None:
+            raise ValueError(
+                "speculation method 'draft' needs a draft_model name "
+                "(ray_tpu.models.llama.CONFIGS) or an explicit "
+                "draft_config/draft_params pair")
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-able canonical form (declarative config surface); drops
+        the non-serializable explicit config/params fields."""
+        out: Dict[str, Any] = {"method": self.method, "k": self.k}
+        if self.method == "ngram":
+            out["ngram"] = self.ngram
+        if self.draft_model is not None:
+            out["draft_model"] = self.draft_model
+            out["draft_seed"] = self.draft_seed
+        return out
+
+    def build_proposer(self, target_config, *, num_slots: int,
+                       max_seq: int):
+        if self.method == "ngram":
+            return NgramProposer(self.k, ngram=self.ngram)
+        from ray_tpu.models import llama
+
+        config = self.draft_config
+        if config is None:
+            if self.draft_model not in llama.CONFIGS:
+                raise ValueError(
+                    f"draft_model {self.draft_model!r}: not in "
+                    f"{sorted(llama.CONFIGS)}")
+            config = llama.CONFIGS[self.draft_model]
+        if config.vocab_size != target_config.vocab_size:
+            # reject before init_params: a real draft's parameter pytree
+            # is seconds and GBs to build (DraftProposer re-checks)
+            raise ValueError(
+                f"draft/target tokenizer mismatch: draft vocab_size "
+                f"{config.vocab_size} != target "
+                f"{target_config.vocab_size} — speculation requires the "
+                "models to share one tokenizer")
+        params = self.draft_params
+        if params is None:
+            import jax
+
+            params = llama.init_params(config,
+                                       jax.random.key(self.draft_seed))
+        return DraftProposer(target_config, config, params,
+                             num_slots=num_slots, max_seq=max_seq,
+                             k=self.k)
+
+
+def make_length_installer():
+    """Jitted fixed-shape cache-length installer,
+    ``install(length, new, touched) -> where(touched, new, length)`` —
+    ONE compiled program however many slots changed (used for both the
+    target's and the draft's post-verify rollback; a variable-size
+    ``.at[idx].set`` would recompile per distinct index-vector size)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda length, new, touched: jnp.where(touched, new, length))
+
+
+def propose_ngram(context: List[int], k: int, ngram: int = 2):
+    """Prompt-lookup proposal (vLLM "[ngram]" speculative method): find
+    the most recent earlier occurrence of the trailing ``ngram`` tokens
+    and propose the k tokens that followed it. None if no match."""
+    if len(context) < ngram + 1 or k <= 0:
+        return None
+    tail = context[-ngram:]
+    # scan right-to-left, excluding the trailing occurrence itself
+    for i in range(len(context) - ngram - 1, -1, -1):
+        if context[i:i + ngram] == tail:
+            nxt = context[i + ngram:i + ngram + k]
+            if nxt:
+                return list(nxt)
+            return None
+    return None
+
+
+class Proposer:
+    """Per-slot proposal source driven by the engine loop.
+
+    ``infos`` (propose) maps slot -> {"seq": prompt+output token list
+    (the last entry is the pending token not yet in any cache),
+    "target_len": tokens cached in the target's slot, "k": max proposals
+    wanted for this slot this round (0 = plain decode)}.
+    """
+
+    def admit(self, slot: int, tokens: List[int]) -> None:
+        """Slot was (re)admitted with ``tokens`` cached in the target."""
+
+    def release(self, slot: int) -> None:
+        """Slot finished or was evicted."""
+
+    def propose(self, infos: Dict[int, dict]) -> Dict[int, List[int]]:
+        raise NotImplementedError
+
+    def after_verify(self, accepted: Dict[int, int]) -> None:
+        """Per-slot accepted counts from the verify just run (slots that
+        finished inside the window are included; release() follows)."""
+
+    def stats(self) -> Dict[str, Any]:
+        return {}
+
+
+class NgramProposer(Proposer):
+    """Prompt-lookup proposals per slot; no model, no device state."""
+
+    def __init__(self, k: int, ngram: int = 2):
+        self.k = k
+        self.ngram = ngram
+
+    def propose(self, infos: Dict[int, dict]) -> Dict[int, List[int]]:
+        out = {}
+        for slot, info in infos.items():
+            prop = propose_ngram(info["seq"], info["k"], self.ngram)
+            out[slot] = prop or []
+        return out
+
+
+class DraftProposer(Proposer):
+    """Small-model proposals: the draft keeps its own slot cache in
+    lockstep with the target (prefill on admission, K batched greedy
+    decode steps per round, rollback to the accepted prefix after
+    verify, batched-verify catch-up when it falls a token behind)."""
+
+    def __init__(self, target_config, draft_config, draft_params, *,
+                 num_slots: int, max_seq: int, k: int = 4):
+        from ray_tpu.models.decoding import (
+            init_cache, make_batched_spec_verify, make_decode_step,
+            make_prefill)
+
+        if draft_config.vocab_size != target_config.vocab_size:
+            raise ValueError(
+                f"draft/target tokenizer mismatch: draft vocab_size "
+                f"{draft_config.vocab_size} != target "
+                f"{target_config.vocab_size} — speculation requires the "
+                "models to share one tokenizer")
+        self.config = draft_config
+        self.params = draft_params
+        self.num_slots = num_slots
+        self.max_seq = max_seq
+        self.k = k
+        self.cache = init_cache(draft_config, num_slots, max_seq)
+        self._prefill = make_prefill(draft_params, draft_config)
+        self._decode = make_decode_step(draft_params, draft_config)
+        self._ingest = make_batched_spec_verify(draft_params, draft_config)
+        self._len = np.zeros(num_slots, np.int64)   # host mirror
+        self._last_m: Dict[int, int] = {}           # proposals last round
+        self.draft_steps = 0
+        self._fix_len = make_length_installer()
+
+    def admit(self, slot: int, tokens: List[int]) -> None:
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import pad_to_bucket
+
+        P = min(pad_to_bucket(len(tokens)), self.max_seq)
+        buf = np.zeros((1, P), np.int32)
+        buf[0, :len(tokens)] = tokens
+        self.cache, _ = self._prefill(self.cache, jnp.asarray(buf),
+                                      len(tokens), slot)
+        self._len[slot] = len(tokens)
+        self._last_m.pop(slot, None)
+
+    def release(self, slot: int) -> None:
+        self._len[slot] = 0
+        self._last_m.pop(slot, None)
+
+    def _catch_up(self, infos: Dict[int, dict]) -> None:
+        """Ingest sequence tokens the draft cache is missing (typically
+        one, after an all-K acceptance) through the draft's batched
+        verify — windows of up to C tokens per call."""
+        import jax.numpy as jnp
+
+        # FIXED window width: per-slot k shrinks near max_tokens/max_seq
+        # and a varying width would compile one ingest program per size
+        C = self.k + 1
+        while True:
+            missing = {}
+            for slot, info in infos.items():
+                # k == 0 slots (per-request opt-out, window out of room)
+                # never propose, so keeping their draft cache current
+                # would burn one ingest forward per engine iteration for
+                # nothing; if k ever becomes positive again the gap is
+                # ingested then
+                if info["k"] <= 0:
+                    continue
+                have = int(self._len[slot])
+                if have < info["target_len"]:
+                    missing[slot] = info["seq"][have:info["target_len"]]
+            if not missing:
+                return
+            buf = np.zeros((self.num_slots, C), np.int32)
+            true_lens = np.zeros(self.num_slots, np.int32)
+            starts = np.asarray(self._len, np.int32).copy()
+            for slot, toks in missing.items():
+                n = min(len(toks), C)
+                buf[slot, :n] = toks[:n]
+                true_lens[slot] = n
+            self.cache, _ = self._ingest(
+                self.cache, jnp.asarray(buf), jnp.asarray(true_lens),
+                jnp.asarray(starts))
+            for slot in missing:
+                self._len[slot] += int(true_lens[slot])
+
+    def propose(self, infos: Dict[int, dict]) -> Dict[int, List[int]]:
+        import jax.numpy as jnp
+
+        self._last_m = {}
+        if not infos:
+            return {}
+        self._catch_up(infos)
+        props: Dict[int, List[int]] = {s: [] for s in infos}
+        kmax = max(info["k"] for info in infos.values())
+        feed = np.zeros(self.num_slots, np.int32)
+        for slot, info in infos.items():
+            feed[slot] = info["seq"][-1]
+        for step in range(kmax):
+            active = np.zeros(self.num_slots, bool)
+            for slot, info in infos.items():
+                active[slot] = info["k"] > step
+            if not active.any():
+                break
+            self.cache, logits = self._decode(
+                self.cache, jnp.asarray(feed), jnp.asarray(active))
+            self.draft_steps += 1
+            toks = np.asarray(logits).argmax(-1)
+            for slot, info in infos.items():
+                if info["k"] > step:
+                    t = int(toks[slot])
+                    props[slot].append(t)
+                    feed[slot] = t
+                    self._len[slot] += 1
+        self._last_m = {s: len(p) for s, p in props.items()}
+        return props
+
+    def after_verify(self, accepted: Dict[int, int]) -> None:
+        """Roll the draft cache back to the accepted prefix: rows
+        [target_len, target_len + min(a+1, m)) hold the fed window
+        tokens, all of which the accepted sequence kept; rejected rows
+        sit past the new length and later writes overwrite them. An
+        all-K acceptance leaves the draft one token short (the last
+        proposal was never fed) — the next round's catch-up feeds it."""
+        import jax.numpy as jnp
+
+        touched = np.zeros(self.num_slots, bool)
+        new_lens = np.zeros(self.num_slots, np.int32)
+        for slot, a in accepted.items():
+            m = self._last_m.get(slot, 0)
+            if m == 0:
+                continue
+            pre = int(self._len[slot]) - m
+            new = pre + min(a + 1, m)
+            self._len[slot] = new
+            touched[slot] = True
+            new_lens[slot] = new
+        if touched.any():
+            self.cache["length"] = self._fix_len(
+                self.cache["length"], jnp.asarray(new_lens),
+                jnp.asarray(touched))
+
+    def stats(self) -> Dict[str, Any]:
+        return {"spec_draft_steps": self.draft_steps}
+
+
+def _softmax(logits: np.ndarray, temperature: float) -> np.ndarray:
+    z = logits.astype(np.float64) / max(temperature, 1e-5)
+    z -= z.max()
+    p = np.exp(z)
+    return p / p.sum()
+
+
+def accept_greedy(greedy: np.ndarray, proposal: List[int]) -> tuple:
+    """Temperature-0 acceptance from precomputed argmax rows only.
+
+    ``greedy``: (1+m,) argmax token per window position. Equivalent to
+    ``accept_speculative(logits, proposal, 0.0, ...)`` but lets the
+    engine ship (B, C) int32 ids off-device instead of the full
+    (B, C, vocab) logits when no active slot samples."""
+    m = len(proposal)
+    a = 0
+    while a < m and int(greedy[a]) == proposal[a]:
+        a += 1
+    return [int(t) for t in proposal[:a]] + [int(greedy[a])], a
+
+
+def accept_speculative(logits: np.ndarray, proposal: List[int],
+                       temperature: float, rng) -> tuple:
+    """Apply the rejection-sampling acceptance rule to one slot's verify
+    window.
+
+    ``logits``: (1+m, vocab) target logits for window
+    [pending_token, p_1..p_m]; row i is the target's next-token
+    distribution AFTER window[0..i]. Returns ``(emitted, accepted)``
+    where ``emitted`` is ``proposal[:accepted] + [bonus]`` (1..m+1
+    tokens) and ``accepted`` counts proposal tokens kept.
+
+    temperature 0: accept while the argmax chain matches (exact greedy
+    equivalence). temperature > 0: proposals are deterministic (q is a
+    delta), so token x is accepted with probability p(x) and the bonus
+    resamples from the residual p with x zeroed, renormalized — the
+    emitted stream is distributed exactly as non-speculative sampling.
+    """
+    m = len(proposal)
+    if temperature <= 0.0:
+        return accept_greedy(logits.argmax(-1), proposal)
+    for i in range(m):
+        probs = _softmax(logits[i], temperature)
+        if rng.random() < probs[proposal[i]]:
+            continue
+        residual = probs.copy()
+        residual[proposal[i]] = 0.0
+        total = residual.sum()
+        if total <= 0.0:
+            # p was (numerically) a delta at the proposal yet it was
+            # rejected — only reachable through float rounding; the
+            # proposal token IS the sample then
+            return [int(t) for t in proposal[:i + 1]], i
+        bonus = int(rng.choice(residual.size, p=residual / total))
+        return [int(t) for t in proposal[:i]] + [bonus], i
+    probs = _softmax(logits[m], temperature)
+    bonus = int(rng.choice(probs.size, p=probs))
+    return [int(t) for t in proposal] + [bonus], m

@@ -1,0 +1,7 @@
+"""Pallas API compat for the jax versions this repo runs on."""
+
+from jax.experimental.pallas import tpu as pltpu
+
+# renamed TPUCompilerParams -> CompilerParams across jax releases
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams

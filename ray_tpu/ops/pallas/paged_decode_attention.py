@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -133,7 +135,7 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths, *,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32),

@@ -77,6 +77,24 @@ TP_RULES = ShardingRules(embed_fsdp=None)
 FSDP_TP_RULES = ShardingRules()
 
 
+def set_mesh(mesh):
+    """Context manager activating ``mesh`` for jitted computations.
+
+    Compat shim: jax >= 0.5 exposes ``jax.set_mesh`` (populates the
+    abstract mesh that ``with_logical_constraint`` reads); older
+    releases only have the legacy ``with mesh:`` context, which the
+    constraint path also honors — callers use this instead of either
+    spelling so the same test/model code runs on both.
+    """
+    import jax
+
+    setter = getattr(jax, "set_mesh", None) \
+        or getattr(jax.sharding, "set_mesh", None)
+    if setter is not None:
+        return setter(mesh)
+    return mesh  # legacy: Mesh is itself a context manager
+
+
 def logical_spec(logical_axes: Sequence[Optional[str]],
                  rules: ShardingRules):
     from jax.sharding import PartitionSpec
@@ -99,7 +117,10 @@ def with_logical_constraint(x, logical_axes, rules: ShardingRules):
     """
     import jax
 
-    mesh = jax.sharding.get_abstract_mesh()
+    # jax >= 0.5 exposes the abstract mesh; on older releases only the
+    # legacy `with mesh:` context exists — fall through to it.
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    mesh = get_abstract() if get_abstract is not None else None
     legacy_mesh = None
     if mesh is None or mesh.empty:
         # A legacy `with mesh:` context doesn't populate the abstract mesh;

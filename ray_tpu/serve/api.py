@@ -200,6 +200,47 @@ def get_declarative_config() -> Optional[Dict[str, Any]]:
     return json.loads(raw) if raw else None
 
 
+def llm_app(model: str = "tiny", *, name: str = "llm",
+            num_replicas: int = 1, num_slots: int = 8,
+            speculation=None, ray_actor_options: Optional[dict] = None,
+            **engine_kwargs) -> Application:
+    """Build a bound LLM-serving Application — the declarative-config
+    entry point for TPU LLM replicas (``import_path:
+    "ray_tpu.serve.api:llm_app"`` with ``args: {model: ..., speculation:
+    {method: draft, draft_model: ..., k: ...}}``). ``speculation`` is
+    validated eagerly (SpeculationConfig.parse — the same rules the
+    config schema applies, minus its JSON-only restriction), so a bad
+    spec fails at deploy time."""
+    from ray_tpu.models.speculation import SpeculationConfig
+    from ray_tpu.serve.llm import LLMServer
+
+    if speculation is not None:
+        # validate eagerly, but hand the ORIGINAL spec to the engine:
+        # programmatic draft_config/draft_params objects are legal here
+        # (schema.validate_speculation would reject them — its canonical
+        # JSON form is for declarative configs, which must name a
+        # draft_model instead). Same rules the engine applies at boot:
+        # thread the sibling spec_k default and check draft_model
+        # membership now, not minutes later on the replica.
+        cfg = SpeculationConfig.parse(
+            speculation, default_k=int(engine_kwargs.get("spec_k", 4)))
+        if cfg.draft_model is not None and cfg.draft_config is None:
+            from ray_tpu.models import llama
+
+            if cfg.draft_model not in llama.CONFIGS:
+                raise ValueError(
+                    f"speculation draft_model {cfg.draft_model!r}: not "
+                    f"in {sorted(llama.CONFIGS)}")
+        engine_kwargs["speculation"] = speculation
+        engine_kwargs.setdefault("kv_cache", "slot")
+    # real TPU replicas must pin device resources or they schedule onto
+    # non-TPU nodes (LLMServer docstring: ray_actor_options={"num_tpus": N})
+    dep = make_deployment(LLMServer, name=name,
+                          num_replicas=num_replicas,
+                          ray_actor_options=ray_actor_options)
+    return dep.bind(model=model, num_slots=num_slots, **engine_kwargs)
+
+
 def proxy_address() -> Optional[Dict[str, Any]]:
     return dict(_proxy_addr) if _proxy_addr else None
 

@@ -35,6 +35,36 @@ class _Request:
     # "k"/"v" (layers, len, kv_heads, hd) numpy + "logits" of the last
     # prompt token; admission injects instead of prefilling.
     preload: Optional[dict] = None
+    # per-request speculation override: None = engine default;
+    # {"enabled": bool, "k": Optional[int]} normalized by _parse_req_spec
+    spec: Optional[dict] = None
+
+
+def _parse_req_spec(speculation) -> Optional[dict]:
+    """Normalize a per-request speculation override (None / bool / dict
+    with "enabled" and/or "k").
+
+    Overrides only restrict what the engine already does: on an engine
+    built without speculation they are validated then no-ops (clients
+    need not know replica config to send requests), and a requested k
+    above the engine's spec_k clamps to spec_k (the compiled verify
+    window is sized at engine build)."""
+    if speculation is None:
+        return None
+    if isinstance(speculation, bool):
+        return {"enabled": speculation, "k": None}
+    if isinstance(speculation, dict):
+        unknown = set(speculation) - {"enabled", "k"}
+        if unknown:
+            raise ValueError(
+                f"per-request speculation has unknown fields "
+                f"{sorted(unknown)}; overridable: ['enabled', 'k']")
+        k = speculation.get("k")
+        if k is not None and int(k) <= 0:
+            raise ValueError("per-request speculation k must be positive")
+        return {"enabled": bool(speculation.get("enabled", True)),
+                "k": None if k is None else int(k)}
+    raise ValueError("per-request speculation must be a bool or dict")
 
 
 class LLMEngine:
@@ -56,7 +86,7 @@ class LLMEngine:
                  kv_pool_tokens: Optional[int] = None,
                  kv_block_size: int = 64,
                  prefill_chunk: Optional[int] = None,
-                 speculation: Optional[str] = None,
+                 speculation=None,
                  spec_k: int = 4):
         import collections
 
@@ -64,8 +94,8 @@ class LLMEngine:
 
         from ray_tpu.models import llama
         from ray_tpu.models.decoding import (
-            init_cache, make_chunked_prefill, make_decode_step,
-            make_inject, make_prefill, make_spec_verify)
+            init_cache, make_batched_spec_verify, make_chunked_prefill,
+            make_decode_step, make_inject, make_prefill)
 
         self.config = config or llama.CONFIGS[model]
         if params is None:
@@ -130,22 +160,39 @@ class LLMEngine:
         # slot -> {"req", "tokens", "pos"} for in-progress chunked prefills
         self._prefilling: Dict[int, dict] = {}
         self._chunks_run = 0
-        # Speculative decoding, prompt-lookup flavor (vLLM's "[ngram]"
-        # method — no draft model): greedy single-stream generations
-        # propose the k tokens that followed the most recent earlier
-        # occurrence of the trailing 2-gram and verify them in ONE
-        # forward; acceptance only skips compute, never changes outputs.
+        # Speculative decoding (ray_tpu.models.speculation): a pluggable
+        # proposer ("ngram" prompt lookup or a small "draft" model in
+        # lockstep) guesses up to k tokens per slot and ONE batched
+        # verify forward scores every slot's window — per-slot under
+        # continuous batching; slots without proposals degenerate to a
+        # plain decode row in the same program. Greedy acceptance only
+        # skips compute, never changes outputs; temperature > 0 keeps
+        # the target distribution via residual resampling.
+        self._proposer = None
+        self._spec_cfg = None
         if speculation is not None:
-            if speculation != "ngram":
-                raise ValueError(
-                    f"speculation={speculation!r}: only 'ngram' "
-                    "(prompt lookup) is supported")
+            from ray_tpu.models.speculation import (SpeculationConfig,
+                                                    make_length_installer)
+
+            cfg = SpeculationConfig.parse(speculation, default_k=spec_k)
             if kv_cache != "slot":
                 raise ValueError(
                     "speculation currently requires kv_cache='slot'")
-            if spec_k <= 0:
-                raise ValueError("spec_k must be positive")
-            self._spec_verify = make_spec_verify(params, self.config)
+            import jax
+            import jax.numpy as jnp
+
+            self._spec_cfg = cfg
+            self._spec_verify = make_batched_spec_verify(params,
+                                                         self.config)
+            self._spec_fix_len = make_length_installer()
+            # device-side argmax so greedy verify rounds transfer (B, C)
+            # ids instead of (B, C, vocab) logits
+            self._spec_argmax = jax.jit(
+                lambda logits: jnp.argmax(logits, axis=-1))
+            self._proposer = cfg.build_proposer(
+                self.config, num_slots=num_slots, max_seq=self.max_seq)
+            spec_k = cfg.k
+            speculation = cfg.method
         self.speculation = speculation
         self.spec_k = spec_k
         self._spec_proposed = 0
@@ -183,17 +230,32 @@ class LLMEngine:
         self._preemptions = 0
 
     # ------------------------------------------------------------- public
+    def _check_vocab(self, prompt: List[int]) -> None:
+        """Reject out-of-vocab prompt token ids at submission. On device
+        the embed gather would clamp silently, but host-side speculation
+        indexes probability rows by proposed token — and an ngram
+        proposer re-proposes PROMPT tokens, so one malformed request
+        could crash an engine step shared by every in-flight slot."""
+        V = self.config.vocab_size
+        for t in prompt:
+            if not 0 <= int(t) < V:
+                raise ValueError(
+                    f"prompt token {t} out of vocab range [0, {V})")
+
     def generate(self, prompt: List[int], max_tokens: int = 64,
                  temperature: float = 0.0,
                  eos_token: Optional[int] = None,
-                 timeout_s: float = 300.0) -> List[int]:
+                 timeout_s: float = 300.0,
+                 speculation=None) -> List[int]:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
                 f"exceeds max_seq {self.max_seq}")
-        req = _Request(list(prompt), max_tokens, temperature, eos_token)
+        self._check_vocab(prompt)
+        req = _Request(list(prompt), max_tokens, temperature, eos_token,
+                       spec=_parse_req_spec(speculation))
         self._queue.put(req)
         if not req.done.wait(timeout_s):
             raise TimeoutError("generation timed out")
@@ -203,7 +265,8 @@ class LLMEngine:
 
     def submit(self, prompt: List[int], max_tokens: int = 64,
                temperature: float = 0.0,
-               eos_token: Optional[int] = None) -> str:
+               eos_token: Optional[int] = None,
+               speculation=None) -> str:
         """Enqueue without blocking; poll with :meth:`poll` (drives the
         proxy's SSE token streaming)."""
         import uuid
@@ -212,7 +275,9 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if len(prompt) + max_tokens > self.max_seq:
             raise ValueError("prompt + max_tokens exceeds max_seq")
-        req = _Request(list(prompt), max_tokens, temperature, eos_token)
+        self._check_vocab(prompt)
+        req = _Request(list(prompt), max_tokens, temperature, eos_token,
+                       spec=_parse_req_spec(speculation))
         rid = uuid.uuid4().hex
         with self._pending_lock:
             self._pending[rid] = {"req": req, "sent": 0}
@@ -279,7 +344,13 @@ class LLMEngine:
                "prefilling_slots": len(self._prefilling),
                "spec_proposed": self._spec_proposed,
                "spec_accepted": self._spec_accepted,
+               "spec_acceptance_rate": (
+                   round(self._spec_accepted / self._spec_proposed, 4)
+                   if self._spec_proposed else None),
+               "speculation": self.speculation,
                "kv_cache": self.kv_cache}
+        if self._proposer is not None:
+            out.update(self._proposer.stats())
         if self.kv_cache == "paged":
             out.update(
                 preemptions=self._preemptions,
@@ -441,56 +512,111 @@ class LLMEngine:
             self._slot_len[slot] = plen
             self._admit_counter += 1
             self._admit_seq[slot] = self._admit_counter
+            if self._proposer is not None:
+                self._proposer.admit(slot, full_prompt)
             self._maybe_finish(slot)
 
-    def _try_speculate(self, slot: int, req) -> bool:
-        """One prompt-lookup speculative step for a lone greedy stream.
-        Returns False (caller falls back to normal decode) when no
-        proposal exists or the window would overrun max_seq."""
+    def _spec_decode_step(self, active: np.ndarray) -> bool:
+        """One speculative iteration for ALL active slots: collect
+        per-slot proposals, score every window in one batched verify,
+        apply the acceptance rule per slot, and install the accepted
+        lengths (target + proposer rollback). Slots with no proposal —
+        lookup miss, per-request opt-out, window out of room — ride the
+        same program as 1-token windows, i.e. a plain decode step.
+
+        Returns False WITHOUT touching the cache when no slot has any
+        proposal at all: every window would be 1 token, and the plain
+        decode program is ~(k+1)x cheaper than the verify for the same
+        result — the caller falls through to it. (Safe for the draft
+        proposer too: empty proposals mean it ran zero decode steps, so
+        there is nothing to roll back.)"""
         import jax.numpy as jnp
 
-        from ray_tpu.models.decoding import propose_ngram
+        from ray_tpu.models.speculation import (accept_greedy,
+                                                accept_speculative)
 
         C = self.spec_k + 1
-        start = int(self._slot_len[slot])
-        if start + C > self.max_seq:
+        infos: Dict[int, dict] = {}
+        for slot in range(self.num_slots):
+            if not active[slot]:
+                continue
+            req = self._slots[slot]
+            start = int(self._slot_len[slot])
+            k_req = self.spec_k
+            if req.spec is not None:
+                if not req.spec["enabled"]:
+                    k_req = 0
+                elif req.spec["k"] is not None:
+                    k_req = min(req.spec["k"], self.spec_k)
+            room = req.max_tokens - len(req.output)
+            k_eff = max(0, min(k_req, room - 1,
+                               self.max_seq - start - 1))
+            infos[slot] = {"seq": req.prompt + req.output,
+                           "target_len": start, "k": k_eff}
+        proposals = self._proposer.propose(infos) if infos else {}
+        if not any(proposals.get(slot) for slot in infos):
             return False
-        prop = propose_ngram(req.prompt + req.output, self.spec_k)
-        if not prop:
-            return False
-        buf = np.zeros((1, C), np.int32)
-        buf[0, 0] = self._last_token[slot]
-        buf[0, 1:1 + len(prop)] = prop
-        true_len = 1 + len(prop)
+        buf = np.zeros((self.num_slots, C), np.int32)
+        true_lens = np.zeros(self.num_slots, np.int32)
+        starts = np.zeros(self.num_slots, np.int32)
+        for slot, info in infos.items():
+            props = proposals.get(slot) or []
+            buf[slot, 0] = self._last_token[slot]
+            buf[slot, 1:1 + len(props)] = props
+            true_lens[slot] = 1 + len(props)
+            starts[slot] = info["target_len"]
         self._cache, all_logits = self._spec_verify(
-            self._cache, jnp.asarray(buf), true_len, start, slot)
-        greedy = np.asarray(all_logits)[:true_len].argmax(-1)
-        accepted = 0
-        while accepted < len(prop) and int(greedy[accepted]) == prop[accepted]:
-            accepted += 1
-        emitted = [int(t) for t in prop[:accepted]] + [int(greedy[accepted])]
-        self._spec_proposed += len(prop)
-        self._spec_accepted += accepted
-        # respect max_tokens and eos inside the speculative window
-        room = req.max_tokens - len(req.output)
-        emitted = emitted[:max(0, room)]
-        if req.eos_token is not None and req.eos_token in emitted:
-            emitted = emitted[:emitted.index(req.eos_token) + 1]
-        if not emitted:
-            # shouldn't happen (finished requests leave the slot), but
-            # never let the device length run ahead of the host state
-            self._cache["length"] = self._cache["length"].at[slot].set(start)
-            return True
-        req.output.extend(emitted)
-        self._last_token[slot] = emitted[-1]
-        new_len = start + len(emitted)
-        # rows beyond the accepted window hold rejected-token K/V; they
-        # sit past the length and are overwritten by later writes
-        self._cache["length"] = self._cache["length"].at[slot].set(new_len)
-        self._slot_len[slot] = new_len
+            self._cache, jnp.asarray(buf), true_lens, starts)
+        # greedy slots need only the (B, C) argmax ids — ship the full
+        # (B, C, vocab) logits off-device only when some slot samples
+        # (a real vocab makes the difference ~(k+1)x the decode path's
+        # per-step transfer)
+        greedy_np = np.asarray(self._spec_argmax(all_logits))
+        need_full = any(self._slots[s].temperature > 0.0 for s in infos)
+        logits_np = np.asarray(all_logits) if need_full else None
+        # post-increment BEFORE seeding, like _sample: seeding first
+        # would reuse the stream the previous plain step sampled with,
+        # correlating accept/reject draws with the token just emitted
         self._steps += 1
-        self._tokens_generated += len(emitted)
-        self._maybe_finish(slot)
+        rng = np.random.default_rng(self._steps)
+        accepted_map: Dict[int, int] = {}
+        touched = np.zeros(self.num_slots, bool)
+        new_lens = np.zeros(self.num_slots, np.int32)
+        for slot in sorted(infos):
+            req = self._slots[slot]
+            props = proposals.get(slot) or []
+            if req.temperature <= 0.0:
+                emitted, accepted = accept_greedy(
+                    greedy_np[slot, :1 + len(props)], props)
+            else:
+                emitted, accepted = accept_speculative(
+                    logits_np[slot, :1 + len(props)], props,
+                    req.temperature, rng)
+            self._spec_proposed += len(props)
+            self._spec_accepted += accepted
+            accepted_map[slot] = accepted
+            # respect max_tokens and eos inside the speculative window
+            room = req.max_tokens - len(req.output)
+            emitted = emitted[:max(1, room)]
+            if req.eos_token is not None and req.eos_token in emitted:
+                emitted = emitted[:emitted.index(req.eos_token) + 1]
+            req.output.extend(emitted)
+            self._last_token[slot] = emitted[-1]
+            # the last emitted token is pending (not yet cached), so the
+            # accepted cache length is start + len(emitted); rejected
+            # rows beyond it are invisible and get overwritten later
+            new_len = int(starts[slot]) + len(emitted)
+            self._slot_len[slot] = new_len
+            touched[slot] = True
+            new_lens[slot] = new_len
+            self._tokens_generated += len(emitted)
+        if touched.any():
+            self._cache["length"] = self._spec_fix_len(
+                self._cache["length"], jnp.asarray(new_lens),
+                jnp.asarray(touched))
+        self._proposer.after_verify(accepted_map)
+        for slot in sorted(accepted_map):
+            self._maybe_finish(slot)
         return True
 
     def _advance_chunked_prefill(self):
@@ -531,6 +657,8 @@ class LLMEngine:
         req.output.append(int(tok))
         self._last_token[slot] = tok
         self._slot_len[slot] = plen
+        if self._proposer is not None:
+            self._proposer.admit(slot, toks)
         self._maybe_finish(slot)
 
     def _sample(self, logits: np.ndarray, temperature: float) -> np.ndarray:
@@ -555,6 +683,8 @@ class LLMEngine:
         if done:
             req.done.set()
             self._slots[slot] = None
+            if self._proposer is not None:
+                self._proposer.release(slot)
             if self.kv_cache == "paged":
                 self._alloc.release(slot)
 
@@ -565,6 +695,8 @@ class LLMEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._alloc.release(slot)
+        if self._proposer is not None:
+            self._proposer.release(slot)
         # a mid-chunked-prefill victim restarts its prefill on re-admission
         self._prefilling.pop(slot, None)
         self._waiting.appendleft(req)
@@ -649,11 +781,14 @@ class LLMEngine:
             if not self._prefilling:
                 time.sleep(0.002)
             return
-        if (self.speculation == "ngram" and int(active.sum()) == 1
-                and not self._prefilling):
-            slot = int(np.argmax(active))
-            req = self._slots[slot]
-            if req.temperature <= 0.0 and self._try_speculate(slot, req):
+        if self._proposer is not None:
+            # speculation replaces the decode step wholesale: every
+            # active slot gets a verify window (1-token windows for
+            # slots without proposals), per-slot under continuous
+            # batching — mid-chunked-prefill slots stay masked out.
+            # When NO slot has a proposal this iteration, fall through
+            # to the plain (cheaper) decode program below instead.
+            if self._spec_decode_step(active):
                 return
         if self.kv_cache == "paged":
             self._cache, logits = self._decode(
@@ -702,7 +837,8 @@ class LLMServer:
             body = prompt_or_request.json() or {}
             merged = {"max_tokens": body.get("max_tokens", 64),
                       "temperature": body.get("temperature", 0.0),
-                      "eos_token": body.get("eos_token")}
+                      "eos_token": body.get("eos_token"),
+                      "speculation": body.get("speculation")}
             return body.get("prompt", []), merged
         return prompt_or_request, kwargs
 
@@ -710,13 +846,13 @@ class LLMServer:
         prompt, kw = self._parse(prompt_or_request, kwargs)
         return self.engine.generate(
             prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
-            kw.get("eos_token"))
+            kw.get("eos_token"), speculation=kw.get("speculation"))
 
     def submit(self, prompt_or_request, **kwargs) -> str:
         prompt, kw = self._parse(prompt_or_request, kwargs)
         return self.engine.submit(
             prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
-            kw.get("eos_token"))
+            kw.get("eos_token"), speculation=kw.get("speculation"))
 
     def poll(self, request_id: str) -> Dict[str, Any]:
         return self.engine.poll(request_id)

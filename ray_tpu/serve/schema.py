@@ -42,6 +42,40 @@ class ServeConfigError(ValueError):
     pass
 
 
+def validate_speculation(spec, default_k: int = 4) -> Dict[str, Any]:
+    """Canonicalize a speculative-decoding spec (method string or dict —
+    see ``ray_tpu.models.speculation.SpeculationConfig``) into its
+    JSON-able form. Declarative LLM apps carry this under
+    ``args.speculation`` (vLLM parity: the reference forwards
+    ``speculative_config`` to the vLLM engine).
+
+    The canonical form is what the replica boots from, and it cannot
+    carry live ``draft_config``/``draft_params`` objects — a draft spec
+    whose only source is an object is rejected HERE, at deploy time,
+    instead of passing validation and failing replica boot minutes
+    later (programmatic callers with real objects go through
+    ``serve.api.llm_app``, which forwards the originals)."""
+    from ray_tpu.models.speculation import SpeculationConfig
+
+    try:
+        cfg = SpeculationConfig.parse(spec, default_k=default_k)
+    except ValueError as e:
+        raise ServeConfigError(f"speculation: {e}") from e
+    if cfg.method == "draft":
+        if cfg.draft_model is None:
+            raise ServeConfigError(
+                "speculation: draft_config/draft_params objects are not "
+                "JSON-serializable — declarative configs must name a "
+                "draft_model (ray_tpu.models.llama.CONFIGS)")
+        from ray_tpu.models import llama
+
+        if cfg.draft_model not in llama.CONFIGS:
+            raise ServeConfigError(
+                f"speculation: draft_model {cfg.draft_model!r} is not in "
+                f"{sorted(llama.CONFIGS)}")
+    return cfg.to_dict()
+
+
 def validate_config(config: Dict[str, Any]) -> Dict[str, Any]:
     """Validate + normalize a deploy config dict.  Returns the canonical
     form; raises ServeConfigError with a field path on bad input."""
@@ -73,6 +107,25 @@ def validate_config(config: Dict[str, Any]) -> Dict[str, Any]:
         args = app.get("args") or {}
         if not isinstance(args, dict):
             raise ServeConfigError(f"{where}.args must be a mapping")
+        if args.get("speculation") is not None:
+            # canonicalize eagerly so a bad spec fails the deploy call,
+            # not the replica boot minutes later; thread the sibling
+            # spec_k kwarg through so a spec with no explicit k inherits
+            # it instead of pinning the canonical form to the default
+            try:
+                default_k = int(args.get("spec_k", 4))
+            except (TypeError, ValueError):
+                raise ServeConfigError(
+                    f"{where}.args.spec_k must be an integer, got "
+                    f"{args['spec_k']!r}") from None
+            try:
+                args = dict(args,
+                            speculation=validate_speculation(
+                                args["speculation"],
+                                default_k=default_k))
+            except ServeConfigError as e:
+                # e already reads "speculation: ..." — just add the path
+                raise ServeConfigError(f"{where}.args.{e}") from e
         deployments = app.get("deployments") or []
         if not isinstance(deployments, list):
             raise ServeConfigError(f"{where}.deployments must be a list")

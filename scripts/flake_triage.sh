@@ -31,8 +31,16 @@ shift $((OPTIND - 1))
 
 cd "$(dirname "$0")/.."
 
-PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m "not slow"
-        -p no:cacheprovider -p no:xdist -p no:randomly)
+PER_FILE_TIMEOUT="${PER_FILE_TIMEOUT:-600}"
+if [ -n "${FT_PYTEST:-}" ]; then
+    # caller aligns the rerun invocation with its own (run_tests.sh sets
+    # this so verdicts are adjudicated under the SAME marker filter and
+    # jax platform the failure was observed under)
+    read -r -a PYTEST <<< "$FT_PYTEST"
+else
+    PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m "not slow"
+            -p no:cacheprovider -p no:xdist -p no:randomly)
+fi
 
 FILES=("$@")
 if [ ${#FILES[@]} -eq 0 ]; then
@@ -58,7 +66,10 @@ status=0
 for f in "${FILES[@]}"; do
     fails=0
     for i in $(seq "$RUNS"); do
-        if ! "${PYTEST[@]}" "$f" >/dev/null 2>&1; then
+        # bounded rerun: a file that failed by HANGING must not hang the
+        # triage pass too
+        if ! timeout -k 10 "$PER_FILE_TIMEOUT" "${PYTEST[@]}" "$f" \
+                >/dev/null 2>&1; then
             fails=$((fails + 1))
         fi
     done

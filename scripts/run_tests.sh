@@ -5,16 +5,25 @@
 # files, and one hung file cannot take the whole suite down (it is
 # killed at PER_FILE_TIMEOUT and reported).
 #
+# Every failing file is automatically adjudicated by
+# scripts/flake_triage.sh (GREEN = cross-test interference, FLAKY =
+# timing, DETERMINISTIC-FAIL = real bug) and the verdict appended to
+# the run log.
+#
 # Usage:
 #   bash scripts/run_tests.sh            # everything under tests/
 #   bash scripts/run_tests.sh test_rl    # only files matching a substring
 #   PER_FILE_TIMEOUT=900 bash scripts/run_tests.sh
+#   TRIAGE_RUNS=0 bash scripts/run_tests.sh   # skip the triage pass
 set -u
 cd "$(dirname "$0")/.."
 
 PER_FILE_TIMEOUT="${PER_FILE_TIMEOUT:-600}"
+TRIAGE_RUNS="${TRIAGE_RUNS:-3}"
+RUN_LOG="${RUN_LOG:-/tmp/rt_test_run.log}"
 FILTER="${1:-}"
 
+: > "$RUN_LOG"
 pass=0; fail=0; failed_files=()
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
@@ -25,12 +34,25 @@ for f in tests/test_*.py; do
   else
     status=FAIL; fail=$((fail+1)); failed_files+=("$f")
   fi
-  printf '%-40s %-5s %3ds\n' "$f" "$status" "$(( $(date +%s) - start ))"
+  printf '%-40s %-5s %3ds\n' "$f" "$status" "$(( $(date +%s) - start ))" \
+    | tee -a "$RUN_LOG"
 done
 
-echo "----------------------------------------"
-echo "files passed: $pass   files failed: $fail"
+echo "----------------------------------------" | tee -a "$RUN_LOG"
+echo "files passed: $pass   files failed: $fail" | tee -a "$RUN_LOG"
 for f in "${failed_files[@]:-}"; do
-  [[ -n "$f" ]] && echo "  FAILED: $f  (log: /tmp/rt_test_$(basename "$f").log)"
+  [[ -n "$f" ]] && echo "  FAILED: $f  (log: /tmp/rt_test_$(basename "$f").log)" \
+    | tee -a "$RUN_LOG"
 done
+
+if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
+  echo "triaging ${#failed_files[@]} failing file(s) (${TRIAGE_RUNS} isolated reruns each)..." \
+    | tee -a "$RUN_LOG"
+  # rerun under the SAME invocation the failure was observed with (no
+  # marker filter, inherited jax platform), and the same per-file bound
+  FT_PYTEST="python -m pytest -q" PER_FILE_TIMEOUT="$PER_FILE_TIMEOUT" \
+    bash scripts/flake_triage.sh -n "$TRIAGE_RUNS" "${failed_files[@]}" \
+    | tee -a "$RUN_LOG"
+fi
+echo "run log: $RUN_LOG"
 [[ $fail -eq 0 ]]

@@ -257,7 +257,10 @@ class TestSpeculativeDecoding:
 
         from ray_tpu.serve.llm import LLMEngine
 
-        with _pytest.raises(ValueError, match="ngram"):
+        # draft is a real method now, but needs a draft model source
+        with _pytest.raises(ValueError, match="draft_model"):
             LLMEngine(model="debug", kv_cache="slot", speculation="draft")
+        with _pytest.raises(ValueError, match="one of"):
+            LLMEngine(model="debug", kv_cache="slot", speculation="medusa")
         with _pytest.raises(ValueError, match="slot"):
             LLMEngine(model="debug", kv_cache="paged", speculation="ngram")

@@ -13,7 +13,7 @@ from ray_tpu.models.training import (
     make_train_step,
 )
 from ray_tpu.parallel.mesh import MeshConfig, make_mesh
-from ray_tpu.parallel.sharding import FSDP_TP_RULES, ShardingRules
+from ray_tpu.parallel.sharding import FSDP_TP_RULES, ShardingRules, set_mesh
 
 CFG = llama.CONFIGS["debug"]
 
@@ -62,7 +62,7 @@ def test_loss_decreases_under_training():
     rules = FSDP_TP_RULES
     opt = OptimizerConfig(learning_rate=1e-2, warmup_steps=1,
                           decay_steps=100).make()
-    with jax.sharding.set_mesh(mesh):
+    with set_mesh(mesh):
         state, shardings = init_train_state(
             lambda key: llama.init_params(CFG, key),
             llama.param_logical_axes(CFG), opt, mesh, rules,
@@ -84,7 +84,7 @@ def test_loss_decreases_under_training():
 def test_param_shardings_actually_shard():
     mesh = make_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
     opt = OptimizerConfig().make()
-    with jax.sharding.set_mesh(mesh):
+    with set_mesh(mesh):
         state, shardings = init_train_state(
             lambda key: llama.init_params(CFG, key),
             llama.param_logical_axes(CFG), opt, mesh, FSDP_TP_RULES,
@@ -108,7 +108,7 @@ def test_sharded_matches_single_device_loss():
     loss_ref, _ = llama.loss_fn(params, batch, CFG)
 
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    with jax.sharding.set_mesh(mesh):
+    with set_mesh(mesh):
         from ray_tpu.parallel.sharding import shard_pytree
 
         sharded = shard_pytree(params, llama.param_logical_axes(CFG), mesh,

@@ -13,7 +13,7 @@ from ray_tpu.models import llama, moe
 from ray_tpu.models.training import (OptimizerConfig, init_train_state,
                                      make_train_step)
 from ray_tpu.parallel.mesh import MeshConfig, make_mesh
-from ray_tpu.parallel.sharding import ShardingRules
+from ray_tpu.parallel.sharding import ShardingRules, set_mesh
 
 
 @pytest.fixture(scope="module")
@@ -109,7 +109,7 @@ def test_ep_sharded_train_step_matches_single_device(cfg):
     opt = OptimizerConfig(warmup_steps=1, decay_steps=10).make()
     batch = _batch(cfg, batch=8, seq=32)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         state, _ = init_train_state(
             lambda k: moe.init_params(cfg, k), moe.param_logical_axes(cfg),
             opt, mesh, rules, jax.random.key(5))

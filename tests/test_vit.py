@@ -69,12 +69,12 @@ class TestSharded:
         from ray_tpu.models.training import (
             OptimizerConfig, init_train_state, make_train_step)
         from ray_tpu.parallel.mesh import MeshConfig, make_mesh
-        from ray_tpu.parallel.sharding import ShardingRules
+        from ray_tpu.parallel.sharding import ShardingRules, set_mesh
 
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
         rules = ShardingRules()
         opt = OptimizerConfig(warmup_steps=1, decay_steps=100).make()
-        with jax.sharding.set_mesh(mesh):
+        with set_mesh(mesh):
             state, _ = init_train_state(
                 lambda key: vit.init_params(cfg, key),
                 vit.param_logical_axes(cfg), opt, mesh, rules,

@@ -23,6 +23,30 @@ def poke_accumulator(handle, k):
     return ray_tpu.get(handle.add.remote(k))
 
 
+def bump_record(rec):
+    """User-struct xlang target: a C++ TaskRecord arrives as the tuple
+    (id, score, tag, parts) — mutate every field and return the same
+    shape (the C++ side revives it via RAY_TPU_SERIALIZE)."""
+    rid, score, tag, parts = rec
+    return (rid + 1, score * 2, tag + "!", list(parts) + [9])
+
+
+class RecordStore:
+    """Actor half of the user-struct round-trip: stores C++ TaskRecords
+    (tuples) and returns the latest with sum(parts) appended."""
+
+    def __init__(self):
+        self.records = []
+
+    def put(self, rec):
+        self.records.append(rec)
+        return len(self.records)
+
+    def latest(self):
+        rid, score, tag, parts = self.records[-1]
+        return (rid, score, tag, list(parts) + [sum(parts)])
+
+
 def which_node():
     """Node id of the worker executing this task (PG verification)."""
     import ray_tpu
