@@ -129,8 +129,21 @@ _D("rpc_require_hello", bool, True,
    "upgrade from pre-handshake nodes, where the silent peer is assumed "
    "legacy and the connection degrades to protocol 1")
 _D("fastloop_enabled", bool, True,
-   "C dispatch loop for eligible actor calls (rpc/native/fastloop.c); "
-   "falls back to the asyncio path when the extension can't build")
+   "C dispatch loop for eligible actor calls and normal tasks "
+   "(rpc/native/fastloop.c); falls back to the asyncio path when the "
+   "extension can't build")
+_D("fast_dispatch_direct", bool, False,
+   "caller-thread pushes through cached lease channels (skips the IO"
+   " loop per task). Off by default: measured SLOWER under contended"
+   " fan-out on this box (the submitting thread and the reply reader"
+   " fight for the submitter process's GIL, and breadth-first spread"
+   " degrades) — see PERF_PLAN.md round 8; on = lowest per-call latency"
+   " for a single isolated submitter")
+_D("fast_dispatch_window", int, 4,
+   "in-flight pushes per lease on the native task-dispatch channel: >1"
+   " overlaps wire/reply latency with execution (small eligible tasks may"
+   " then briefly overlap on one leased worker); 1 = strict one-task-per-"
+   "lease pacing")
 
 # --- scheduling --------------------------------------------------------------
 _D("scheduler_top_k_fraction", float, 0.2, "hybrid policy: top-k fraction of nodes")
@@ -147,9 +160,16 @@ _D("lease_idle_grace_ms", int, 100,
 _D("log_to_driver", bool, True,
    "stream worker stdout/stderr to subscribed drivers via GCS pubsub")
 _D("worker_log_flush_interval_s", float, 0.2, "worker log relay batch period")
-_D("num_prestart_workers", int, 2, "workers forked at raylet boot")
+_D("num_prestart_workers", int, 2,
+   "warm default-env worker watermark: forked at raylet boot and"
+   " replenished concurrently in the background (through the warm"
+   " forkserver, once attached) as creations consume the pool")
 _D("worker_factory_enabled", bool, True,
    "forkserver worker factory: fork warm interpreters instead of exec")
+_D("worker_factory_procs", int, 2,
+   "parallel forkserver processes: fork(2) serializes per address space"
+   " (~12 ms/fork of a warm interpreter), so K factories raise the"
+   " sustained worker-supply — and therefore actor-creation — ceiling")
 _D("worker_register_timeout_s", int, 60, "")
 _D("idle_worker_killing_time_threshold_ms", int, 1000, "idle reap threshold")
 _D("maximum_startup_concurrency", int, 4, "concurrent worker forks")
@@ -191,6 +211,10 @@ _D("autoscaler_launch_timeout_s", float, 120.0,
    "drop a launched node that never registers with the GCS within this time")
 
 # --- observability -----------------------------------------------------------
+_D("task_events_enabled", bool, True,
+   "buffer per-task lifecycle events and flush them to the GCS task store"
+   " (reference RAY_task_events_report_interval_ms; 0/off skips the"
+   " per-task buffering entirely — read once at worker boot)")
 _D("enable_export_api", bool, False,
    "write versioned JSONL export events (actor/node/job/PG transitions)"
    " under <session>/export_events/ for external tooling")

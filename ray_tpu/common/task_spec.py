@@ -155,9 +155,12 @@ class TaskSpec:
 
     @classmethod
     def from_fast(cls, blob: bytes) -> "TaskSpec":
-        """Rebuild an ACTOR_TASK from a native fastspec buffer (see
-        rpc/native/fastspec.c). Only fields the executee reads are
-        populated; the rest hold cheap defaults."""
+        """Rebuild a task from a native fastspec buffer (see
+        rpc/native/fastspec.c): v1 = ACTOR_TASK, v2 = NORMAL_TASK (the
+        lease-cached dispatch channel's record). Only fields the executee
+        reads are populated; the rest hold cheap defaults."""
+        if len(blob) > 4 and blob[4] == 2:
+            return cls._from_fast_task(blob)
         from ray_tpu.rpc.native import unpack_fastspec
 
         (task_raw, job_raw, actor_raw, wid_raw, host, method, payload,
@@ -178,6 +181,34 @@ class TaskSpec:
             caller_worker_id=WorkerID(wid_raw),
             caller_address=(host.decode(), port),
             name=method_s,
+        )
+
+    @classmethod
+    def _from_fast_task(cls, blob: bytes) -> "TaskSpec":
+        """v2 record: a normal task pushed over the native dispatch
+        channel. The args payload is ONE pickle of the per-arg inline
+        frames (eligibility guarantees every arg was inline)."""
+        import pickle as _pickle
+
+        from ray_tpu.rpc.native import unpack_fasttask
+
+        (task_raw, job_raw, wid_raw, host, qualname, func, payload,
+         name, num_returns, port) = unpack_fasttask(blob)
+        qual_s = qualname.decode()
+        return cls(
+            task_id=TaskID(task_raw),
+            job_id=JobID(job_raw),
+            task_type=TaskType.NORMAL_TASK,
+            function=FunctionDescriptor("", qual_s),
+            serialized_func=func,
+            args=[TaskArg.inline(v) for v in _pickle.loads(payload)],
+            num_returns=num_returns,
+            required_resources=ResourceRequest({}),
+            caller_worker_id=WorkerID(wid_raw),
+            caller_address=(host.decode(), port),
+            # display name travels in the record: task events / errors
+            # must report the submit-side name, not the qualname
+            name=name.decode() or qual_s,
         )
 
     def shape_key(self) -> tuple:

@@ -61,6 +61,13 @@ class ActorClass:
     def __init__(self, cls, default_options: Optional[Dict[str, Any]] = None):
         self._cls = cls
         self._default_options = default_options or {}
+        # per-class work hoisted off the per-creation critical path:
+        # cloudpickling the class and scanning it for methods cost ~ms
+        # each — at actor-churn rates that is a large share of the
+        # driver-side creation budget
+        self._serialized_cls: Optional[bytes] = None
+        self._methods: Optional[list] = None
+        self._default_concurrency: Optional[int] = None
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         return self._create(args, kwargs, self._default_options)
@@ -90,29 +97,38 @@ class ActorClass:
         if cw is None:
             raise RuntimeError("ray_tpu.init() must be called first")
         sched = _strategy_from_options(opts)
-        # Async actors (any ``async def`` method) default to high concurrency:
-        # calls interleave on the actor's event loop rather than queueing
-        # (reference python/ray/actor.py DEFAULT_MAX_CONCURRENCY_ASYNC=1000).
-        import inspect
+        if self._default_concurrency is None:
+            # Async actors (any ``async def`` method) default to high
+            # concurrency: calls interleave on the actor's event loop
+            # rather than queueing (reference python/ray/actor.py
+            # DEFAULT_MAX_CONCURRENCY_ASYNC=1000).
+            import inspect
 
-        default_concurrency = 1
-        if any(inspect.iscoroutinefunction(getattr(self._cls, m, None))
-               for m in dir(self._cls) if not m.startswith("__")):
-            default_concurrency = 1000
+            self._default_concurrency = 1000 if any(
+                inspect.iscoroutinefunction(getattr(self._cls, m, None))
+                for m in dir(self._cls) if not m.startswith("__")) else 1
+        if self._serialized_cls is None:
+            import cloudpickle
+
+            self._serialized_cls = cloudpickle.dumps(self._cls)
         actor_id = cw.create_actor(
             self._cls, args, kwargs,
             resources=_resources_from_options(opts, for_actor=True),
             label_selector=opts.get("label_selector"),
             scheduling_strategy=sched,
             max_restarts=opts.get("max_restarts", 0),
-            max_concurrency=opts.get("max_concurrency", default_concurrency),
+            max_concurrency=opts.get("max_concurrency",
+                                     self._default_concurrency),
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             runtime_env=opts.get("runtime_env"),
+            serialized_cls=self._serialized_cls,
         )
-        methods = [m for m in dir(self._cls)
-                   if not m.startswith("_") and callable(getattr(self._cls, m))]
-        return ActorHandle(actor_id, methods)
+        if self._methods is None:
+            self._methods = [
+                m for m in dir(self._cls)
+                if not m.startswith("_") and callable(getattr(self._cls, m))]
+        return ActorHandle(actor_id, self._methods)
 
 
 class ActorClassOptions:

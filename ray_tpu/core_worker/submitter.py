@@ -14,6 +14,7 @@ import asyncio
 import logging
 import pickle
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
@@ -36,8 +37,183 @@ class _JobFinishedByRaylet(WorkerCrashedError):
     (the GCS declared the driver dead). Terminal for the affected tasks."""
 
 
+class _FastLeaseChannel:
+    """Native dispatch channel to ONE leased worker (rpc/native/fastloop.c
+    client): eligible normal tasks skip the per-push asyncio RPC stack on
+    both ends — the lease holder writes the frame from the IO loop, the
+    worker's C poll loop hands it straight to the executor pool, and the
+    reply completes on the C reader thread.
+
+    Owned by a single ``_run_on_lease`` coroutine (the lease's window of
+    in-flight pushes); replies are stored entirely on the C reader
+    thread — the loop-side future per push only sequences the window and
+    carries channel failures into the retry path.
+
+    A connected channel is also REGISTERED in the submitter's per-shape
+    pool: caller threads push eligible tasks through it directly
+    (``push_direct``), skipping the IO loop entirely — the lease-cache
+    design. The lease holder keeps the lease alive while direct traffic
+    flows and unregisters the channel before giving the worker back."""
+
+    def __init__(self, submitter, loop, worker_addr):
+        self._sub = submitter
+        self._cw = submitter._cw
+        self._loop = loop
+        self._addr = tuple(worker_addr)
+        self._cli = None
+        self._ids = 0
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, tuple] = {}  # req_id -> (fut|None, spec)
+        self.last_push = 0.0  # monotonic time of the last direct push
+        self.down = False
+        self._retired = False  # lease returning: no NEW direct pushes
+
+    def connect(self, fast_port: int) -> bool:
+        """Blocking (call off-loop). False = no channel; Python path."""
+        from ray_tpu.rpc.native import load_fastloop
+
+        fl = load_fastloop()
+        if fl is None:
+            return False
+        import socket as _socket
+
+        try:
+            host = _socket.gethostbyname(self._addr[0])
+            self._cli = fl.Client(
+                host, int(fast_port), self._on_reply,
+                timeout=GLOBAL_CONFIG.get("rpc_connect_timeout_s"))
+        except Exception:  # noqa: BLE001 — asyncio path still works
+            logger.debug("fast task channel to %s:%s failed",
+                         self._addr[0], fast_port, exc_info=True)
+            return False
+        return True
+
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def push(self, spec: TaskSpec, payload: bytes) -> "asyncio.Future":
+        """Write one frame; returns a loop future resolved once the reply
+        has been stored (or failed with RpcError on channel death)."""
+        fut = self._loop.create_future()
+        self._push(fut, spec, payload)
+        return fut
+
+    def push_direct(self, spec: TaskSpec, payload: bytes) -> None:
+        """Caller-thread push: no future, no loop hop. The reply is
+        stored by the reader thread; a channel failure re-routes the spec
+        through the loop's retry machinery (``_fail_pending``)."""
+        self._push(None, spec, payload)
+
+    def retire(self) -> None:
+        """Refuse new DIRECT pushes (caller threads may hold a stale
+        channel-list snapshot taken before the pool unregistration); the
+        owning lease coroutine may still drain its own window."""
+        with self._lock:
+            self._retired = True
+
+    def _push(self, fut, spec: TaskSpec, payload: bytes) -> None:
+        with self._lock:
+            if self.down or self._cli is None or \
+                    (self._retired and fut is None):
+                raise RpcError("fast task channel closed")
+            self._ids += 1
+            req_id = self._ids
+            self._inflight[req_id] = (fut, spec)
+            self.last_push = time.monotonic()
+            try:
+                self._cli.call(req_id, payload)
+            except Exception as e:  # noqa: BLE001 — possibly MID-frame:
+                # the byte stream can't be trusted; the channel goes down
+                self._inflight.pop(req_id, None)
+                self.down = True
+                raise RpcError(f"fast task channel write failed: {e}") from e
+
+    def _on_reply(self, req_id: int, payload) -> None:
+        """Runs on the C reader thread."""
+        if req_id == 0 and payload is None:
+            self._fail_pending(RpcError("fast task channel lost"))
+            return
+        with self._lock:
+            entry = self._inflight.pop(req_id, None)
+        if entry is None:
+            return
+        fut, spec = entry
+        exc: Optional[Exception] = None
+        try:
+            reply = pickle.loads(payload)
+            self._cw.store_task_reply(spec, reply, self._addr)
+        except Exception as e:  # noqa: BLE001 — surface to the retry path
+            exc = RpcError(f"fast task reply failed: {e}")
+        if fut is not None:
+            self._resolve(fut, exc)
+            return
+        self._sub._pushed.pop(spec.task_id.binary(), None)
+        if exc is not None:
+            self._route_failures([(spec, exc)])
+
+    def _fail_pending(self, exc: Exception) -> None:
+        with self._lock:
+            self.down = True
+            pending = list(self._inflight.values())
+            self._inflight.clear()
+        direct = []
+        for fut, spec in pending:
+            if fut is not None:
+                self._resolve(fut, exc)
+            else:
+                self._sub._pushed.pop(spec.task_id.binary(), None)
+                direct.append((spec, exc))
+        if direct:
+            self._route_failures(direct)
+
+    def _route_failures(self, items: List[tuple]) -> None:
+        """Hand direct-push failures to the loop's shared retry path."""
+        sub = self._sub
+
+        def go():
+            sub._io.spawn(sub._handle_push_failures(items))
+
+        try:
+            self._loop.call_soon_threadsafe(go)
+        except RuntimeError:  # loop closed (shutdown)
+            pass
+
+    def _resolve(self, fut, exc: Optional[Exception]) -> None:
+        def done():
+            if fut.done():
+                return
+            if exc is None:
+                fut.set_result(None)
+            else:
+                fut.set_exception(exc)
+
+        try:
+            self._loop.call_soon_threadsafe(done)
+        except RuntimeError:  # loop closed (shutdown)
+            pass
+
+    def close(self) -> None:
+        self._fail_pending(RpcError("fast task channel closed"))
+        cli, self._cli = self._cli, None
+        if cli is not None:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
 class NormalTaskSubmitter:
-    """Per-shape lease pools; pushes tasks directly to leased workers."""
+    """Per-shape lease pools; pushes tasks directly to leased workers.
+
+    Eligible small-arg tasks ride the native dispatch channel
+    (:class:`_FastLeaseChannel`) once per lease; everything else — and
+    every failure mode (worker death mid-dispatch, lease revocation,
+    channel loss) — takes the ordinary asyncio push/retry path with no
+    semantic change."""
+
+    # frames bigger than this stay on the asyncio path: the loop-thread
+    # write must never block on a full socket buffer
+    _FAST_MAX_BYTES = 256 * 1024
 
     def __init__(self, core_worker):
         self._cw = core_worker
@@ -57,8 +233,30 @@ class NormalTaskSubmitter:
 
         self._pushed: Dict[bytes, Tuple[str, int]] = {}
         self._cancelled = BoundedSet()
+        # dispatch-path observability: which channel tasks actually rode
+        # (the native-coverage map in PERF_PLAN.md is verified from these)
+        from ray_tpu.util import metrics as _metrics
+
+        self._m_fast = _metrics.Counter(
+            "rt_tasks_dispatched_fast",
+            "normal tasks pushed over the native dispatch channel")
+        self._m_slow = _metrics.Counter(
+            "rt_tasks_dispatched_rpc",
+            "normal tasks pushed over the asyncio RPC path")
+        # lease cache: shape key -> connected fast channels. Caller
+        # threads push eligible tasks through these directly; the lease
+        # holders register/unregister them and own the lease lifecycle.
+        self._fast_pool: Dict[tuple, List[_FastLeaseChannel]] = {}
+        self._fast_pool_lock = threading.Lock()
 
     def submit(self, spec: TaskSpec):
+        # Lease-cache fast path: an eligible task whose shape already
+        # holds a connected channel is written from THIS thread straight
+        # to the leased worker's fastloop — no loop wakeup, no queue, no
+        # per-task raylet round-trip.
+        if self._fast_pool and GLOBAL_CONFIG.get("fast_dispatch_direct") \
+                and self._try_fast_submit(spec):
+            return
         # Batched wakeup: a burst of submits from caller threads schedules
         # ONE loop callback that drains them all, instead of one
         # call_soon_threadsafe (pipe write + loop iteration) per task —
@@ -69,6 +267,46 @@ class NormalTaskSubmitter:
                 return
             self._wakeup_scheduled = True
         self._io.loop.call_soon_threadsafe(self._drain_pending)
+
+    def _try_fast_submit(self, spec: TaskSpec) -> bool:
+        """Caller-thread dispatch through a cached lease channel. False =
+        take the queue path (no channel for the shape, channels at their
+        window cap, or the task is ineligible). Eligible tasks have only
+        inline args, so the dependency gate is vacuous for them."""
+        key = spec.shape_key()
+        if key not in self._fast_pool:
+            return False
+        # capacity/breadth gates BEFORE encoding: a gated submit must not
+        # pay the args pickle + native pack only to throw it away (the
+        # queue path re-encodes later)
+        with self._fast_pool_lock:
+            chans = list(self._fast_pool.get(key) or ())
+        if not chans:
+            return False
+        cap = max(1, GLOBAL_CONFIG.get("fast_dispatch_window"))
+        best = min(chans, key=lambda c: c.inflight())
+        busy = best.inflight()
+        if best.down or busy >= cap:
+            return False  # saturated: queue → more leases spawn
+        if busy > 0 and len(chans) < GLOBAL_CONFIG.get(
+                "lease_request_batch_size"):
+            # breadth first here too: stack depth on a channel only once
+            # the shape's lease pool is at full width — otherwise a small
+            # fan-out serializes onto one worker process while the queue
+            # path would have spread it
+            return False
+        payload = self._encode_task(spec)
+        if payload is None:
+            return False
+        tid = spec.task_id.binary()
+        self._pushed[tid] = best._addr
+        try:
+            best.push_direct(spec, payload)
+        except Exception:  # noqa: BLE001 — channel raced shut: queue path
+            self._pushed.pop(tid, None)
+            return False
+        self._m_fast.inc()  # count only dispatches that actually left
+        return True
 
     def _drain_pending(self):
         with self._pending_lock:
@@ -164,9 +402,10 @@ class NormalTaskSubmitter:
                                 f"{sample.required_resources.resources.to_dict()}"),
                         )
                     return
-                raylet_addr, lease_id, worker_addr = grant
+                raylet_addr, lease_id, worker_addr, fast_port = grant
                 try:
-                    await self._run_on_lease(key, lease_id, worker_addr)
+                    await self._run_on_lease(key, lease_id, worker_addr,
+                                             fast_port)
                 finally:
                     try:
                         c = RetryableRpcClient(raylet_addr, deadline_s=5.0)
@@ -213,7 +452,8 @@ class NormalTaskSubmitter:
             status = reply.get("status")
             if status == "granted":
                 logger.debug("lease granted: worker %s", reply["worker_address"])
-                return raylet_addr, lease_id, tuple(reply["worker_address"])
+                return (raylet_addr, lease_id, tuple(reply["worker_address"]),
+                        reply.get("worker_fast_port"))
             if status == "spill":
                 raylet_addr = tuple(reply["address"])
                 continue
@@ -232,20 +472,68 @@ class NormalTaskSubmitter:
                     "unreachable or exited)")
         return None
 
-    async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr):
+    async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr,
+                            fast_port=None):
         """Drain queued tasks through one leased worker. When the queue
         empties, the lease is RETAINED for a short grace window waiting for
         more same-shape work (reference: lease pooling / idle lease reuse)
         — a sequential sync caller otherwise pays a full lease round-trip
-        per task."""
+        per task.
+
+        The lease resolves its native dispatch channel ONCE (connect to
+        the worker's fastloop port, off-loop); every eligible task of the
+        lease then bypasses the per-push asyncio RPC stack entirely.
+        Channel loss — worker death mid-dispatch, lease revocation by the
+        raylet — fails the in-flight push into the ordinary retry path,
+        exactly as an asyncio push failure would."""
         client = RpcClient(worker_addr)
+        fast: Optional[_FastLeaseChannel] = None
+        if fast_port and GLOBAL_CONFIG.get("fastloop_enabled"):
+            chan = _FastLeaseChannel(self, asyncio.get_running_loop(),
+                                     worker_addr)
+            if await asyncio.to_thread(chan.connect, fast_port):
+                fast = chan
+                with self._fast_pool_lock:
+                    self._fast_pool.setdefault(key, []).append(chan)
         grace_s = GLOBAL_CONFIG.get("lease_idle_grace_ms") / 1000.0
+        window = max(1, GLOBAL_CONFIG.get("fast_dispatch_window")) \
+            if fast is not None else 1
+        pending: Dict["asyncio.Future", TaskSpec] = {}
+        failed: List[tuple] = []
+
+        async def reap(return_when):
+            done, _ = await asyncio.wait(list(pending),
+                                         return_when=return_when)
+            for fut in done:
+                spec = pending.pop(fut)
+                self._pushed.pop(spec.task_id.binary(), None)
+                exc = fut.exception()
+                if exc is not None:
+                    failed.append((spec, exc))
+
         try:
             while True:
+                if failed:
+                    # channel died (worker crash / lease revocation):
+                    # reap the rest and route every failed spec through
+                    # the ordinary retry path, then end the lease
+                    if pending:
+                        await reap(asyncio.ALL_COMPLETED)
+                    await self._handle_push_failures(failed)
+                    return
                 queue = self._queues.get(key)
                 if not queue:
+                    if pending:
+                        await reap(asyncio.FIRST_COMPLETED)
+                        continue
+                    if fast is not None and not fast.down \
+                            and fast.inflight():
+                        # direct (caller-thread) pushes are riding this
+                        # lease: hold it open while they complete
+                        await asyncio.sleep(0.01)
+                        continue
                     if grace_s <= 0:
-                        return
+                        return  # retention disabled: give the worker back
                     ev = self._work_events.get(key)
                     if ev is None:
                         ev = self._work_events[key] = asyncio.Event()
@@ -253,7 +541,24 @@ class NormalTaskSubmitter:
                     try:
                         await asyncio.wait_for(ev.wait(), grace_s)
                     except asyncio.TimeoutError:
+                        if fast is not None and not fast.down and (
+                                fast.inflight()
+                                or time.monotonic() - fast.last_push
+                                < grace_s):
+                            # recent direct traffic: stay warm
+                            continue
                         return  # stayed idle: give the worker back
+                    continue
+                # Breadth first, depth second: a second task enters THIS
+                # lease's window only when the queue is deeper than the
+                # shape's lease pool could drain one-per-lease — small
+                # fan-outs must spread across workers (pipelining four
+                # long batchers onto one process serializes them), deep
+                # backlogs overlap wire latency with execution.
+                if pending and (
+                        len(pending) >= window
+                        or len(queue) <= self._leases_in_flight.get(key, 1)):
+                    await reap(asyncio.FIRST_COMPLETED)
                     continue
                 spec = queue.pop(0)
                 tid = spec.task_id.binary()
@@ -262,7 +567,28 @@ class NormalTaskSubmitter:
                         "the task was cancelled before it started"))
                     continue
                 logger.debug("pushing task %s to %s", spec.task_id.hex()[:8], worker_addr)
+                payload = (self._encode_task(spec)
+                           if fast is not None and not fast.down else None)
+                if payload is not None:
+                    self._pushed[tid] = tuple(worker_addr)
+                    try:
+                        # the reply is stored by the channel's reader
+                        # thread; the future only sequences the window
+                        pending[fast.push(spec, payload)] = spec
+                    except Exception as e:  # noqa: BLE001 — channel died
+                        self._pushed.pop(tid, None)
+                        failed.append((spec, e))
+                        continue
+                    self._m_fast.inc()  # only frames that actually left
+                    continue
+                # ineligible task: drain the window first (the asyncio
+                # push is strictly one-at-a-time on the lease)
+                if pending:
+                    queue.insert(0, spec)
+                    await reap(asyncio.ALL_COMPLETED)
+                    continue
                 self._pushed[tid] = tuple(worker_addr)
+                self._m_slow.inc()
                 try:
                     reply = await client.call_async(
                         "push_task", spec=pickle.dumps(spec), timeout=None,
@@ -276,6 +602,67 @@ class NormalTaskSubmitter:
                 self._cw.store_task_reply(spec, reply, worker_addr)
         finally:
             client.close()
+            if fast is not None:
+                # Unregister + retire FIRST: caller threads stop picking
+                # this channel and racing direct pushes (stale snapshot)
+                # are refused — a push that landed on the live worker must
+                # never ALSO be re-enqueued by close()'s fail-pending.
+                with self._fast_pool_lock:
+                    lst = self._fast_pool.get(key)
+                    if lst is not None:
+                        if fast in lst:
+                            lst.remove(fast)
+                        if not lst:
+                            self._fast_pool.pop(key, None)
+                fast.retire()
+                # graceful drain: an in-flight frame on a LIVE worker is
+                # waited out (worker death flips `down` and routes the
+                # remainder through the retry path). Bounded — a reply
+                # swallowed by a worker-side bug must not wedge the lease
+                # coroutine forever; past the bound, close() fails the
+                # stragglers into the retry path.
+                deadline = time.monotonic() + 300.0
+                while fast.inflight() and not fast.down \
+                        and time.monotonic() < deadline:
+                    await asyncio.sleep(0.01)
+                fast.close()
+
+    def _encode_task(self, spec: TaskSpec) -> Optional[bytes]:
+        """Native submit record for a channel-eligible task, or None to
+        take the asyncio path. Eligible = plain inline args (by-ref args
+        — including OOB-promoted ones — need the handoff protocol and
+        executee-side fetches that must not ride the C thread), no
+        runtime_env / streaming / tracing, and a small total frame."""
+        if spec.streaming or spec.runtime_env is not None or \
+                getattr(spec, "tracing", None) is not None:
+            return None
+        total = len(spec.serialized_func or b"")
+        for arg in spec.args:
+            if not arg.is_inline:
+                return None
+            total += len(arg.value)
+        if total > self._FAST_MAX_BYTES:
+            return None
+        from ray_tpu.rpc.native import load_fastspec
+
+        fs = load_fastspec()
+        payload = pickle.dumps([arg.value for arg in spec.args])
+        if fs is not None:
+            host, port = spec.caller_address
+            try:
+                return fs.pack_task(
+                    spec.task_id.binary(), spec.job_id.binary(),
+                    spec.caller_worker_id.binary(), host.encode(),
+                    spec.function.qualname.encode(),
+                    spec.serialized_func or b"", payload,
+                    (spec.name or "").encode(),
+                    spec.num_returns, port)
+            except OverflowError:
+                return None
+        # no codec here: the executee accepts a pickled spec on the same
+        # channel (frames not starting with RTFS unpickle)
+        blob = pickle.dumps(spec)
+        return blob if len(blob) <= self._FAST_MAX_BYTES else None
 
     def cancel(self, task_id_bin: bytes):
         """Owner side. Returns ("queued", None) if removed before running,
@@ -295,23 +682,34 @@ class NormalTaskSubmitter:
         return (None, None)
 
     async def _handle_push_failure(self, spec: TaskSpec, exc: Exception):
-        if spec.task_id.binary() in self._cancelled:
-            # force-cancel kills the executor mid-push: that is the cancel
-            # completing, not a crash to retry
-            self._store_error(spec, TaskCancelledError(
-                "the task was cancelled while running"))
-            return
-        if spec.max_retries > 0:
-            spec.max_retries -= 1
-            logger.info("retrying task %s after push failure: %s",
-                        spec.task_id.hex()[:8], exc)
-            # brief backoff: give the raylet time to reap dead workers so the
-            # retry isn't granted the same dying worker again
+        await self._handle_push_failures([(spec, exc)])
+
+    async def _handle_push_failures(self, items: List[tuple]):
+        """Shared by the asyncio path (one spec) and the native dispatch
+        window (every spec in flight when the channel died): cancelled
+        specs resolve as cancelled, retryable ones re-enqueue after ONE
+        backoff — giving the raylet time to reap the dead worker so the
+        retries aren't granted the same dying worker again."""
+        retry: List[TaskSpec] = []
+        for spec, exc in items:
+            if spec.task_id.binary() in self._cancelled:
+                # force-cancel kills the executor mid-push: that is the
+                # cancel completing, not a crash to retry
+                self._store_error(spec, TaskCancelledError(
+                    "the task was cancelled while running"))
+            elif spec.max_retries > 0:
+                spec.max_retries -= 1
+                logger.info("retrying task %s after push failure: %s",
+                            spec.task_id.hex()[:8], exc)
+                retry.append(spec)
+            else:
+                self._store_error(spec, WorkerCrashedError(
+                    f"worker died executing task "
+                    f"{spec.name or spec.function.qualname}: {exc}"))
+        if retry:
             await asyncio.sleep(0.3)
-            self._enqueue(spec)
-        else:
-            self._store_error(spec, WorkerCrashedError(
-                f"worker died executing task {spec.name or spec.function.qualname}: {exc}"))
+            for spec in retry:
+                self._enqueue(spec)
 
     def _store_error(self, spec: TaskSpec, error: Exception):
         blob = pickle.dumps(error)
@@ -546,14 +944,26 @@ class ActorTaskSubmitter:
 
     async def _resolve_address_inner(self):
         prev_addr = self._address
-        deadline = asyncio.get_running_loop().time() + 60.0
-        while asyncio.get_running_loop().time() < deadline:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 60.0
+        # registrations are async for unnamed actors (worker.py
+        # create_actor): "not found" within this window just means the
+        # register RPC hasn't landed yet, not that the actor is gone.
+        # Backoff doubles 20ms → 250ms so a churn burst of unresolved
+        # handles doesn't stampede the GCS with 50 polls/s each.
+        unknown_deadline = loop.time() + 5.0
+        unknown_wait = 0.02
+        while loop.time() < deadline:
             try:
                 info = await self._cw.gcs.call_async("get_actor", actor_id=self.actor_id.binary())
             except Exception:  # noqa: BLE001
                 await asyncio.sleep(0.5)
                 continue
             if info is None:
+                if loop.time() < unknown_deadline:
+                    await asyncio.sleep(unknown_wait)
+                    unknown_wait = min(unknown_wait * 2, 0.25)
+                    continue
                 self._mark_dead(ActorDiedError(self.actor_id, "actor not found"))
                 return
             state = info["state"]

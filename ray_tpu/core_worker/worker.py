@@ -116,6 +116,15 @@ class CoreWorker:
         self.raylet_address = tuple(raylet_address)
         self._io = IoContext.current()
 
+        # boot-phase tracing (RT_BOOT_TRACE=1): worker supply rate bounds
+        # actors_per_second, so the init hot spots must stay findable
+        _bt0 = time.monotonic()
+        _bt = (lambda tag, _l=[_bt0]:
+               (logger.info("boot-trace %s %.1fms", tag,
+                            1e3 * (time.monotonic() - _l[0])),
+                _l.__setitem__(0, time.monotonic()))
+               ) if os.environ.get("RT_BOOT_TRACE") else (lambda tag: None)
+
         self.server = RpcServer(port=port)
         for name in (
             "push_task", "create_actor", "get_object", "free_object",
@@ -123,10 +132,11 @@ class CoreWorker:
             "actor_method_metadata", "object_info", "get_object_chunk",
             "incref_inflight", "borrow_ack", "borrow_release", "drop_copy",
             "handoff_done", "device_object_get", "report_generator_item",
-            "cancel_task", "cancel_running_task",
+            "cancel_task", "cancel_running_task", "configure_worker",
         ):
             self.server.register(name, getattr(self, f"h_{name}"))
         self.server.start()
+        _bt("rpc-server")
 
         self.gcs = GcsClient(self.gcs_address, client_id=f"worker-{self.worker_id.hex()[:8]}")
         self.memory_store = MemoryStore()
@@ -141,6 +151,7 @@ class CoreWorker:
         self._device_obj_cache: "_collections.OrderedDict" = \
             _collections.OrderedDict()
         self._device_cache_lock = threading.Lock()
+        _bt("stores")
         self.submitter = NormalTaskSubmitter(self)
         self._actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
         self._actor_sub_lock = threading.Lock()
@@ -156,6 +167,7 @@ class CoreWorker:
         self._cancel_requested = BoundedSet()
         self._cancelled_tasks = BoundedSet()
 
+        _bt("submitters")
         if mode == MODE_DRIVER:
             self.job_id = job_id or JobID(self.gcs.call("get_next_job_id"))
             self.gcs.register_job(self.job_id, self.server.address)
@@ -191,12 +203,14 @@ class CoreWorker:
 
         # execution state (executee side)
         self._executor = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rt-exec")
+        self._fn_cache: Dict[bytes, Any] = {}
         # C dispatch loop (rpc/native/fastloop.c): eligible actor pushes
         # bypass asyncio end to end — frames execute straight off the C
         # thread (ordered, immediately-runnable calls) or hop once to the
         # executor/actor loop (concurrent or async-actor calls).  The
         # SURVEY §2.5 native hot path; drivers never execute actor tasks,
         # so only workers pay for the extra thread.
+        _bt("exec-state")
         self._fast_server = None
         self._fast_port: Optional[int] = None
         self._fast_gap_buf: Dict[bytes, dict] = {}
@@ -225,6 +239,7 @@ class CoreWorker:
         self._async_call_sem: Optional[asyncio.Semaphore] = None
         self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
 
+        _bt("fastloop")
         self._shm = False  # False = not probed yet; None = unavailable
         self._shm_probe_lock = threading.Lock()
         if mode != MODE_DRIVER:
@@ -233,10 +248,14 @@ class CoreWorker:
             # first fetch must not silently fall back to an RPC copy
             _ = self.shm
         self._task_events: list = []
+        # read once at boot: the per-task hot path must not take the
+        # config lock (toggling at runtime requires a worker restart)
+        self._task_events_enabled = GLOBAL_CONFIG.get("task_events_enabled")
         self._task_events_lock = threading.Lock()
         self._task_events_stop = threading.Event()
         threading.Thread(target=self._task_event_flusher, daemon=True,
                          name="task-event-flush").start()
+        _bt("shm-probe")
         install_release_sink(self._on_ref_deleted)
         install_borrow_sinks(self._on_ref_serialized, self._on_ref_deserialized)
         CoreWorker._current = self
@@ -790,7 +809,8 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, resources=None, label_selector=None,
                      scheduling_strategy=None, max_restarts=0, max_concurrency=1,
                      name=None, namespace="default",
-                     runtime_env=None) -> "ActorID":
+                     runtime_env=None,
+                     serialized_cls: Optional[bytes] = None) -> "ActorID":
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
         actor_id = ActorID.of(self.job_id, self.current_task_id(), self._actor_counter.next())
@@ -801,7 +821,7 @@ class CoreWorker:
             task_type=TaskType.ACTOR_CREATION_TASK,
             function=FunctionDescriptor(
                 getattr(cls, "__module__", "?"), getattr(cls, "__qualname__", str(cls))),
-            serialized_func=cloudpickle.dumps(cls),
+            serialized_func=serialized_cls or cloudpickle.dumps(cls),
             args=self._serialize_args(args, kwargs, allow_oob=False),
             num_returns=0,
             required_resources=ResourceRequest(resources or {}, label_selector),
@@ -815,11 +835,38 @@ class CoreWorker:
             runtime_env=_merge_env(
                 getattr(self, "job_runtime_env", None), runtime_env),
         )
-        reply = self.gcs.register_actor(
-            pickle.dumps(spec), actor_id, self.job_id, name=name,
-            namespace=namespace, max_restarts=max_restarts)
-        if not reply.get("ok"):
-            raise RtError(reply.get("error", "actor registration failed"))
+        if name is not None:
+            # named actors keep the synchronous ack: the caller must see a
+            # name collision as an exception from .remote()
+            reply = self.gcs.register_actor(
+                pickle.dumps(spec), actor_id, self.job_id, name=name,
+                namespace=namespace, max_restarts=max_restarts)
+            if not reply.get("ok"):
+                raise RtError(reply.get("error", "actor registration failed"))
+            return actor_id
+
+        # Unnamed actors register ASYNCHRONOUSLY (reference semantics:
+        # ActorClass.remote() must not block the driver for the spawn
+        # chain). The actor_id is minted locally; the submitter's address
+        # resolution tolerates the registration still being in flight.
+        # At actor-churn rates the synchronous ack was the single largest
+        # serial cost on the creation path (~9 ms per .remote() measured).
+        blob = pickle.dumps(spec)
+
+        async def register():
+            try:
+                reply = await self.gcs.call_async(
+                    "register_actor", creation_spec=blob,
+                    actor_id=actor_id.binary(), job_id=self.job_id.binary(),
+                    name=None, namespace=namespace,
+                    max_restarts=max_restarts)
+                if not reply.get("ok"):
+                    logger.error("async actor registration failed: %s",
+                                 reply.get("error"))
+            except Exception:  # noqa: BLE001 — resolution will time out
+                logger.exception("async actor registration failed")
+
+        self._io.spawn_threadsafe(register())
         return actor_id
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
@@ -1335,6 +1382,22 @@ class CoreWorker:
             os.environ["CUDA_VISIBLE_DEVICES"] = ",".join(str(i) for i in gpu_ids)
         return True
 
+    async def h_configure_worker(self, env_vars: Optional[dict] = None,
+                                 cwd: Optional[str] = None):
+        """Warm-pool adoption fixup (raylet worker_pool): a pre-forked
+        default-env worker is reassigned to a lease/actor whose runtime
+        env differs only by env_vars/cwd. Those are applied here, post
+        fork, instead of paying a fresh fork. Envs that need fork-time
+        state (pip/py_modules/working_dir PYTHONPATH staging) are not
+        offered to this path — the raylet falls back to a real fork."""
+        if env_vars:
+            os.environ.update({str(k): str(v) for k, v in env_vars.items()})
+            # RT_* flag overrides may have arrived with the env
+            GLOBAL_CONFIG._cache.clear()
+        if cwd:
+            os.chdir(cwd)
+        return True
+
     @staticmethod
     def _boot_deferred_tpu_runtime():
         """Workers fork without the TPU PJRT preload (it costs ~2 s per
@@ -1657,6 +1720,15 @@ class CoreWorker:
         if task.job_id is not None and not task.job_id.is_nil():
             self.current_job_hex = task.job_id.hex()
             self.job_id = task.job_id
+        if not task.is_actor_task():
+            # Normal task over the lease-cached dispatch channel
+            # (submitter.py _run_on_lease): no per-caller ordering
+            # contract, so it goes straight to the pool with a deferred
+            # reply — never executed on the C thread.
+            f = self._executor.submit(self._execute_task, task)
+            f.add_done_callback(
+                lambda f: self._fast_deferred_reply(conn_id, req_id, f))
+            return None
         if task.is_actor_task() and self._is_async_actor_call(task):
             start = time.time()
             cf = asyncio.run_coroutine_threadsafe(
@@ -1880,6 +1952,8 @@ class CoreWorker:
                            reply: dict):
         """Buffer + batch-flush task events to the GCS task store
         (reference: core_worker/task_event_buffer.cc → gcs_task_manager)."""
+        if not self._task_events_enabled:
+            return
         failed = any("error" in p for p in reply.get("results", {}).values())
         event = {
             "task_id": task.task_id.hex(),
@@ -1908,10 +1982,30 @@ class CoreWorker:
         except Exception:  # noqa: BLE001 — observability is best-effort
             pass
 
+    # Deserialized-function cache, keyed by the cloudpickle bytes (the
+    # reference keeps a per-job function table the same way,
+    # function_manager.py). A fan-out of N tasks over one function pays
+    # ONE cloudpickle.loads instead of N — the single hottest line of the
+    # normal-task execute path once dispatch went native. Bounded FIFO;
+    # GIL-atomic dict ops, a racing double-load is benign.
+    _FN_CACHE_CAP = 256
+
+    def _load_task_fn(self, blob: bytes):
+        fn = self._fn_cache.get(blob)
+        if fn is None:
+            fn = cloudpickle.loads(blob)
+            if len(self._fn_cache) >= self._FN_CACHE_CAP:
+                try:
+                    self._fn_cache.pop(next(iter(self._fn_cache)))
+                except (KeyError, StopIteration):
+                    pass
+            self._fn_cache[blob] = fn
+        return fn
+
     def _execute_fn_task(self, task: TaskSpec) -> dict:
         self._ctx.task_id = task.task_id
         try:
-            fn = cloudpickle.loads(task.serialized_func)
+            fn = self._load_task_fn(task.serialized_func)
             args, kwargs = self._resolve_args(task.args)
             result = fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 - user task error

@@ -138,6 +138,9 @@ def main():
 
     from .worker import MODE_WORKER, CoreWorker
 
+    # boot timing: the warm-pool supply rate IS this path (worker_factory
+    # fork → CoreWorker init → register); keep it observable
+    t_boot = time.monotonic()
     cw = CoreWorker(
         mode=MODE_WORKER,
         gcs_address=(gcs_host, int(gcs_port)),
@@ -145,9 +148,20 @@ def main():
         node_id=node_id,
         worker_id=worker_id,
     )
+    t_cw = time.monotonic()
     raylet = RetryableRpcClient((raylet_host, int(raylet_port)))
     reply = raylet.call(
-        "register_worker", worker_id=worker_id.binary(), address=cw.server.address)
+        "register_worker", worker_id=worker_id.binary(),
+        address=cw.server.address,
+        # advertised to lease holders for the native task-dispatch channel
+        fast_port=cw._fast_port)
+    spawn_t = float(os.environ.get("RT_SPAWN_T") or t_boot)
+    child_t = float(os.environ.get("RT_CHILD_T") or t_boot)
+    logging.getLogger(__name__).info(
+        "worker boot: spawn-to-fork %.0fms, fork-to-entry %.0fms, "
+        "core_worker %.0fms, register %.0fms",
+        1e3 * (child_t - spawn_t), 1e3 * (t_boot - child_t),
+        1e3 * (t_cw - t_boot), 1e3 * (time.monotonic() - t_cw))
     if not reply.get("ok"):
         return  # raylet doesn't know us: die quietly
     while True:

@@ -119,6 +119,14 @@ class ShmObjectStore:
         # derived from the segment name), so any process can spill and
         # any process can read back.
         self._spill_dir = spill_dir
+        # drop_spilled() runs on EVERY owned-ref free — an unconditional
+        # unlink(2) there costs ~60 µs per freed object (measured: the
+        # single hottest syscall of the small-task hot loop). The dir-level
+        # sentinel below makes the no-spills-ever case free: it is created
+        # on the first spill by ANY process sharing the dir, and each
+        # handle re-checks it at most once a second until seen.
+        self._spill_seen = False
+        self._spill_seen_t = 0.0
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
             self._lib.rts_set_autoevict(self._h, 0)
@@ -146,6 +154,33 @@ class ShmObjectStore:
     def _spill_path(self, object_id: bytes) -> str:
         return os.path.join(self._spill_dir, object_id.hex())
 
+    def _sentinel_path(self) -> str:
+        return os.path.join(self._spill_dir, ".has_spills")
+
+    def _mark_spilled(self) -> None:
+        if not self._spill_seen:
+            self._spill_seen = True
+            try:
+                open(self._sentinel_path(), "a").close()
+            except OSError:
+                pass
+
+    def _maybe_has_spills(self) -> bool:
+        """Cheap gate for per-free spill-file cleanup: False until any
+        process sharing this spill dir has spilled (re-stat ≤ 1/s). The
+        ≤1 s race can only leak a stray spill file until session teardown
+        removes the dir — never lose data (read paths are unguarded)."""
+        if self._spill_seen:
+            return True
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._spill_seen_t < 1.0:
+            return False
+        self._spill_seen_t = now
+        self._spill_seen = os.path.exists(self._sentinel_path())
+        return self._spill_seen
+
     def _spill_one(self) -> bool:
         """Demote the LRU victim to disk.  False when nothing evictable."""
         out_id = ctypes.create_string_buffer(32)
@@ -163,6 +198,7 @@ class ShmObjectStore:
             with open(tmp, "wb") as f:
                 f.write(view)
             os.replace(tmp, self._spill_path(oid))
+            self._mark_spilled()
         finally:
             del view
             self.release(oid)
@@ -188,6 +224,7 @@ class ShmObjectStore:
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, self._spill_path(object_id))
+        self._mark_spilled()
         return True
 
     def read_spilled(self, object_id: bytes) -> Optional[bytes]:
@@ -203,7 +240,7 @@ class ShmObjectStore:
             return None
 
     def drop_spilled(self, object_id: bytes) -> None:
-        if self._spill_dir is None:
+        if self._spill_dir is None or not self._maybe_has_spills():
             return
         try:
             os.unlink(self._spill_path(object_id))

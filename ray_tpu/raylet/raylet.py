@@ -49,6 +49,7 @@ class WorkerHandle:
     worker_id: WorkerID
     proc: Optional[subprocess.Popen]
     address: Optional[Tuple[str, int]] = None  # worker's RPC server
+    fast_port: Optional[int] = None  # worker's fastloop dispatch port
     state: str = "STARTING"  # STARTING | IDLE | LEASED | ACTOR | DEAD
     env_key: Optional[str] = None  # runtime-env pool key (None = default env)
     lease_id: Optional[bytes] = None
@@ -61,6 +62,22 @@ class WorkerHandle:
     registered: "asyncio.Event" = field(default_factory=asyncio.Event)
     # factory-forked workers have a bare pid instead of a Popen handle
     factory_pid: Optional[int] = None
+    # cached raylet→worker RPC client (connect+HELLO once per worker, not
+    # once per actor creation / device grant)
+    rpc: Optional[RetryableRpcClient] = None
+
+    def client(self) -> RetryableRpcClient:
+        if self.rpc is None:
+            self.rpc = RetryableRpcClient(self.address, deadline_s=30.0)
+        return self.rpc
+
+    def close_client(self) -> None:
+        if self.rpc is not None:
+            try:
+                self.rpc.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.rpc = None
 
     @property
     def pid(self) -> Optional[int]:
@@ -158,7 +175,8 @@ class Raylet:
         self._bg_tasks: List = []
         self._fake_worker_env = fake_worker_env or {}
         self._factory = None        # forkserver client (worker_factory.py)
-        self._factory_proc = None
+        self._factory_procs: List[subprocess.Popen] = []
+        self._refills_inflight = 0  # scheduled pool refills not yet STARTING
         from ray_tpu.runtime_env.agent import RuntimeEnvAgent
 
         self.runtime_env_agent = RuntimeEnvAgent(self.session_dir)
@@ -169,6 +187,24 @@ class Raylet:
             min_interval_s=GLOBAL_CONFIG.get(
                 "memory_monitor_refresh_ms") / 1000.0)
         self._oom_kills = 0
+        # warm-pool observability (util/metrics.py): pool depth + hit/miss
+        # make actors_per_second regressions attributable — a collapsing
+        # pool shows up as a miss streak, not just a slower bench row
+        from ray_tpu.util import metrics as _metrics
+
+        self._m_pool_size = _metrics.Gauge(
+            "rt_worker_pool_size",
+            "warm default-env workers (IDLE registered or STARTING)")
+        self._m_pool_hits = _metrics.Counter(
+            "rt_worker_pool_hits",
+            "worker pops served by a warm pool worker (incl. adoptions)")
+        self._m_pool_misses = _metrics.Counter(
+            "rt_worker_pool_misses",
+            "worker pops that had to fork (or wait for a fork)")
+        self._m_pool_adoptions = _metrics.Counter(
+            "rt_worker_pool_adoptions",
+            "default-env pool workers reassigned to an env_vars/cwd-only "
+            "runtime env via the configure_worker handshake")
         self.cgroups = None
         if GLOBAL_CONFIG.get("cgroup_isolation_enabled"):
             from ray_tpu.raylet.cgroups import CgroupManager
@@ -257,34 +293,67 @@ class Raylet:
         the BACKGROUND: sustained actor churn then pipelines interpreter
         forks behind control-plane work instead of paying them on every
         creation's critical path (reference: worker_pool.cc
-        PrestartWorkers on demand-prediction)."""
+        PrestartWorkers on demand-prediction).
+
+        Replenishment is CONCURRENT up to the node-wide fork cap: a burst
+        of creations larger than the pool used to serialize behind one
+        fork per consumed worker (the round-5 cold-start hole) — now the
+        whole deficit forks at once and the pool refills in one fork
+        latency instead of ``deficit`` of them."""
         target = GLOBAL_CONFIG.get("num_prestart_workers")
         if target <= 0 or self._stopped:
+            return
+        if self._factory is None:
+            # no warm forkserver attached (yet): a proactive refill would
+            # exec-spawn a full interpreter (~1.5 s CPU) per consumed
+            # worker — short-lived clusters (tests) must not pay that;
+            # demand-driven pops still spawn as before
             return
         warm = sum(1 for w in self._workers.values()
                    if w.env_key is None
                    and (w.state == "STARTING"  # pid may not be known yet
                         or (w.state == "IDLE" and w.alive())))
-        if warm >= target:
+        self._m_pool_size.set(warm)
+        # refills already scheduled but not yet visible as STARTING
+        # handles (the factory spawn hasn't returned a pid yet) count
+        # toward the deficit, or a pop burst schedules the whole deficit
+        # once per pop and overshoots the watermark
+        inflight = getattr(self, "_refills_inflight", 0)
+        deficit = target - warm - inflight
+        if deficit <= 0:
             return
+        starting = sum(1 for w in self._workers.values()
+                       if w.state == "STARTING")
+        slots = max(0, GLOBAL_CONFIG.get("maximum_startup_concurrency")
+                    - starting - inflight)
+        n = min(deficit, slots)
+        if n <= 0:
+            return
+        self._refills_inflight = inflight + n
 
         async def refill():
             try:
                 await self._start_worker()
             except Exception:  # noqa: BLE001 — warm pool is best-effort
                 logger.debug("pool replenish failed", exc_info=True)
+            finally:
+                self._refills_inflight -= 1
 
-        self._io.spawn_threadsafe(refill())
+        for _ in range(n):
+            self._io.spawn_threadsafe(refill())
 
     def _start_factory(self):
-        """Boot the forkserver worker factory (worker_factory.py): one warm
-        interpreter whose forks cut worker creation from interpreter-boot
-        cost to ~fork cost."""
+        """Boot the forkserver worker factories (worker_factory.py): warm
+        interpreters whose forks cut worker creation from interpreter-boot
+        cost to ~fork cost. ``worker_factory_procs`` of them run side by
+        side — fork(2) serializes inside one address space (~12 ms per
+        fork of a warm interpreter here), so parallel factories are what
+        raise the sustained worker-supply ceiling that actor churn rides."""
         from ray_tpu.common.tpu_detect import defer_tpu_preload
-        from ray_tpu.raylet.worker_factory import FactoryClient
+        from ray_tpu.raylet.worker_factory import (FactoryClient,
+                                                   MultiFactoryClient)
 
-        sock = os.path.join(self.session_dir,
-                            f"factory_{self.node_id.hex()[:8]}.sock")
+        n = max(1, GLOBAL_CONFIG.get("worker_factory_procs"))
         env = defer_tpu_preload(dict(os.environ))
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -292,27 +361,46 @@ class Raylet:
             env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
                                  if env.get("PYTHONPATH") else pkg_root)
         log_path = os.path.join(self.session_dir, "worker_factory.log")
-        self._factory_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.raylet.worker_factory", sock],
-            env=env, stdout=open(log_path, "ab"), stderr=subprocess.STDOUT)
+        socks = []
+        self._factory_procs = []
+        for i in range(n):
+            sock = os.path.join(
+                self.session_dir,
+                f"factory_{self.node_id.hex()[:8]}_{i}.sock")
+            socks.append(sock)
+            self._factory_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.raylet.worker_factory",
+                 sock],
+                env=env, stdout=open(log_path, "ab"),
+                stderr=subprocess.STDOUT))
 
-        def wait_ready(proc=self._factory_proc):
+        def wait_ready(procs=list(self._factory_procs)):
             # Non-blocking adoption: raylet startup (and anything timing
             # it, e.g. the autoscaler's launch bookkeeping) must not stall
-            # on interpreter boot; workers exec-spawn until the factory's
-            # socket is up, then forks take over.
+            # on interpreter boot; workers exec-spawn until the factory
+            # sockets are up, then forks take over. Factories that come
+            # up are adopted incrementally.
             deadline = time.monotonic() + 30.0
-            while not os.path.exists(sock):
-                if (proc.poll() is not None
-                        or time.monotonic() > deadline
-                        or self._stopped):
-                    logger.warning("worker factory failed to start; "
-                                   "exec spawning stays in effect")
-                    return
-                time.sleep(0.05)
-            if self._factory_proc is proc and not self._stopped:
-                self._factory = FactoryClient(sock)
-                logger.debug("worker factory up at %s", sock)
+            ready: list = []
+            waiting = list(zip(procs, socks))
+            while waiting and time.monotonic() < deadline \
+                    and not self._stopped:
+                still = []
+                for proc, sock in waiting:
+                    if os.path.exists(sock):
+                        ready.append(FactoryClient(sock))
+                        if self._factory_procs and not self._stopped:
+                            self._factory = MultiFactoryClient(ready)
+                    elif proc.poll() is None:
+                        still.append((proc, sock))
+                waiting = still
+                if waiting:
+                    time.sleep(0.05)
+            if not ready:
+                logger.warning("worker factory failed to start; "
+                               "exec spawning stays in effect")
+            else:
+                logger.debug("%d worker factories up", len(ready))
 
         import threading as _threading
 
@@ -340,13 +428,13 @@ class Raylet:
         if getattr(self, "_factory", None) is not None:
             self._factory.shutdown()
             self._factory = None
-        if getattr(self, "_factory_proc", None) is not None:
-            self._factory_proc.terminate()
+        for proc in getattr(self, "_factory_procs", []):
+            proc.terminate()
             try:
-                self._factory_proc.wait(timeout=3)
+                proc.wait(timeout=3)
             except subprocess.TimeoutExpired:
-                self._factory_proc.kill()
-            self._factory_proc = None
+                proc.kill()
+        self._factory_procs = []
         self.gcs.close()
         self.server.stop()
         if self.cgroups is not None:
@@ -569,6 +657,7 @@ class Raylet:
             return
         prev_state = w.state
         w.state = "DEAD"
+        w.close_client()
         logger.warning("worker %s dead (%s): %s", w.worker_id.hex()[:8], prev_state, reason)
         if w.lease_id is not None:
             self._free_lease(w)
@@ -588,6 +677,9 @@ class Raylet:
         if self.cgroups is not None:
             self.cgroups.remove_worker_cgroup(w.worker_id.hex())
         self._try_grant_pending()
+        # a dead worker may have been the pool's warm capacity (actor
+        # churn kills one worker per actor): refill in the background
+        self._replenish_pool()
 
     def _kill_worker_proc(self, w: WorkerHandle):
         if w.state != "DEAD":
@@ -602,6 +694,7 @@ class Raylet:
             else:
                 self._free_worker_resources(w)
         w.state = "DEAD"
+        w.close_client()
         self._workers.pop(w.worker_id, None)
         if w.alive():
             w.terminate()
@@ -630,6 +723,10 @@ class Raylet:
             env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
                                  if env.get("PYTHONPATH") else pkg_root)
         env["RT_WORKER_ID"] = worker_id.hex()
+        # spawn timestamp (CLOCK_MONOTONIC is machine-wide): worker_main
+        # logs fork→entry latency against it — the part of the supply
+        # path that lives outside the worker's own boot trace
+        env["RT_SPAWN_T"] = repr(time.monotonic())
         env["RT_RAYLET_ADDR"] = f"{self.server.address[0]}:{self.server.address[1]}"
         env["RT_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
         env["RT_NODE_ID"] = self.node_id.hex()
@@ -672,12 +769,14 @@ class Raylet:
         logger.debug("forked worker %s (pid %s)", worker_id.hex()[:8], proc.pid)
         return w
 
-    async def h_register_worker(self, worker_id: bytes, address):
+    async def h_register_worker(self, worker_id: bytes, address,
+                                fast_port: Optional[int] = None):
         w = self._workers.get(WorkerID(worker_id))
         if w is None:
             # worker from a previous life / unknown: tell it to exit
             return {"ok": False}
         w.address = tuple(address)
+        w.fast_port = fast_port
         if w.state == "STARTING":
             w.state = "IDLE"
             w.idle_since = time.monotonic()
@@ -689,16 +788,39 @@ class Raylet:
     async def _pop_worker(self, timeout: float = None, ctx=None) -> Optional[WorkerHandle]:
         """Get an idle registered worker IN THE SAME runtime env (pools are
         keyed by env hash, reference: worker_pool.h), forking if needed.
-        ``maximum_startup_concurrency`` caps forks NODE-WIDE, across envs."""
+        ``maximum_startup_concurrency`` caps forks NODE-WIDE, across envs.
+
+        Envs that differ from the default only by env_vars/cwd ADOPT a
+        warm default-env worker via the configure_worker handshake
+        instead of forking; envs needing fork-time state (staged
+        PYTHONPATH trees: pip/py_modules/working_dir) are ineligible and
+        keep the fork path."""
         timeout = timeout or GLOBAL_CONFIG.get("worker_register_timeout_s")
         env_key = ctx.env_key if ctx is not None else None
         deadline = time.monotonic() + timeout
+        missed = False
         while True:
             for w in self._workers.values():
                 if (w.state == "IDLE" and w.env_key == env_key
                         and w.alive()):
                     w.state = "LEASED"
+                    if not missed:
+                        self._m_pool_hits.inc()
+                    if env_key is None:
+                        # consumed a warm default-env worker: refill in
+                        # the background so the next pop finds one too
+                        self._replenish_pool()
                     return w
+            if env_key is not None and ctx is not None \
+                    and self._adoptable(ctx):
+                w = await self._adopt_pool_worker(ctx)
+                if w is not None:
+                    if not missed:
+                        self._m_pool_hits.inc()
+                    return w
+            if not missed:
+                missed = True
+                self._m_pool_misses.inc()
             starting_all = [w for w in self._workers.values()
                             if w.state == "STARTING"]
             if len(starting_all) < GLOBAL_CONFIG.get("maximum_startup_concurrency"):
@@ -727,6 +849,54 @@ class Raylet:
                 w.state = "LEASED"
                 return w
             # someone else took it, it's a different env, or it died — retry
+
+    # env_vars that only take effect at interpreter boot/import time:
+    # applying them post-adoption would silently do nothing (fork applies
+    # them pre-exec), so envs carrying any of these must really fork.
+    _BOOT_ENV_KEYS = frozenset({
+        "PYTHONPATH", "PYTHONHOME", "PYTHONSTARTUP", "LD_PRELOAD",
+        "LD_LIBRARY_PATH", "JAX_PLATFORMS", "XLA_FLAGS", "TPU_VISIBLE_CHIPS",
+    })
+
+    def _adoptable(self, ctx) -> bool:
+        """True when a warm default-env worker can be reassigned to this
+        env with post-boot fixups only: no staged PYTHONPATH trees and no
+        boot-time env_vars (RT_* flags may be read once at worker boot,
+        so they need a fork too)."""
+        if ctx.pythonpath_prepend:
+            return False
+        return not any(k in self._BOOT_ENV_KEYS or k.startswith("RT_")
+                       for k in ctx.env_vars)
+
+    async def _adopt_pool_worker(self, ctx) -> Optional[WorkerHandle]:
+        """Reassign a warm default-env worker to an env_vars/cwd-only
+        runtime env: one configure_worker RPC instead of a fork. The
+        worker keeps its new env_key for the rest of its life (its
+        process env HAS been mutated), so later pops pool it under that
+        env. A half-configured worker (RPC failed) is killed, never
+        reused."""
+        for w in list(self._workers.values()):
+            if not (w.state == "IDLE" and w.env_key is None
+                    and w.address is not None and w.alive()):
+                continue
+            w.state = "LEASED"  # claim before awaiting
+            try:
+                await w.client().call_async("configure_worker",
+                                            env_vars=ctx.env_vars,
+                                            cwd=ctx.cwd, timeout=10.0)
+            except Exception:  # noqa: BLE001 — env state unknown: discard
+                logger.warning("pool-worker adoption failed; forking",
+                               exc_info=True)
+                self._kill_worker_proc(w)
+                return None
+            w.env_key = ctx.env_key
+            self.runtime_env_agent.acquire(ctx.env_key)
+            self._m_pool_adoptions.inc()
+            self._replenish_pool()  # consumed a default-env warm worker
+            logger.debug("adopted pool worker %s into env %s",
+                         w.worker_id.hex()[:8], ctx.env_key[:8])
+            return w
+        return None
 
     # ------------------------------------------------------------- scheduling
     def _local_available(self, request: ResourceRequest,
@@ -841,15 +1011,20 @@ class Raylet:
         tpu_chips = (assignment or {}).get(TPU)
         if w.address is not None and tpu_chips is not None:
             try:
-                c = RetryableRpcClient(w.address, deadline_s=5.0)
-                await c.call_async("set_visible_devices", tpu_chips=tpu_chips)
-                c.close()
+                # bounded: a wedged worker must not stall the lease grant
+                # for the cached client's full 30s retry window
+                await w.client().call_async("set_visible_devices",
+                                            tpu_chips=tpu_chips,
+                                            timeout=5.0)
             except Exception:  # noqa: BLE001
                 pass
         return {
             "status": "granted",
             "worker_id": w.worker_id.binary(),
             "worker_address": w.address,
+            # the worker's native dispatch port: the lease holder opens
+            # its fast task channel against it (submitter.py)
+            "worker_fast_port": w.fast_port,
             "node_id": self.node_id.binary(),
         }
 
@@ -996,12 +1171,11 @@ class Raylet:
         self._replenish_pool()
         tpu_chips = (assignment or {}).get(TPU)
         try:
-            c = RetryableRpcClient(w.address, deadline_s=30.0)
+            c = w.client()
             if tpu_chips is not None:
                 await c.call_async("set_visible_devices", tpu_chips=tpu_chips)
             await c.call_async("create_actor", creation_spec=creation_spec,
                                node_id=self.node_id.binary(), timeout=120.0)
-            c.close()
         except Exception as e:  # noqa: BLE001
             logger.warning("create_actor push failed: %s", e)
             await self._on_worker_dead(w, f"create_actor failed: {e}")
@@ -1091,6 +1265,17 @@ class Raylet:
             "resources": self.resources.snapshot(),
             "oom_kills": self._oom_kills,
             "io_stats": dict(self._io.stats),
+            "worker_pool": {
+                "warm": sum(1 for w in self._workers.values()
+                            if w.env_key is None and w.state in
+                            ("IDLE", "STARTING")),
+                "hits": sum(self._m_pool_hits.snapshot()
+                            ["values"].values()),
+                "misses": sum(self._m_pool_misses.snapshot()
+                              ["values"].values()),
+                "adoptions": sum(self._m_pool_adoptions.snapshot()
+                                 ["values"].values()),
+            },
         }
 
 

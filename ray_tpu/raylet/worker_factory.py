@@ -73,6 +73,9 @@ def _reap_loop():
 def _child_main(req: dict, listener: socket.socket,
                 conn: socket.socket) -> None:
     """Runs in the forked child: become a clean worker process."""
+    import time as _time
+
+    t_fork = _time.monotonic()
     listener.close()
     conn.close()
     os.setsid()  # own process group: raylet signals don't hit the factory
@@ -83,6 +86,7 @@ def _child_main(req: dict, listener: socket.socket,
     os.close(log_fd)
     os.environ.clear()
     os.environ.update(req["env"])
+    os.environ["RT_CHILD_T"] = repr(t_fork)  # worker_main logs the split
     if req.get("cwd"):
         os.chdir(req["cwd"])
     # flag values cached in the warm parent may disagree with this
@@ -112,6 +116,21 @@ def main(sock_path: str) -> None:
     import ray_tpu.core_worker.worker_main  # noqa: F401
     import ray_tpu.rpc.rpc  # noqa: F401
 
+    # Pre-dlopen the native extensions: children inherit the mappings,
+    # cutting ~10-15 ms of per-worker boot (fastloop server + shm arena
+    # open both dlopen these on first use). Load only — no sockets, no
+    # arena handles, no threads from these libs cross the fork.
+    from ray_tpu.rpc.native import load_fastloop, load_fastspec
+
+    load_fastloop()
+    load_fastspec()
+    try:
+        from ray_tpu.object_store import shm as _shm
+
+        _shm._load()
+    except Exception:  # noqa: BLE001 — workers just dlopen themselves
+        pass
+
     threading.Thread(target=_reap_loop, daemon=True,
                      name="factory-reap").start()
     if os.path.exists(sock_path):
@@ -119,21 +138,29 @@ def main(sock_path: str) -> None:
     listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     listener.bind(sock_path)
     listener.listen(64)
+    import time as _time
+
     while True:
         try:
             conn, _ = listener.accept()
         except OSError:
             return
         try:
+            t_acc = _time.monotonic()
             req = _recv_msg(conn)
             if req is None:
                 continue
             if req.get("op") == "shutdown":
                 _send_msg(conn, {"ok": True})
                 return
+            t_req = _time.monotonic()
             pid = os.fork()
             if pid == 0:
                 _child_main(req, listener, conn)  # never returns
+            if os.environ.get("RT_BOOT_TRACE"):
+                print(f"factory: recv {1e3*(t_req-t_acc):.1f}ms fork "
+                      f"{1e3*(_time.monotonic()-t_req):.1f}ms pid {pid}",
+                      flush=True)
             _send_msg(conn, {"pid": pid})
         except Exception as e:  # noqa: BLE001 — keep serving
             try:
@@ -147,6 +174,48 @@ def main(sock_path: str) -> None:
                 pass
 
 
+class MultiFactoryClient:
+    """Round-robin over several forkserver processes. fork(2) copies the
+    parent's page tables under mm-wide locks — ONE warm factory tops out
+    at ~70-80 forks/s on this class of host, which caps sustained actor
+    creation (every actor consumes a worker). K independent factories
+    fork in parallel from separate address spaces."""
+
+    def __init__(self, clients):
+        self._clients = list(clients)
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def spawn(self, env: dict, log_path: str, cwd: str,
+              timeout: float = 10.0) -> int:
+        with self._lock:
+            i = self._i
+            self._i += 1
+        last: Exception = RuntimeError("no factory processes")
+        for k in range(len(self._clients)):
+            c = self._clients[(i + k) % len(self._clients)]
+            try:
+                return c.spawn(env, log_path, cwd, timeout)
+            except FactoryUnavailable as e:
+                # connect-phase failure: this factory never saw the
+                # request, safe to try the next one
+                last = e
+            # anything past connect (send/recv timeout etc.) may have
+            # ALREADY forked the child — retrying on another factory
+            # would double-spawn the same RT_WORKER_ID; propagate
+        raise last
+
+    def shutdown(self):
+        for c in self._clients:
+            c.shutdown()
+
+
+class FactoryUnavailable(OSError):
+    """The factory socket could not be reached (connect-phase failure):
+    the request never arrived, so failing over to another factory cannot
+    double-spawn."""
+
+
 class FactoryClient:
     """Raylet-side handle: spawn workers through the factory socket."""
 
@@ -158,7 +227,10 @@ class FactoryClient:
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         conn.settimeout(timeout)
         try:
-            conn.connect(self._path)
+            try:
+                conn.connect(self._path)
+            except OSError as e:
+                raise FactoryUnavailable(str(e)) from e
             _send_msg(conn, {"env": env, "log_path": log_path, "cwd": cwd})
             reply = _recv_msg(conn)
         finally:

@@ -17,9 +17,22 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_fastspec.so")
 _SRC = os.path.join(_DIR, "fastspec.c")
+_HDR = os.path.join(_DIR, "fastframe.h")  # shared wire layer (both .so's)
 _lock = threading.Lock()
 _mod = None
 _FAILED = object()  # build attempted and lost — don't re-run gcc per call
+
+
+def _src_mtime(src: str) -> float:
+    """Staleness anchor for a native source: the newest of the .c file and
+    the shared fastframe.h it includes — editing the header alone must
+    trigger a rebuild or tests measure the wrong code."""
+    m = os.path.getmtime(src)
+    try:
+        m = max(m, os.path.getmtime(_HDR))
+    except OSError:
+        pass
+    return m
 
 
 def load_fastspec():
@@ -34,7 +47,7 @@ def load_fastspec():
             return None if _mod is _FAILED else _mod
         try:
             if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                    or os.path.getmtime(_SO) < _src_mtime(_SRC)):
                 include = sysconfig.get_paths()["include"]
                 tmp = _SO + f".tmp.{os.getpid()}"
                 subprocess.run(
@@ -69,7 +82,7 @@ def load_fastloop():
             return None if _fl_mod is _FAILED else _fl_mod
         try:
             if (not os.path.exists(_FL_SO)
-                    or os.path.getmtime(_FL_SO) < os.path.getmtime(_FL_SRC)):
+                    or os.path.getmtime(_FL_SO) < _src_mtime(_FL_SRC)):
                 include = sysconfig.get_paths()["include"]
                 tmp = _FL_SO + f".tmp.{os.getpid()}"
                 subprocess.run(
@@ -87,18 +100,9 @@ def load_fastloop():
         return None if _fl_mod is _FAILED else _fl_mod
 
 
-def unpack_fastspec(blob: bytes):
-    """Decode a fastspec buffer with the C codec when available, else a
-    pure-Python reader — a receiver without a compiler must still accept
-    fast-path pushes from nodes that have one."""
-    mod = load_fastspec()
-    if mod is not None:
-        return mod.unpack(blob)
-    if len(blob) < 21 or blob[:4] != b"RTFS" or blob[4] != 1:
-        raise ValueError("not a fastspec v1 buffer")
-    seq, num_returns, port = struct.unpack_from("<QII", blob, 5)
-    blobs, off = [], 21
-    for _ in range(7):
+def _read_blobs(blob: bytes, off: int, n: int):
+    blobs = []
+    for _ in range(n):
         if off + 4 > len(blob):
             raise ValueError("truncated fastspec buffer")
         (ln,) = struct.unpack_from("<I", blob, off)
@@ -107,4 +111,31 @@ def unpack_fastspec(blob: bytes):
             raise ValueError("truncated fastspec buffer")
         blobs.append(blob[off:off + ln])
         off += ln
+    return blobs
+
+
+def unpack_fastspec(blob: bytes):
+    """Decode a v1 (actor-call) fastspec buffer with the C codec when
+    available, else a pure-Python reader — a receiver without a compiler
+    must still accept fast-path pushes from nodes that have one."""
+    mod = load_fastspec()
+    if mod is not None:
+        return mod.unpack(blob)
+    if len(blob) < 21 or blob[:4] != b"RTFS" or blob[4] != 1:
+        raise ValueError("not a fastspec v1 buffer")
+    seq, num_returns, port = struct.unpack_from("<QII", blob, 5)
+    blobs = _read_blobs(blob, 21, 7)
     return (*blobs, seq, num_returns, port)
+
+
+def unpack_fasttask(blob: bytes):
+    """Decode a v2 (normal-task) fastspec buffer, C codec or pure-Python
+    fallback (same compiler-less receiver contract as unpack_fastspec)."""
+    mod = load_fastspec()
+    if mod is not None:
+        return mod.unpack_task(blob)
+    if len(blob) < 13 or blob[:4] != b"RTFS" or blob[4] != 2:
+        raise ValueError("not a fastspec v2 buffer")
+    num_returns, port = struct.unpack_from("<II", blob, 5)
+    blobs = _read_blobs(blob, 13, 8)
+    return (*blobs, num_returns, port)

@@ -1,4 +1,5 @@
-/* fastloop.c — C dispatch loop for the actor-call hot path.
+/* fastloop.c — C dispatch loop for the actor-call and normal-task hot
+ * paths.
  *
  * SURVEY §2.5 native-core mandate: the reference's per-call path is C++
  * end-to-end (src/ray/core_worker/transport/normal_task_submitter.cc
@@ -41,61 +42,15 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
-#define HDR_SIZE 12u
-#define MAX_FRAME (1u << 30) /* 1 GiB sanity cap */
+/* Wire codec + robust writer live in fastframe.h (pure C, no Python)
+ * so run_tsan.sh can drive them under the sanitizers. */
+#include "fastframe.h"
 
-static void put_u32(unsigned char *p, uint32_t v) {
-    p[0] = v & 0xff; p[1] = (v >> 8) & 0xff;
-    p[2] = (v >> 16) & 0xff; p[3] = (v >> 24) & 0xff;
-}
-static uint32_t get_u32(const unsigned char *p) {
-    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
-           ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
-}
-static void put_u64(unsigned char *p, uint64_t v) {
-    put_u32(p, (uint32_t)(v & 0xffffffffu));
-    put_u32(p + 4, (uint32_t)(v >> 32));
-}
-static uint64_t get_u64(const unsigned char *p) {
-    return (uint64_t)get_u32(p) | ((uint64_t)get_u32(p + 4) << 32);
-}
-
-/* Robust write of a full frame on a (possibly non-blocking) fd; the
- * caller must hold the connection's write mutex and NOT the GIL. */
-static int write_frame_fd(int fd, uint64_t req_id, const char *payload,
-                          size_t len) {
-    unsigned char hdr[HDR_SIZE];
-    put_u32(hdr, (uint32_t)len);
-    put_u64(hdr + 4, req_id);
-    struct iovec iov[2] = {
-        {.iov_base = hdr, .iov_len = HDR_SIZE},
-        {.iov_base = (void *)payload, .iov_len = len},
-    };
-    size_t total = HDR_SIZE + len, sent = 0;
-    while (sent < total) {
-        ssize_t n = writev(fd, iov, iov[1].iov_len ? 2 : 1);
-        if (n < 0) {
-            if (errno == EINTR) continue;
-            if (errno == EAGAIN || errno == EWOULDBLOCK) {
-                struct pollfd p = {.fd = fd, .events = POLLOUT};
-                if (poll(&p, 1, 30000) <= 0) return -1;
-                continue;
-            }
-            return -1;
-        }
-        sent += (size_t)n;
-        size_t left = (size_t)n;
-        if (iov[0].iov_len) {
-            size_t take = left < iov[0].iov_len ? left : iov[0].iov_len;
-            iov[0].iov_base = (char *)iov[0].iov_base + take;
-            iov[0].iov_len -= take;
-            left -= take;
-        }
-        iov[1].iov_base = (char *)iov[1].iov_base + left;
-        iov[1].iov_len -= left;
-    }
-    return 0;
-}
+#define HDR_SIZE FF_HDR_SIZE
+#define MAX_FRAME FF_MAX_FRAME
+#define write_frame_fd ff_write_frame_fd
+#define get_u32 ff_get_u32
+#define get_u64 ff_get_u64
 
 /* ------------------------------------------------------------------ */
 /* Server                                                             */
@@ -153,16 +108,18 @@ static void server_drop_conn(ServerObject *self, Conn *c) {
 static int server_dispatch(ServerObject *self, Conn *c) {
     size_t off = 0;
     int rc = 0;
-    while (c->len - off >= HDR_SIZE) {
-        uint32_t plen = get_u32(c->buf + off);
-        if (plen > MAX_FRAME) { rc = -1; break; }
-        if (c->len - off < HDR_SIZE + (size_t)plen) break;
-        uint64_t req_id = get_u64(c->buf + off + 4);
+    for (;;) {
+        uint64_t req_id;
+        const unsigned char *payload;
+        uint32_t plen;
+        int fr = ff_next_frame(c->buf, c->len, &off, &req_id, &payload,
+                               &plen);
+        if (fr <= 0) { if (fr < 0) rc = -1; break; }
         PyGILState_STATE g = PyGILState_Ensure();
         PyObject *res = PyObject_CallFunction(
             self->handler, "KKy#", (unsigned long long)c->id,
             (unsigned long long)req_id,
-            (const char *)(c->buf + off + HDR_SIZE), (Py_ssize_t)plen);
+            (const char *)payload, (Py_ssize_t)plen);
         if (res == NULL) {
             /* Handler bug: the Python side wraps user errors into reply
              * payloads, so an escape here is unexpected.  Surface it and
@@ -198,7 +155,6 @@ static int server_dispatch(ServerObject *self, Conn *c) {
             PyGILState_Release(g);
             if (rc < 0) break;
         }
-        off += HDR_SIZE + plen;
     }
     if (off > 0) {
         memmove(c->buf, c->buf + off, c->len - off);
@@ -485,20 +441,21 @@ static void *client_main(void *arg) {
         len += (size_t)n;
         size_t off = 0;
         int bad = 0;
-        while (len - off >= HDR_SIZE) {
-            uint32_t plen = get_u32(buf + off);
-            if (plen > MAX_FRAME) { bad = 1; break; }
-            if (len - off < HDR_SIZE + (size_t)plen) break;
-            uint64_t req_id = get_u64(buf + off + 4);
+        for (;;) {
+            uint64_t req_id;
+            const unsigned char *payload;
+            uint32_t plen;
+            int fr = ff_next_frame(buf, len, &off, &req_id, &payload,
+                                   &plen);
+            if (fr <= 0) { bad = (fr < 0); break; }
             PyGILState_STATE g = PyGILState_Ensure();
             PyObject *r = PyObject_CallFunction(
                 self->on_reply, "Ky#", (unsigned long long)req_id,
-                (const char *)(buf + off + HDR_SIZE), (Py_ssize_t)plen);
+                (const char *)payload, (Py_ssize_t)plen);
             if (r == NULL)
                 PyErr_WriteUnraisable(self->on_reply);
             Py_XDECREF(r);
             PyGILState_Release(g);
-            off += HDR_SIZE + plen;
         }
         if (bad) break;
         if (off > 0) {

@@ -141,7 +141,10 @@ RPC_SCHEMAS: Dict[str, Message] = {
     "return_worker": _m("return_worker", req("lease_id", bytes),
                         opt("disconnect", bool)),
     "register_worker": _m("register_worker", req("worker_id", bytes),
-                          req("address", (tuple, list))),
+                          req("address", (tuple, list)),
+                          opt("fast_port", int)),
+    "configure_worker": _m("configure_worker", opt("env_vars", dict),
+                           opt("cwd", str)),
     "start_actor": _m("start_actor", req("creation_spec", bytes)),
     "kill_worker": _m("kill_worker", req("worker_id", bytes)),
     # ---- GCS service (reference gcs_service.proto) ----

@@ -1,19 +1,35 @@
 #!/bin/bash
-# TSAN + ASAN runs for the concurrency-critical native shm code
-# (reference: .bazelrc build:tsan/build:asan CI configs, SURVEY.md §4.5).
+# TSAN + ASAN runs for the concurrency-critical native code
+# (reference: .bazelrc build:tsan/build:asan CI configs, SURVEY.md §4.5):
+# the shared-memory object store/channel, and the fastloop wire layer
+# (fastframe.h) that the actor-call AND lease-cached task-dispatch
+# channels ride — concurrent writers behind the connection mutex vs one
+# frame-parsing reader, exactly the production thread shape.
 set -e
 cd "$(dirname "$0")/.."
 
 SRC="cpp/test/tsan_shm.cc \
      ray_tpu/object_store/native/shm_store.cc \
      ray_tpu/object_store/native/shm_channel.cc"
+FF_SRC="cpp/test/tsan_fastframe.cc"
+FF_INC="-Iray_tpu/rpc/native"
 
-echo "== TSAN =="
+echo "== TSAN (shm) =="
 g++ -O1 -g -fsanitize=thread -std=c++17 -o /tmp/tsan_shm $SRC -lpthread -lrt
 TSAN_OPTIONS="halt_on_error=1" /tmp/tsan_shm
 
-echo "== ASAN =="
+echo "== TSAN (fastframe) =="
+g++ -O1 -g -fsanitize=thread -std=c++17 $FF_INC -o /tmp/tsan_fastframe \
+    $FF_SRC -lpthread
+TSAN_OPTIONS="halt_on_error=1" /tmp/tsan_fastframe
+
+echo "== ASAN (shm) =="
 g++ -O1 -g -fsanitize=address -std=c++17 -o /tmp/asan_shm $SRC -lpthread -lrt
 /tmp/asan_shm
+
+echo "== ASAN (fastframe) =="
+g++ -O1 -g -fsanitize=address -std=c++17 $FF_INC -o /tmp/asan_fastframe \
+    $FF_SRC -lpthread
+/tmp/asan_fastframe
 
 echo "sanitizer runs clean"

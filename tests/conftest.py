@@ -16,6 +16,14 @@ prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
 
+# One forkserver per raylet in tests: the production default (2) exists
+# for sustained actor churn — fork(2) parallelism — but every test
+# cluster init would pay a second warm-interpreter boot (~2 s CPU) for
+# pools it never stresses, and the suite runs hundreds of cluster
+# inits against a hard wall-clock budget. MultiFactoryClient logic is
+# identical at K=1.
+os.environ.setdefault("RT_worker_factory_procs", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -24,6 +32,13 @@ assert jax.default_backend() == "cpu", (
 assert jax.device_count() == 8
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly, e.g. the 500k queued-task envelope")
 
 
 @pytest.fixture(autouse=True, scope="session")
