@@ -100,11 +100,6 @@ def init(
             GLOBAL_CONFIG.initialize(system_config)
             GLOBAL_CONFIG.reset_cache()
         if address is None:
-            from ray_tpu.gcs.server import GcsServer
-            from ray_tpu.raylet.raylet import Raylet
-
-            gcs = GcsServer()
-            gcs.start()
             node_resources = dict(resources or {})
             node_labels = dict(labels or {})
             if num_cpus is not None:
@@ -131,16 +126,41 @@ def init(
                 if found["worker_id"] is not None:
                     node_labels.setdefault(
                         LABEL_SLICE_WORKER_INDEX, str(found["worker_id"]))
-            raylet = Raylet(gcs.address, resources=node_resources,
-                            labels=node_labels)
-            # before start(): the node's own ALIVE registration must land
-            # in the export log too
-            gcs.attach_export_logger(raylet.session_dir)
-            raylet.start()
-            _head = {"gcs": gcs, "raylet": raylet}
-            gcs_address = gcs.address
-            raylet_address = raylet.server.address
-            node_id = raylet.node_id
+            if GLOBAL_CONFIG.get("control_plane_procs"):
+                # Multi-process deployment shape (control_plane.py): the
+                # GCS server and the raylet each get their OWN process —
+                # own loop, own GIL — and the driver talks to them over
+                # the ordinary rpc layer. Control-plane scheduling no
+                # longer time-slices against driver submit/reply work.
+                from ray_tpu.control_plane import ProcHead
+
+                head = ProcHead(
+                    resources=node_resources, labels=node_labels,
+                    system_config=GLOBAL_CONFIG.system_config_json())
+                _head = {"proc_head": head,
+                         "session_dir": head.session_dir,
+                         "node_id": head.node_id}
+                gcs_address = head.gcs_address
+                raylet_address = head.raylet_address
+                node_id = head.node_id
+            else:
+                from ray_tpu.gcs.server import GcsServer
+                from ray_tpu.raylet.raylet import Raylet
+
+                gcs = GcsServer()
+                gcs.start()
+                raylet = Raylet(gcs.address, resources=node_resources,
+                                labels=node_labels)
+                # before start(): the node's own ALIVE registration must
+                # land in the export log too
+                gcs.attach_export_logger(raylet.session_dir)
+                raylet.start()
+                _head = {"gcs": gcs, "raylet": raylet,
+                         "session_dir": raylet.session_dir,
+                         "node_id": raylet.node_id}
+                gcs_address = gcs.address
+                raylet_address = raylet.server.address
+                node_id = raylet.node_id
         else:
             host, _, port = address.partition(":")
             gcs_address = (host, int(port))
@@ -155,26 +175,46 @@ def init(
             raylet_address = tuple(alive[0]["address"])
             node_id = NodeID(alive[0]["node_id"])
 
-        cw = CoreWorker(
-            mode=MODE_DRIVER,
-            gcs_address=gcs_address,
-            raylet_address=raylet_address,
-            node_id=node_id,
-        )
-        cw.job_runtime_env = dict(runtime_env) if runtime_env else None
-        if GLOBAL_CONFIG.get("log_to_driver"):
-            _subscribe_worker_logs(cw)
-        atexit.register(_shutdown_atexit)
-        out = {"gcs_address": gcs_address, "node_id": node_id.hex()}
-        if dashboard and _head is not None:
-            from ray_tpu.dashboard import Dashboard
+        try:
+            cw = CoreWorker(
+                mode=MODE_DRIVER,
+                gcs_address=gcs_address,
+                raylet_address=raylet_address,
+                node_id=node_id,
+            )
+            cw.job_runtime_env = dict(runtime_env) if runtime_env else None
+            if _head is not None and _head.get("proc_head") is not None:
+                # supervisor → core worker: a dead GCS/raylet process
+                # fails new control-plane work with a typed error instead
+                # of hanging
+                _head["proc_head"].set_on_death(cw.fail_control_plane)
+            if GLOBAL_CONFIG.get("log_to_driver"):
+                _subscribe_worker_logs(cw)
+            atexit.register(_shutdown_atexit)
+            out = {"gcs_address": gcs_address, "node_id": node_id.hex()}
+            if dashboard and _head is not None:
+                from ray_tpu.dashboard import Dashboard
 
-            dash = Dashboard(gcs_address, _head["raylet"].session_dir,
-                             port=dashboard_port)
-            dash.start()
-            _head["dashboard"] = dash
-            out["dashboard_url"] = dash.url
-        return out
+                dash = Dashboard(gcs_address, _head["session_dir"],
+                                 port=dashboard_port)
+                dash.start()
+                _head["dashboard"] = dash
+                out["dashboard_url"] = dash.url
+            return out
+        except BaseException:
+            # a failure after the head came up must not leak it — in the
+            # multi-process shape that would orphan two OS daemons (and
+            # the raylet's workers) with no supervisor
+            if _head is not None:
+                if _head.get("proc_head") is not None:
+                    _head["proc_head"].stop()
+                else:
+                    _head["raylet"].stop()
+                    _head["gcs"].stop()
+                _head = None
+            if CoreWorker._current is not None:
+                CoreWorker._current.shutdown()
+            raise
 
 
 def _subscribe_worker_logs(cw) -> None:
@@ -218,27 +258,34 @@ def shutdown() -> None:
             return
         cw = CoreWorker._current
         if cw is not None:
-            if _head is not None:
+            if _head is not None and getattr(cw, "_control_plane_error",
+                                             None) is None:
                 # before cw.shutdown(): the report snapshots cluster
-                # shape through the still-live core worker
+                # shape through the still-live core worker (skipped when
+                # the control plane is already dead — nothing to snapshot)
                 from ray_tpu.util import usage
 
-                usage.write_report(_head["raylet"].session_dir)
-            try:
-                cw.gcs.finish_job(cw.job_id)
-            except Exception:  # noqa: BLE001
-                pass
+                usage.write_report(_head["session_dir"])
+            if getattr(cw, "_control_plane_error", None) is None:
+                try:
+                    cw.gcs.finish_job(cw.job_id)
+                except Exception:  # noqa: BLE001
+                    pass
             cw.shutdown()
         if _head is not None:
-            node_id = _head["raylet"].node_id
+            node_id = _head["node_id"]
             if _head.get("dashboard") is not None:
                 _head["dashboard"].stop()
-            _head["raylet"].stop()
-            _head["gcs"].stop()
-            _head = None
-            from ray_tpu.object_store.shm import unlink as shm_unlink
+            if _head.get("proc_head") is not None:
+                _head["proc_head"].stop()  # raylet first, then GCS + shm
+            else:
+                _head["raylet"].stop()
+                _head["gcs"].stop()
+                from ray_tpu.object_store.shm import node_shm_name
+                from ray_tpu.object_store.shm import unlink as shm_unlink
 
-            shm_unlink(f"/rtshm_{node_id.hex()[:12]}")
+                shm_unlink(node_shm_name(node_id))
+            _head = None
 
 
 def is_initialized() -> bool:

@@ -1,13 +1,20 @@
 """Multi-node test cluster on one machine (reference:
 python/ray/cluster_utils.py:135 Cluster / add_node:202 / remove_node:286).
 
-Runs one GCS plus N raylets in the current process (each raylet still forks
-real worker subprocesses), which is how the reference tests multi-node
-behavior on localhost.
+Two shapes, mirroring ``ray_tpu.init``'s deployment shapes:
+
+- default: one GCS plus N raylets in the current process (each raylet
+  still forks real worker subprocesses), which is how the reference tests
+  multi-node behavior on localhost.
+- ``control_plane_procs=True``: the GCS and every raylet run as dedicated
+  OS processes (ray_tpu/control_plane.py) — real process boundaries for
+  crash/failover tests, and the deployment shape the round-9 perf work
+  benchmarks.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -18,16 +25,39 @@ from ray_tpu.raylet.raylet import Raylet
 class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None,
-                 persist_dir: Optional[str] = None):
+                 persist_dir: Optional[str] = None,
+                 control_plane_procs: bool = False):
         self.persist_dir = persist_dir
-        self.gcs = GcsServer(persist_dir=persist_dir)
-        self.gcs.start()
-        self.raylets: List[Raylet] = []
+        self.control_plane_procs = control_plane_procs
+        self.raylets: List[Raylet] = []   # in-process shape
+        self.raylet_procs: List = []      # multi-process shape
+        self._raylet_infos: List[dict] = []
+        if control_plane_procs:
+            from ray_tpu.control_plane import launch_gcs
+
+            self.session_dir = (
+                f"/tmp/rt/cluster_{os.getpid()}_{id(self) & 0xffffff:x}")
+            self.gcs = None
+            self.gcs_proc, self._gcs_address = launch_gcs(
+                self.session_dir, persist_dir=persist_dir)
+        else:
+            self.gcs = GcsServer(persist_dir=persist_dir)
+            self.gcs.start()
+            self.gcs_proc = None
+            self._gcs_address = self.gcs.address
         if initialize_head:
             self.add_node(**(head_node_args or {}))
 
+    @property
+    def gcs_address(self):
+        return self._gcs_address
+
     def kill_gcs(self):
         """Simulate a GCS crash: stop the server, leave raylets running."""
+        if self.control_plane_procs:
+            self.gcs_proc.kill()
+            self.gcs_proc.proc.wait(timeout=10)
+            return
         self.gcs.server.stop()
         self.gcs._stopped = True
         if self.gcs.storage is not None:
@@ -37,39 +67,68 @@ class Cluster:
     def restart_gcs(self):
         """Bring the GCS back at the SAME address, recovering state from the
         persist log; surviving raylets re-register via their report loop."""
-        addr = self.gcs.address
+        addr = self._gcs_address
+        if self.control_plane_procs:
+            from ray_tpu.control_plane import launch_gcs
+
+            self.gcs_proc, self._gcs_address = launch_gcs(
+                self.session_dir, persist_dir=self.persist_dir,
+                host=addr[0], port=addr[1])
+            return
         self.gcs = GcsServer(host=addr[0], port=addr[1],
                              persist_dir=self.persist_dir)
         self.gcs.start()
 
     @property
     def address(self) -> str:
-        return f"{self.gcs.address[0]}:{self.gcs.address[1]}"
+        return f"{self._gcs_address[0]}:{self._gcs_address[1]}"
 
     def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
-                 labels: Optional[Dict[str, str]] = None) -> Raylet:
+                 labels: Optional[Dict[str, str]] = None):
         node_resources = dict(resources or {})
         node_resources.setdefault("CPU", num_cpus)
         if num_tpus:
             node_resources["TPU"] = num_tpus
-        raylet = Raylet(self.gcs.address, resources=node_resources, labels=labels)
+        if self.control_plane_procs:
+            from ray_tpu.control_plane import launch_raylet
+
+            proc, info = launch_raylet(
+                self._gcs_address,
+                os.path.join(self.session_dir,
+                             f"node{len(self.raylet_procs)}"),
+                resources=node_resources, labels=labels)
+            self.raylet_procs.append(proc)
+            self._raylet_infos.append(info)
+            return proc
+        raylet = Raylet(self._gcs_address, resources=node_resources,
+                        labels=labels)
         raylet.start()
         self.raylets.append(raylet)
         return raylet
 
-    def remove_node(self, raylet: Raylet, graceful: bool = False):
-        """Kill a node (ungraceful = simulate crash: workers die, GCS finds out
-        via health checks)."""
-        raylet.stop()
-        self.raylets.remove(raylet)
-        if graceful:
+    def remove_node(self, raylet, graceful: bool = False):
+        """Kill a node (ungraceful = simulate crash: workers die, GCS finds
+        out via health checks)."""
+        if self.control_plane_procs:
+            idx = self.raylet_procs.index(raylet)
+            info = self._raylet_infos.pop(idx)
+            self.raylet_procs.remove(raylet)
+            if graceful:
+                raylet.stop()
+            else:
+                raylet.kill()
+            node_id_bin = bytes.fromhex(info["node_id_hex"])
+        else:
+            raylet.stop()
+            self.raylets.remove(raylet)
+            node_id_bin = raylet.node_id.binary() if graceful else None
+        if graceful and node_id_bin is not None:
             try:
-                self.gcs.server and None
                 from ray_tpu.gcs.client import GcsClient
 
-                c = GcsClient(self.gcs.address)
-                c.call("unregister_node", node_id=raylet.node_id.binary())
+                c = GcsClient(self._gcs_address)
+                c.call("unregister_node", node_id=node_id_bin)
                 c.close()
             except Exception:  # noqa: BLE001
                 pass
@@ -77,8 +136,10 @@ class Cluster:
     def wait_for_nodes(self, count: Optional[int] = None, timeout: float = 30.0):
         from ray_tpu.gcs.client import GcsClient
 
-        want = count if count is not None else len(self.raylets)
-        c = GcsClient(self.gcs.address)
+        want = count if count is not None else (
+            len(self.raylet_procs) if self.control_plane_procs
+            else len(self.raylets))
+        c = GcsClient(self._gcs_address)
         deadline = time.monotonic() + timeout
         try:
             while time.monotonic() < deadline:
@@ -91,6 +152,14 @@ class Cluster:
             c.close()
 
     def shutdown(self):
+        if self.control_plane_procs:
+            for p in list(self.raylet_procs):
+                p.stop()
+            self.raylet_procs.clear()
+            self._raylet_infos.clear()
+            if self.gcs_proc is not None:
+                self.gcs_proc.stop()
+            return
         for r in list(self.raylets):
             r.stop()
         self.raylets.clear()

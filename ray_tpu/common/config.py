@@ -145,6 +145,34 @@ _D("fast_dispatch_window", int, 4,
    " then briefly overlap on one leased worker); 1 = strict one-task-per-"
    "lease pacing")
 
+# --- deployment shape --------------------------------------------------------
+_D("control_plane_procs", bool, False,
+   "multi-process deployment shape: ray_tpu.init() launches the GCS server"
+   " and the raylet each in their OWN OS process (own interpreter, own"
+   " asyncio loop, own GIL) instead of on the driver's shared IO loop."
+   " Removes control-plane/driver loop contention — actor-creation and"
+   " lease scheduling no longer time-slice against driver submit/reply"
+   " work — at the cost of real RPC hops for every crossing. Off ="
+   " the historical in-process head (driver+GCS+raylet share one loop)")
+_D("control_plane_ready_timeout_s", float, 40.0,
+   "how long init() waits for a spawned GCS/raylet process to print its"
+   " READY line before declaring the launch failed")
+_D("control_plane_poll_ms", int, 200,
+   "supervisor poll interval for detecting GCS/raylet process death in"
+   " the multi-process shape")
+
+_D("lease_grant_coalescing", bool, False,
+   "burst lease requests ride ONE request_worker_leases RPC (up to"
+   " lease_request_batch_size grants, raylet-side fairness cap of half"
+   " the currently-fitting copies) instead of one round trip per lease."
+   " Off by default: queue depth at submit time OVERSTATES lease demand"
+   " under lease retention (most queued tasks drain through reused"
+   " leases), so eager multi-grant forks workers the lazy single-lease"
+   " ramp never needs — measured 16-60% SLOWER on the multi-client"
+   " fan-out rows with it on (PERF_PLAN round 9); the RPC exists for"
+   " deployments whose shapes genuinely need N distinct leases at once"
+   " (wide gang fan-outs with no retention reuse)")
+
 # --- scheduling --------------------------------------------------------------
 _D("scheduler_top_k_fraction", float, 0.2, "hybrid policy: top-k fraction of nodes")
 _D("scheduler_top_k_absolute", int, 1, "hybrid policy: min top-k")
@@ -171,6 +199,10 @@ _D("worker_factory_procs", int, 2,
    " (~12 ms/fork of a warm interpreter), so K factories raise the"
    " sustained worker-supply — and therefore actor-creation — ceiling")
 _D("worker_register_timeout_s", int, 60, "")
+_D("worker_raylet_death_check_s", float, 5.0,
+   "workers probe their raylet at this interval and exit after 3"
+   " consecutive failures — a SIGKILLed raylet (multi-process shape"
+   " crash) must not orphan its worker processes forever (0 disables)")
 _D("idle_worker_killing_time_threshold_ms", int, 1000, "idle reap threshold")
 _D("maximum_startup_concurrency", int, 4, "concurrent worker forks")
 

@@ -35,6 +35,24 @@ class GcsDeposedError(RtError):
         return (GcsDeposedError, (self.epoch, self.new_epoch))
 
 
+class ControlPlaneDiedError(RtError):
+    """A dedicated control-plane process (GCS server or raylet) died while
+    the cluster was in use (multi-process deployment shape,
+    ``control_plane_procs``).  Raised by new control-plane operations —
+    task submission, actor creation — after the supervisor detects the
+    death; already-dispatched work on live workers is unaffected."""
+
+    def __init__(self, component: str, detail: str = ""):
+        self.component = component
+        self.detail = detail
+        super().__init__(
+            f"control-plane process {component!r} died"
+            + (f": {detail}" if detail else ""))
+
+    def __reduce__(self):  # two-arg __init__: default reduce would break
+        return (ControlPlaneDiedError, (self.component, self.detail))
+
+
 class TaskError(RtError):
     """A task raised an exception; re-raised at `get` on the caller."""
 

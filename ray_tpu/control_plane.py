@@ -1,0 +1,277 @@
+"""Out-of-process control plane: GCS + raylet as dedicated OS processes.
+
+The historical deployment shape runs the driver, the GCS server, and the
+raylet on ONE process — one asyncio loop, one GIL.  That is the cheapest
+possible wiring (a control-plane hop is an in-process coroutine switch),
+but at actor-churn rates every creation crosses the shared loop ~10 times
+(register → schedule → start_actor → pop → create_actor → ALIVE → pubsub →
+resolve → first call) while the same loop also carries driver submits and
+task replies; the control plane and the data plane starve each other
+(PERF_PLAN.md round 8: actors_per_second was control-plane-bound).
+
+This module is the other shape (reference: Ray proper — gcs_server and
+raylet are separate daemons; Podracer, arxiv 2104.06272 — decouple control
+from actor/learner execution so neither can starve the other): spawn
+``python -m ray_tpu.gcs.server`` and ``python -m ray_tpu.raylet.raylet``
+as children, parse their READY lines, and supervise them.  Everything
+already speaks the rpc layer, so the only behavioral difference is where
+the handlers run.  A dead child is detected by the supervisor within
+``control_plane_poll_ms`` and surfaced as a typed
+:class:`~ray_tpu.common.status.ControlPlaneDiedError` — never a hang.
+
+Selected by the ``control_plane_procs`` config flag (see common/config.py);
+the in-process shape remains the default.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.status import ControlPlaneDiedError, RtError
+
+logger = logging.getLogger(__name__)
+
+
+def _pkg_env() -> Dict[str, str]:
+    """Child env with ray_tpu importable even when the driver runs from an
+    unrelated cwd (same contract as raylet worker spawn)."""
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if pkg_root not in env.get("PYTHONPATH", "").split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+    return env
+
+
+class _ReadyTail(threading.Thread):
+    """Drain a child's stdout: tee every line to a log file, capture the
+    READY line, and keep a small ring for post-mortem error messages.
+    Draining must continue for the child's whole life or a chatty child
+    blocks on a full pipe."""
+
+    def __init__(self, proc: subprocess.Popen, ready_prefix: str,
+                 log_path: str):
+        super().__init__(daemon=True, name=f"cp-tail-{ready_prefix}")
+        self._proc = proc
+        self._prefix = ready_prefix.encode()
+        self._log_path = log_path
+        self.ready_line: Optional[str] = None
+        self.ready = threading.Event()
+        self.tail: List[str] = []
+        self.start()
+
+    def run(self):
+        try:
+            with open(self._log_path, "ab") as log:
+                for raw in iter(self._proc.stdout.readline, b""):
+                    log.write(raw)
+                    log.flush()
+                    if not self.ready.is_set() and raw.startswith(self._prefix):
+                        self.ready_line = raw.decode().strip()
+                        self.ready.set()
+                    self.tail.append(raw.decode(errors="replace").rstrip())
+                    del self.tail[:-20]
+        except Exception:  # noqa: BLE001 — tail loss must not kill anything
+            pass
+        finally:
+            self.ready.set()  # unblock waiters when the pipe closes
+
+
+class ControlPlaneProcess:
+    """One spawned control-plane daemon (GCS or raylet)."""
+
+    def __init__(self, component: str, argv: List[str], ready_prefix: str,
+                 log_path: str):
+        self.component = component
+        self.proc = subprocess.Popen(
+            argv, env=_pkg_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._tail = _ReadyTail(self.proc, ready_prefix, log_path)
+        self.log_path = log_path
+
+    def wait_ready(self, timeout: Optional[float] = None) -> List[str]:
+        """Block until the READY line appears; returns its fields (after
+        the prefix). Kills the child and raises on timeout or early exit."""
+        timeout = timeout if timeout is not None else GLOBAL_CONFIG.get(
+            "control_plane_ready_timeout_s")
+        self._tail.ready.wait(timeout)
+        if self._tail.ready_line is None:
+            detail = "; ".join(self._tail.tail[-5:])
+            self.stop(grace_s=1.0)
+            raise RtError(
+                f"{self.component} process failed to become ready within "
+                f"{timeout}s (see {self.log_path}): {detail}")
+        return self._tail.ready_line.split()[1:]
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def exit_detail(self) -> str:
+        code = self.proc.poll()
+        tail = "; ".join(self._tail.tail[-3:])
+        return f"exit code {code}" + (f" — {tail}" if tail else "")
+
+    def kill(self) -> None:
+        """Hard-kill (tests simulate a crash through this)."""
+        self.proc.kill()
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        """Graceful stop: SIGTERM (the daemons' mains run their clean
+        stop paths — the raylet kills its workers), escalate to SIGKILL."""
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                try:
+                    self.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        try:
+            self.proc.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def launch_gcs(session_dir: str, persist_dir: Optional[str] = None,
+               host: str = "127.0.0.1", port: int = 0,
+               system_config: Optional[str] = None) -> Tuple[
+                   ControlPlaneProcess, Tuple[str, int]]:
+    argv = [sys.executable, "-m", "ray_tpu.gcs.server",
+            "--host", host, "--port", str(port),
+            "--session-dir", session_dir]
+    if persist_dir:
+        argv += ["--persist-dir", persist_dir]
+    if system_config:
+        argv += ["--system-config", system_config]
+    os.makedirs(session_dir, exist_ok=True)
+    p = ControlPlaneProcess("gcs", argv, "GCS_READY",
+                            os.path.join(session_dir, "gcs.log"))
+    fields = p.wait_ready()
+    h, _, prt = fields[0].partition(":")
+    return p, (h, int(prt))
+
+
+def launch_raylet(gcs_address: Tuple[str, int], session_dir: str,
+                  resources: Optional[dict] = None,
+                  labels: Optional[dict] = None,
+                  host: str = "127.0.0.1", port: int = 0) -> Tuple[
+                      ControlPlaneProcess, dict]:
+    """Returns (process, {"address", "node_id_hex", "session_dir"})."""
+    import json
+
+    argv = [sys.executable, "-m", "ray_tpu.raylet.raylet",
+            "--gcs", f"{gcs_address[0]}:{gcs_address[1]}",
+            "--host", host, "--port", str(port),
+            "--resources", json.dumps(resources or {}),
+            "--labels", json.dumps(labels or {}),
+            "--session-dir", session_dir]
+    os.makedirs(session_dir, exist_ok=True)
+    p = ControlPlaneProcess("raylet", argv, "RAYLET_READY",
+                            os.path.join(session_dir, "raylet.log"))
+    fields = p.wait_ready()
+    h, _, prt = fields[0].partition(":")
+    info = {"address": (h, int(prt)), "node_id_hex": fields[1],
+            "session_dir": fields[2] if len(fields) > 2 else session_dir}
+    return p, info
+
+
+class ControlPlaneSupervisor(threading.Thread):
+    """Watch spawned control-plane processes; on unexpected death invoke
+    ``on_death(ControlPlaneDiedError)`` exactly once per process.  A clean
+    ``shutdown()`` stops the watch first, so teardown never masquerades as
+    a crash."""
+
+    def __init__(self, procs: Dict[str, ControlPlaneProcess],
+                 on_death: Callable[[ControlPlaneDiedError], None]):
+        super().__init__(daemon=True, name="control-plane-supervisor")
+        self._procs = dict(procs)
+        self._on_death = on_death
+        self._stop = threading.Event()
+        self._reported: set = set()
+
+    def run(self):
+        period = GLOBAL_CONFIG.get("control_plane_poll_ms") / 1000.0
+        while not self._stop.wait(period):
+            for name, p in self._procs.items():
+                if name in self._reported or p.alive():
+                    continue
+                self._reported.add(name)
+                err = ControlPlaneDiedError(name, p.exit_detail())
+                logger.error("%s", err)
+                try:
+                    self._on_death(err)
+                except Exception:  # noqa: BLE001 — keep watching the rest
+                    logger.exception("control-plane death callback failed")
+
+    def shutdown(self):
+        self._stop.set()
+
+
+class ProcHead:
+    """Driver-side handle for a multi-process head node: the GCS process,
+    the raylet process, and their supervisor.  Mirrors the duck-type the
+    in-process shape keeps in ``api._head`` (address/session_dir/node_id
+    accessors + stop())."""
+
+    def __init__(self, *, resources: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 persist_dir: Optional[str] = None,
+                 system_config: Optional[str] = None,
+                 session_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 on_death: Optional[Callable] = None):
+        from ray_tpu.common.ids import NodeID
+
+        self.session_dir = session_dir or f"/tmp/rt/session_{os.getpid()}"
+        self.gcs_proc, self.gcs_address = launch_gcs(
+            self.session_dir, persist_dir=persist_dir,
+            host=host, port=port, system_config=system_config)
+        try:
+            self.raylet_proc, info = launch_raylet(
+                self.gcs_address, self.session_dir,
+                resources=resources, labels=labels)
+        except BaseException:
+            self.gcs_proc.stop(grace_s=2.0)
+            raise
+        self.raylet_address = info["address"]
+        self.node_id = NodeID.from_hex(info["node_id_hex"])
+        self.fatal: Optional[ControlPlaneDiedError] = None
+        self._user_on_death = on_death
+        self.supervisor = ControlPlaneSupervisor(
+            {"gcs": self.gcs_proc, "raylet": self.raylet_proc},
+            self._record_death)
+        self.supervisor.start()
+
+    def _record_death(self, err: ControlPlaneDiedError) -> None:
+        if self.fatal is None:
+            self.fatal = err
+        if self._user_on_death is not None:
+            self._user_on_death(err)
+
+    def set_on_death(self, cb: Callable) -> None:
+        """Late-bound: the CoreWorker the callback fails does not exist
+        yet when the processes are launched."""
+        self._user_on_death = cb
+        if self.fatal is not None:  # died during init: deliver immediately
+            cb(self.fatal)
+
+    def stop(self) -> None:
+        self.supervisor.shutdown()
+        # raylet first (it reaps its workers on SIGTERM), then the GCS
+        self.raylet_proc.stop()
+        self.gcs_proc.stop()
+        try:
+            from ray_tpu.object_store.shm import node_shm_name
+            from ray_tpu.object_store.shm import unlink as shm_unlink
+
+            shm_unlink(node_shm_name(self.node_id))
+        except Exception:  # noqa: BLE001
+            pass

@@ -27,7 +27,8 @@ from ray_tpu.common.status import (
     WorkerCrashedError,
 )
 from ray_tpu.common.task_spec import PlacementGroupStrategy, TaskSpec
-from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcError
+from ray_tpu.rpc.rpc import (IoContext, RemoteMethodError,
+                             RetryableRpcClient, RpcClient, RpcError)
 
 logger = logging.getLogger(__name__)
 
@@ -248,6 +249,15 @@ class NormalTaskSubmitter:
         # holders register/unregister them and own the lease lifecycle.
         self._fast_pool: Dict[tuple, List[_FastLeaseChannel]] = {}
         self._fast_pool_lock = threading.Lock()
+        # per-address raylet clients: a lease request and its eventual
+        # return used to open (connect + HELLO) a fresh connection EACH —
+        # two TCP setups per lease cycle at churn rates (loop-only access)
+        self._raylet_clients: Dict[tuple, RetryableRpcClient] = {}
+        # coalesced lease grants (shape key -> granted tuples): one
+        # request_worker_leases RPC grants up to batch-size leases; the
+        # first lease coroutine parks the extras here and its siblings
+        # consume them without a round trip (loop-only access)
+        self._grant_cache: Dict[tuple, List[tuple]] = {}
 
     def submit(self, spec: TaskSpec):
         # Lease-cache fast path: an eligible task whose shape already
@@ -307,6 +317,26 @@ class NormalTaskSubmitter:
             return False
         self._m_fast.inc()  # count only dispatches that actually left
         return True
+
+    def fail_queued(self, exc: Exception) -> None:
+        """Control-plane death (multi-process shape): every spec still
+        waiting for a lease can never run — fail them with the typed
+        error so pending ``get()``s unblock instead of hanging.  Specs
+        already pushed to live workers are untouched."""
+
+        def drain():
+            with self._pending_lock:
+                specs, self._pending = self._pending, []
+                self._wakeup_scheduled = False
+            for spec in specs:
+                self._store_error(spec, exc)
+            for key in list(self._queues):
+                for spec in self._queues.pop(key, []):
+                    self._store_error(spec, exc)
+            for key in list(self._grant_cache):
+                self._drain_grant_cache(key)
+
+        self._io.loop.call_soon_threadsafe(drain)
 
     def _drain_pending(self):
         with self._pending_lock:
@@ -376,7 +406,7 @@ class NormalTaskSubmitter:
         try:
             while self._queues.get(key):
                 try:
-                    grant = await self._request_lease(sample)
+                    grant = await self._request_lease(sample, key=key)
                 except _JobFinishedByRaylet as jf_err:
                     for spec in self._queues.pop(key, []):
                         self._store_error(spec, jf_err)
@@ -393,14 +423,15 @@ class NormalTaskSubmitter:
                         self._store_error(spec, env_err)
                     return
                 if grant is None:
-                    # infeasible right now — fail queued tasks of this shape
+                    # infeasible right now — fail queued tasks of this
+                    # shape (typed as the control-plane death when that is
+                    # the actual reason the lease could not be obtained)
+                    err = getattr(self._cw, "_control_plane_error", None) \
+                        or WorkerCrashedError(
+                            "task is infeasible: no node can ever satisfy "
+                            f"{sample.required_resources.resources.to_dict()}")
                     for spec in self._queues.pop(key, []):
-                        self._store_error(
-                            spec,
-                            WorkerCrashedError(
-                                "task is infeasible: no node can ever satisfy "
-                                f"{sample.required_resources.resources.to_dict()}"),
-                        )
+                        self._store_error(spec, err)
                     return
                 raylet_addr, lease_id, worker_addr, fast_port = grant
                 try:
@@ -408,26 +439,73 @@ class NormalTaskSubmitter:
                                              fast_port)
                 finally:
                     try:
-                        c = RetryableRpcClient(raylet_addr, deadline_s=5.0)
-                        await c.call_async("return_worker", lease_id=lease_id)
-                        c.close()
+                        await self._raylet_client(raylet_addr).call_async(
+                            "return_worker", lease_id=lease_id,
+                            timeout=10.0)
                     except Exception:  # noqa: BLE001
                         pass
         finally:
             self._leases_in_flight[key] = max(0, self._leases_in_flight.get(key, 1) - 1)
+            if self._leases_in_flight[key] == 0:
+                # last lease coroutine of this shape: any still-cached
+                # coalesced grants have no consumer left — give them back
+                self._drain_grant_cache(key)
 
-    async def _request_lease(self, spec: TaskSpec):
-        """Lease protocol with spillback: follow redirects up to a few hops."""
+    def _raylet_client(self, addr) -> RetryableRpcClient:
+        """Cached per-address raylet client (loop-only). The cache is
+        dropped on transport failure inside _request_lease so a restarted
+        raylet at the same address gets a fresh connection."""
+        addr = tuple(addr)
+        c = self._raylet_clients.get(addr)
+        if c is None:
+            c = self._raylet_clients[addr] = RetryableRpcClient(
+                addr, deadline_s=30.0)
+        return c
+
+    def _next_lease_id(self) -> bytes:
         self._lease_counter += 1
-        lease_id = self._lease_counter.to_bytes(8, "little") + self._cw.worker_id.binary()
-        raylet_addr = self._cw.raylet_address
-        strategy = pickle.dumps(spec.scheduling_strategy)
+        return (self._lease_counter.to_bytes(8, "little")
+                + self._cw.worker_id.binary())
+
+    async def _request_lease(self, spec: TaskSpec, key: Optional[tuple] = None):
+        """Lease protocol with spillback: follow redirects up to a few hops.
+
+        When the shape's queue is deeper than one, up to batch-size
+        leases are requested in ONE coalesced RPC against the local
+        raylet; surplus grants are parked in ``_grant_cache`` for the
+        sibling lease coroutines (and anything not granted coalesced
+        falls through to the ordinary single-lease protocol below, which
+        owns queueing/spill/infeasible)."""
         pg = None
         if isinstance(spec.scheduling_strategy, PlacementGroupStrategy):
             pg = (spec.scheduling_strategy.placement_group_id.binary(),
                   spec.scheduling_strategy.bundle_index)
+        if key is not None:
+            cached = self._grant_cache.get(key)
+            if cached:
+                return cached.pop(0)
+            from ray_tpu.common.task_spec import DefaultStrategy
+
+            want = min(len(self._queues.get(key) or ()),
+                       GLOBAL_CONFIG.get("lease_request_batch_size"))
+            # Default-strategy shapes only: the coalesced RPC grants
+            # strictly locally, so placement-bearing strategies (PG,
+            # node affinity, spread) keep the single-lease protocol that
+            # ships the strategy to the raylet
+            if want > 1 and isinstance(spec.scheduling_strategy,
+                                       DefaultStrategy) \
+                    and GLOBAL_CONFIG.get("lease_grant_coalescing"):
+                grants = await self._request_leases_coalesced(spec, want)
+                if grants:
+                    if len(grants) > 1:
+                        self._grant_cache.setdefault(key, []).extend(
+                            grants[1:])
+                    return grants[0]
+        lease_id = self._next_lease_id()
+        raylet_addr = self._cw.raylet_address
+        strategy = pickle.dumps(spec.scheduling_strategy)
         for _hop in range(8):
-            client = RetryableRpcClient(raylet_addr, deadline_s=30.0)
+            client = self._raylet_client(raylet_addr)
             try:
                 # No client-side timeout: a queued lease legitimately blocks
                 # until resources free up; truly impossible demands come back
@@ -446,9 +524,12 @@ class NormalTaskSubmitter:
                 )
             except Exception as e:  # noqa: BLE001
                 logger.warning("lease request to %s failed: %s", raylet_addr, e)
+                # drop the cached client: the address may come back as a
+                # different incarnation (raylet restart)
+                stale = self._raylet_clients.pop(tuple(raylet_addr), None)
+                if stale is not None:
+                    stale.close()
                 return None
-            finally:
-                client.close()
             status = reply.get("status")
             if status == "granted":
                 logger.debug("lease granted: worker %s", reply["worker_address"])
@@ -471,6 +552,45 @@ class NormalTaskSubmitter:
                     "lease rejected: this job was finished (driver "
                     "unreachable or exited)")
         return None
+
+    async def _request_leases_coalesced(self, spec: TaskSpec,
+                                        want: int) -> List[tuple]:
+        """One request_worker_leases RPC for up to ``want`` grants from
+        the local raylet. Empty list = nothing immediately grantable (or
+        a pre-batching raylet): take the single-lease path."""
+        from ray_tpu.rpc.rpc import RpcMethodNotFound
+
+        raylet_addr = self._cw.raylet_address
+        lease_ids = [self._next_lease_id() for _ in range(want)]
+        try:
+            reply = await self._raylet_client(raylet_addr).call_async(
+                "request_worker_leases", lease_ids=lease_ids,
+                resources=spec.required_resources.to_dict(),
+                runtime_env=spec.runtime_env,
+                job_id=self._cw.job_id.binary(), timeout=60.0)
+        except (RpcMethodNotFound, RemoteMethodError):
+            return []  # rolling upgrade: raylet predates the batch RPC
+        except Exception as e:  # noqa: BLE001 — single path will retry
+            logger.debug("coalesced lease request failed: %s", e)
+            return []
+        return [(raylet_addr, g["lease_id"], tuple(g["worker_address"]),
+                 g.get("worker_fast_port"))
+                for g in reply.get("granted") or []]
+
+    def _drain_grant_cache(self, key: tuple) -> None:
+        """Give back grants nobody consumed (queue emptied first): a
+        cached grant holds a LEASED worker — dropping it would leak the
+        worker and its resources forever."""
+        for raylet_addr, lease_id, _wa, _fp in self._grant_cache.pop(
+                key, []):
+            async def give_back(addr=raylet_addr, lid=lease_id):
+                try:
+                    await self._raylet_client(addr).call_async(
+                        "return_worker", lease_id=lid, timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            self._io.spawn(give_back())
 
     async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr,
                             fast_port=None):
@@ -745,6 +865,11 @@ class ActorTaskSubmitter:
         # set by pubsub actor-state events: resolution wakes immediately on
         # ALIVE instead of sleeping a fixed poll interval
         self._state_event = asyncio.Event()
+        # the most recent pubsub actor view: the ALIVE event already
+        # carries address + fast_port, so resolution consumes it directly
+        # instead of re-polling get_actor after every wakeup (measured
+        # ~3 get_actor RPCs per creation at churn rates without this)
+        self._pushed_view: Optional[dict] = None
         from ray_tpu.common.containers import BoundedSet
 
         # cancelled call ids: never resent after an actor restart, and
@@ -954,11 +1079,19 @@ class ActorTaskSubmitter:
         unknown_deadline = loop.time() + 5.0
         unknown_wait = 0.02
         while loop.time() < deadline:
-            try:
-                info = await self._cw.gcs.call_async("get_actor", actor_id=self.actor_id.binary())
-            except Exception:  # noqa: BLE001
-                await asyncio.sleep(0.5)
-                continue
+            # pubsub-pushed view first: the ALIVE event carries the full
+            # public view, so the common churn path resolves without any
+            # get_actor round trip (the poll below is the fallback for
+            # actors that went ALIVE before this submitter subscribed)
+            info = self._pushed_view
+            self._pushed_view = None
+            if info is None:
+                try:
+                    info = await self._cw.gcs.call_async(
+                        "get_actor", actor_id=self.actor_id.binary())
+                except Exception:  # noqa: BLE001
+                    await asyncio.sleep(0.5)
+                    continue
             if info is None:
                 if loop.time() < unknown_deadline:
                     await asyncio.sleep(unknown_wait)
@@ -1118,6 +1251,15 @@ class ActorTaskSubmitter:
     def notify_actor_state(self, view: dict):
         """Pubsub-driven: DEAD → fail; ALIVE after restart → reconnect."""
         state = view.get("state")
+        if state == "ALIVE" and view.get("address"):
+            # hand the resolver the full view: ALIVE resolution then needs
+            # no get_actor round trip (consumed on the loop thread)
+            self._pushed_view = view
+        else:
+            # DEAD/RESTARTING supersede any parked ALIVE view — a stale
+            # one would point the resolver at the dead incarnation's
+            # address (and skip the new-incarnation renumbering)
+            self._pushed_view = None
         self._io.loop.call_soon_threadsafe(self._state_event.set)
         if state == "DEAD" and self._state != "DEAD":
             self._io.loop.call_soon_threadsafe(

@@ -56,7 +56,8 @@ from ray_tpu.common.task_spec import (
     _FastArgs,
 )
 from ray_tpu.gcs.client import GcsClient
-from ray_tpu.rpc.rpc import IoContext, RetryableRpcClient, RpcClient, RpcServer
+from ray_tpu.rpc.rpc import (IoContext, RemoteMethodError,
+                             RetryableRpcClient, RpcClient, RpcServer)
 from ray_tpu.common.resources import ResourceRequest
 from ray_tpu.util import tracing as _tracing
 from . import serialization as _serialization
@@ -177,6 +178,11 @@ class CoreWorker:
         self._ctx = _TaskContext()
         self._driver_task_id = TaskID.for_driver(self.job_id)
         self._actor_counter = _Counter()
+        # unnamed-actor registration batcher (one register_actors RPC per
+        # loop tick instead of one RPC per .remote())
+        self._pending_actor_regs: list = []
+        self._actor_reg_lock = threading.Lock()
+        self._actor_reg_flush_scheduled = False
         self._empty_args_payload: Optional[bytes] = None
         self._index_counters: Dict[Any, _Counter] = {}
         self._index_lock = threading.Lock()
@@ -240,6 +246,10 @@ class CoreWorker:
         self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
 
         _bt("fastloop")
+        # Multi-process shape: the supervisor stores the typed death error
+        # here; new control-plane work (submits, creations) fails fast on
+        # it instead of timing out against a dead daemon (control_plane.py)
+        self._control_plane_error: Optional[Exception] = None
         self._shm = False  # False = not probed yet; None = unavailable
         self._shm_probe_lock = threading.Lock()
         if mode != MODE_DRIVER:
@@ -293,14 +303,15 @@ class CoreWorker:
                 probed = None
                 if GLOBAL_CONFIG.get("shm_store_enabled"):
                     try:
-                        from ray_tpu.object_store.shm import ShmObjectStore
+                        from ray_tpu.object_store.shm import (ShmObjectStore,
+                                                              node_shm_name)
 
                         # spill dir DERIVED from the segment name inside
                         # the store — every handle (workers, tools, the
                         # teardown unlink) must agree on it, so no caller
                         # spells it out
                         probed = ShmObjectStore(
-                            f"/rtshm_{self.node_id.hex()[:12]}",
+                            node_shm_name(self.node_id),
                             capacity=GLOBAL_CONFIG.get("shm_store_bytes"))
                     except Exception as e:  # noqa: BLE001 — degrade to RPC
                         logger.warning("shm object store unavailable: %s", e)
@@ -656,6 +667,21 @@ class CoreWorker:
             raise ObjectLostError(ref.object_id, f"fetch failed: {e}") from e
 
     # ------------------------------------------------------- task submission
+    def fail_control_plane(self, exc: Exception) -> None:
+        """Control-plane process died (multi-process shape): record the
+        typed error and fail every normal task still QUEUED for a lease —
+        leases need the raylet, so those can never run.  Work already
+        pushed to live workers keeps its direct connection and completes
+        normally (the Podracer argument: data plane outlives control
+        plane)."""
+        self._control_plane_error = exc
+        logger.error("control plane failed: %s", exc)
+        self.submitter.fail_queued(exc)
+
+    def _raise_if_control_plane_dead(self) -> None:
+        if self._control_plane_error is not None:
+            raise self._control_plane_error
+
     def submit_task(
         self,
         func,
@@ -674,6 +700,7 @@ class CoreWorker:
     ):
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
+        self._raise_if_control_plane_dead()
         task_id = TaskID.for_normal_task(
             self.job_id, self.current_task_id(), self.next_task_index())
         spec = TaskSpec(
@@ -813,6 +840,7 @@ class CoreWorker:
                      serialized_cls: Optional[bytes] = None) -> "ActorID":
         from ray_tpu.runtime_env.runtime_env import merge as _merge_env
 
+        self._raise_if_control_plane_dead()
         actor_id = ActorID.of(self.job_id, self.current_task_id(), self._actor_counter.next())
         creation_task_id = TaskID.for_actor_creation_task(actor_id)
         spec = TaskSpec(
@@ -847,27 +875,64 @@ class CoreWorker:
 
         # Unnamed actors register ASYNCHRONOUSLY (reference semantics:
         # ActorClass.remote() must not block the driver for the spawn
-        # chain). The actor_id is minted locally; the submitter's address
-        # resolution tolerates the registration still being in flight.
-        # At actor-churn rates the synchronous ack was the single largest
-        # serial cost on the creation path (~9 ms per .remote() measured).
+        # chain), and COALESCED: a burst of .remote() calls from caller
+        # threads batches into ONE register_actors RPC per loop tick
+        # instead of one GCS round trip per creation — at churn rates the
+        # per-creation RPC (pickle + syscalls + a GCS handler dispatch)
+        # was the largest driver-side cost left after the ack went async.
         blob = pickle.dumps(spec)
-
-        async def register():
-            try:
-                reply = await self.gcs.call_async(
-                    "register_actor", creation_spec=blob,
-                    actor_id=actor_id.binary(), job_id=self.job_id.binary(),
-                    name=None, namespace=namespace,
-                    max_restarts=max_restarts)
-                if not reply.get("ok"):
-                    logger.error("async actor registration failed: %s",
-                                 reply.get("error"))
-            except Exception:  # noqa: BLE001 — resolution will time out
-                logger.exception("async actor registration failed")
-
-        self._io.spawn_threadsafe(register())
+        entry = {"creation_spec": blob, "actor_id": actor_id.binary(),
+                 "namespace": namespace, "max_restarts": max_restarts}
+        with self._actor_reg_lock:
+            self._pending_actor_regs.append(entry)
+            if self._actor_reg_flush_scheduled:
+                return actor_id
+            self._actor_reg_flush_scheduled = True
+        self._io.loop.call_soon_threadsafe(self._flush_actor_regs)
         return actor_id
+
+    def _flush_actor_regs(self):
+        """Ship every registration queued since the last flush as one
+        batched GCS RPC (falls back to per-actor register_actor against a
+        pre-batching GCS)."""
+        with self._actor_reg_lock:
+            batch, self._pending_actor_regs = self._pending_actor_regs, []
+            self._actor_reg_flush_scheduled = False
+        if not batch:
+            return
+
+        async def send():
+            from ray_tpu.rpc.rpc import RpcMethodNotFound
+
+            try:
+                try:
+                    reply = await self.gcs.call_async(
+                        "register_actors", specs=batch,
+                        job_id=self.job_id.binary())
+                except (RpcMethodNotFound, RemoteMethodError):
+                    # older GCS (rolling upgrade): per-actor fallback —
+                    # each actor's failure is its own (one transient error
+                    # must not abort the rest of the batch)
+                    for e in batch:
+                        try:
+                            await self.gcs.call_async(
+                                "register_actor",
+                                creation_spec=e["creation_spec"],
+                                actor_id=e["actor_id"],
+                                job_id=self.job_id.binary(), name=None,
+                                namespace=e["namespace"],
+                                max_restarts=e["max_restarts"])
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "fallback actor registration failed")
+                    return
+                for err in (reply or {}).get("errors") or []:
+                    logger.error("batched actor registration failed: %s",
+                                 err)
+            except Exception:  # noqa: BLE001 — resolution will time out
+                logger.exception("batched actor registration failed")
+
+        self._io.spawn(send())
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           *, num_returns: int = 1, name: str = "",
@@ -1868,7 +1933,13 @@ class CoreWorker:
         self._seq_finish(caller, seq, reply)
         return reply
 
-    async def h_create_actor(self, creation_spec: bytes, node_id: bytes):
+    async def h_create_actor(self, creation_spec: bytes, node_id: bytes,
+                             tpu_chips=None):
+        # coalesced device grant: the raylet ships the chip assignment on
+        # the creation push instead of a preceding set_visible_devices
+        # round trip (one RPC on the creation critical path, not two)
+        if tpu_chips is not None:
+            await self.h_set_visible_devices(tpu_chips=list(tpu_chips))
         task: TaskSpec = pickle.loads(creation_spec)
         if task.runtime_env is not None:
             self.job_runtime_env = task.runtime_env  # children inherit
@@ -2503,6 +2574,12 @@ class CoreWorker:
                     cli.close()
                 except Exception:  # noqa: BLE001
                     pass
+        for c in list(getattr(self.submitter, "_raylet_clients",
+                              {}).values()):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
         self.server.stop()
         self._executor.shutdown(wait=False)
 

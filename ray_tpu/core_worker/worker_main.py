@@ -149,6 +149,19 @@ def main():
         worker_id=worker_id,
     )
     t_cw = time.monotonic()
+    # Raylet-death watchdog — started BEFORE registration: a worker
+    # forked moments before its raylet was SIGKILLed (multi-process
+    # shape crash) would otherwise sit in the registration retry loop as
+    # an orphan. A crashed raylet never runs its worker-reaping stop
+    # path, and factory-forked workers aren't even its direct children,
+    # so this probe is the only reaper. Three consecutive failures ≈
+    # raylet gone, not merely busy (loop p99 under churn is ~30 ms).
+    period = GLOBAL_CONFIG.get("worker_raylet_death_check_s")
+    if period > 0:
+        threading.Thread(
+            target=_raylet_death_watchdog,
+            args=((raylet_host, int(raylet_port)), period),
+            daemon=True, name="raylet-death-watch").start()
     raylet = RetryableRpcClient((raylet_host, int(raylet_port)))
     reply = raylet.call(
         "register_worker", worker_id=worker_id.binary(),
@@ -166,6 +179,32 @@ def main():
         return  # raylet doesn't know us: die quietly
     while True:
         time.sleep(3600)
+
+
+def _raylet_death_watchdog(raylet_addr, period: float) -> None:
+    from ray_tpu.rpc.rpc import RpcClient
+
+    misses = 0
+    probe = None
+    while True:
+        time.sleep(period)
+        try:
+            if probe is None:
+                probe = RpcClient(raylet_addr)
+            probe.call("health_check", timeout=max(3.0, period))
+            misses = 0
+        except Exception:  # noqa: BLE001 — count toward the threshold
+            try:
+                if probe is not None:
+                    probe.close()
+            except Exception:  # noqa: BLE001
+                pass
+            probe = None
+            misses += 1
+            if misses >= 3:
+                logging.getLogger(__name__).warning(
+                    "raylet unreachable x%d; worker exiting", misses)
+                os._exit(1)
 
 
 if __name__ == "__main__":

@@ -335,7 +335,8 @@ class GcsServer:
             "get_cluster_load", "update_system_config",
             "get_cluster_resources", "check_alive",
             "register_job", "finish_job", "get_all_jobs", "get_next_job_id",
-            "register_actor", "report_actor_state", "get_actor", "get_actor_by_name",
+            "register_actor", "register_actors", "report_actor_state",
+            "get_actor", "get_actor_by_name",
             "list_actors", "kill_actor",
             "create_placement_group", "remove_placement_group", "get_placement_group",
             "wait_placement_group_ready", "list_placement_groups",
@@ -775,6 +776,29 @@ class GcsServer:
         self._io.spawn(self._schedule_actor(rec))
         return {"ok": True}
 
+    async def h_register_actors(self, specs: List[dict], job_id: bytes):
+        """Coalesced unnamed-actor registration: one RPC registers a whole
+        burst of creations (the driver batches per loop tick).  Named
+        actors keep the per-actor RPC — their callers need the synchronous
+        name-collision ack."""
+        jid = JobID(job_id)
+        errors: List[str] = []
+        for e in specs:
+            try:
+                rec = ActorRecord(
+                    actor_id=ActorID(e["actor_id"]), job_id=jid, name=None,
+                    namespace=e.get("namespace", "default"),
+                    creation_spec=e["creation_spec"],
+                    max_restarts=e.get("max_restarts", 0),
+                )
+                self._actors[rec.actor_id] = rec
+                self._persist_actor(rec)
+                self._io.spawn(self._schedule_actor(rec))
+            except Exception as ex:  # noqa: BLE001 — one bad spec must not
+                # poison the rest of the batch
+                errors.append(f"{e.get('actor_id', b'').hex()}: {ex!r}")
+        return {"ok": not errors, "errors": errors}
+
     async def _schedule_actor(self, rec: ActorRecord):
         """GcsActorScheduler equivalent: pick node, ask its raylet to start the
         actor (raylet owns worker pool + resource accounting)."""
@@ -1149,21 +1173,35 @@ class GcsServer:
 
 def main():
     import argparse
+    import signal
+    import threading
 
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--persist-dir", default=None)
+    parser.add_argument("--session-dir", default=None,
+                        help="attach the export-event logger here (only "
+                        "active when enable_export_api is set)")
+    parser.add_argument("--system-config", default=None,
+                        help="JSON system_config dict (the multi-process "
+                        "launcher forwards the driver's init(system_config) "
+                        "here so cluster-wide flags apply in this process)")
     args = parser.parse_args()
+    if args.system_config:
+        GLOBAL_CONFIG.initialize(args.system_config)
+        GLOBAL_CONFIG.reset_cache()
     gcs = GcsServer(args.host, args.port, args.persist_dir)
+    if args.session_dir:
+        gcs.attach_export_logger(args.session_dir)
     gcs.start()
     print(f"GCS_READY {gcs.address[0]}:{gcs.address[1]}", flush=True)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        gcs.stop()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    gcs.stop()
 
 
 if __name__ == "__main__":

@@ -320,6 +320,37 @@ def make_batched_spec_verify(params: Params, config: LlamaConfig):
     (the engine overwrites ``cache["length"]`` wholesale) and are
     overwritten by later writes — attention masks by position, so they
     are invisible."""
+    return _make_window_forward(params, config, with_logits=True)
+
+
+def make_kv_ingest(params: Params, config: LlamaConfig):
+    """KV-write-only sibling of :func:`make_batched_spec_verify`: writes
+    exactly the same cache rows but skips the final norm + lm-head
+    projection, so no ``(slots, C, vocab)`` logits einsum is paid.
+
+    ingest(cache, tokens (B, C), true_lens (B,), start_pos (B,)) → cache
+
+    This is the draft catch-up path (speculation.DraftProposer): after an
+    all-K-accepted round the draft cache is one token behind and the
+    catch-up only needs the KV rows — reusing the verify program meant
+    every such round computed (and discarded) a full-vocab logits block
+    (PERF_PLAN round 7, "known draft-path optimization, not yet taken").
+    """
+    call = _make_window_forward(params, config, with_logits=False)
+
+    def ingest(cache, tokens, true_lens, start_pos):
+        cache, _ = call(cache, tokens, true_lens, start_pos)
+        return cache
+
+    return ingest
+
+
+def _make_window_forward(params: Params, config: LlamaConfig,
+                         with_logits: bool):
+    """Shared builder: per-slot token windows scattered at per-slot
+    offsets through the full stack, with (``with_logits``) or without the
+    lm-head projection.  See :func:`make_batched_spec_verify` for the
+    window semantics."""
     c = config
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
 
@@ -389,10 +420,16 @@ def make_batched_spec_verify(params: Params, config: LlamaConfig):
 
         x, (new_k, new_v) = jax.lax.scan(
             body, x, (params["layers"], cache["k"], cache["v"]))
-        x = rmsnorm(x, params["final_norm"], c.norm_eps)
-        head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-        all_logits = jnp.einsum("bce,ev->bcv", x.astype(jnp.float32),
-                                head.astype(jnp.float32))
+        if with_logits:
+            x = rmsnorm(x, params["final_norm"], c.norm_eps)
+            head = (params["embed"].T if c.tie_embeddings
+                    else params["lm_head"])
+            all_logits = jnp.einsum("bce,ev->bcv", x.astype(jnp.float32),
+                                    head.astype(jnp.float32))
+        else:
+            # KV-ingest: the caller discards logits — skip the final norm
+            # and the (B, C, vocab) head projection entirely
+            all_logits = None
         # provisional: start + window length for touched slots; the
         # engine installs the accepted lengths right after
         new_len = jnp.where(true_lens > 0,

@@ -231,8 +231,7 @@ class DraftProposer(Proposer):
     def __init__(self, target_config, draft_config, draft_params, *,
                  num_slots: int, max_seq: int, k: int = 4):
         from ray_tpu.models.decoding import (
-            init_cache, make_batched_spec_verify, make_decode_step,
-            make_prefill)
+            init_cache, make_decode_step, make_kv_ingest, make_prefill)
 
         if draft_config.vocab_size != target_config.vocab_size:
             raise ValueError(
@@ -248,7 +247,10 @@ class DraftProposer(Proposer):
         self.cache = init_cache(draft_config, num_slots, max_seq)
         self._prefill = make_prefill(draft_params, draft_config)
         self._decode = make_decode_step(draft_params, draft_config)
-        self._ingest = make_batched_spec_verify(draft_params, draft_config)
+        # KV-write-only catch-up: all-K-accepted rounds no longer pay a
+        # discarded (slots, k+1, vocab) lm-head einsum (the round-7
+        # "known draft-path optimization")
+        self._ingest = make_kv_ingest(draft_params, draft_config)
         self._len = np.zeros(num_slots, np.int64)   # host mirror
         self._last_m: Dict[int, int] = {}           # proposals last round
         self.draft_steps = 0
@@ -302,7 +304,7 @@ class DraftProposer(Proposer):
                 n = min(len(toks), C)
                 buf[slot, :n] = toks[:n]
                 true_lens[slot] = n
-            self.cache, _ = self._ingest(
+            self.cache = self._ingest(
                 self.cache, jnp.asarray(buf), jnp.asarray(true_lens),
                 jnp.asarray(starts))
             for slot in missing:

@@ -388,6 +388,14 @@ class ShmObjectStore:
         self._lib.rts_unlink(self.name)
 
 
+def node_shm_name(node_id) -> str:
+    """Canonical name of a node's arena segment — the ONE place the
+    naming scheme lives (creator: the hosting raylet; openers: workers,
+    stats, teardown in both deployment shapes)."""
+    hexid = node_id if isinstance(node_id, str) else node_id.hex()
+    return f"/rtshm_{hexid[:12]}"
+
+
 def unlink(name) -> bool:
     """Unlink a segment by name WITHOUT opening it (no handle-slot cost).
     Also removes the segment's derived spill dir — demoted objects die

@@ -77,6 +77,34 @@ TP_RULES = ShardingRules(embed_fsdp=None)
 FSDP_TP_RULES = ShardingRules()
 
 
+def _ensure_partitionable_rng() -> None:
+    """jax < 0.5 defaults ``jax_threefry_partitionable`` to False, under
+    which a jitted init whose output is sharded along an array's LEADING
+    dim generates different random bits than the unsharded computation
+    (measured on jax 0.4.37: ``truncated_normal`` under
+    ``out_shardings=P("fsdp", None)`` diverges; trailing-dim sharding does
+    not).  That breaks the sharded-from-birth contract — "same seed ⇒ same
+    params as single-device" — for any weight whose dim 0 is sharded
+    (e.g. llama's ``lm_head`` under ZeRO-3 rules).  jax >= 0.5 flips the
+    default to True; align older versions with the modern semantics."""
+    import jax
+
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+        if (major, minor) >= (0, 5):
+            return
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # noqa: BLE001 — unknown version string: leave as-is
+        pass
+
+
+# At import, not per-call: the flag must flip BEFORE any RNG value that
+# will later be compared against a sharded computation is drawn — the
+# stream itself changes, so a mid-session flip would split one process
+# into two incompatible RNG regimes.
+_ensure_partitionable_rng()
+
+
 def set_mesh(mesh):
     """Context manager activating ``mesh`` for jitted computations.
 

@@ -133,6 +133,20 @@ def main(sock_path: str) -> None:
 
     threading.Thread(target=_reap_loop, daemon=True,
                      name="factory-reap").start()
+
+    def _orphan_watch(parent=os.getppid()):
+        # the factory is a direct child of the raylet: if the raylet is
+        # SIGKILLed (multi-process-shape crash) nobody shuts the factory
+        # down — reparenting is the death signal
+        import time as _t
+
+        while True:
+            _t.sleep(2.0)
+            if os.getppid() != parent:
+                os._exit(0)
+
+    threading.Thread(target=_orphan_watch, daemon=True,
+                     name="factory-orphan-watch").start()
     if os.path.exists(sock_path):
         os.unlink(sock_path)
     listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)

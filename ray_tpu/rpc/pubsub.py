@@ -28,6 +28,12 @@ class Publisher:
         self._mail: Dict[str, List[tuple]] = defaultdict(list)
         self._wakeups: Dict[str, asyncio.Event] = {}
         self._lock = threading.Lock()
+        # wakeup coalescing: a burst of publishes (batched actor ALIVEs,
+        # resource gossip) schedules ONE loop callback that fires every
+        # pending subscriber event, instead of one call_soon_threadsafe
+        # (pipe write + loop iteration) per message per subscriber
+        self._pending_wakeups: set = set()
+        self._wakeup_scheduled = False
 
     def attach(self, server: RpcServer, prefix: str = "pubsub_"):
         server.register(prefix + "subscribe", self._handle_subscribe)
@@ -89,11 +95,23 @@ class Publisher:
                 if len(box) < _MAILBOX_CAP:
                     box.append((channel, key, message))
                 targets.append(sub_id)
-        io = IoContext.current()
+            if not targets:
+                return
+            self._pending_wakeups.update(targets)
+            if self._wakeup_scheduled:
+                return  # a flush is already on its way: ride it
+            self._wakeup_scheduled = True
+        IoContext.current().loop.call_soon_threadsafe(self._flush_wakeups)
+
+    def _flush_wakeups(self):
+        with self._lock:
+            targets = self._pending_wakeups
+            self._pending_wakeups = set()
+            self._wakeup_scheduled = False
         for sub_id in targets:
             ev = self._wakeups.get(sub_id)
             if ev is not None:
-                io.loop.call_soon_threadsafe(ev.set)
+                ev.set()
 
 
 class Subscriber:

@@ -109,7 +109,11 @@ RPC_SCHEMAS: Dict[str, Message] = {
     "cancel_running_task": _m("cancel_running_task", req("task_id", bytes),
                               opt("force", bool)),
     "create_actor": _m("create_actor", req("creation_spec", bytes),
-                       req("node_id", bytes)),
+                       req("node_id", bytes),
+                       # coalesced device grant: chips ride the creation
+                       # push instead of a separate set_visible_devices
+                       # round trip (raylet h_start_actor)
+                       opt("tpu_chips", (tuple, list))),
     "get_object": _m("get_object", req("object_id", bytes),
                      opt("timeout", _num)),
     "object_info": _m("object_info", req("object_id", bytes),
@@ -138,6 +142,11 @@ RPC_SCHEMAS: Dict[str, Message] = {
         req("resources", dict), opt("strategy", bytes),
         opt("pg", (tuple, list)), opt("runtime_env", dict),
         opt("grant_only_local", bool), opt("job_id", bytes)),
+    # coalesced grants: up to N same-shape leases in one round trip
+    "request_worker_leases": _m(
+        "request_worker_leases", req("lease_ids", list),
+        req("resources", dict), opt("runtime_env", dict),
+        opt("job_id", bytes)),
     "return_worker": _m("return_worker", req("lease_id", bytes),
                         opt("disconnect", bool)),
     "register_worker": _m("register_worker", req("worker_id", bytes),
@@ -157,6 +166,9 @@ RPC_SCHEMAS: Dict[str, Message] = {
                          req("actor_id", bytes), req("job_id", bytes),
                          opt("name", str), opt("namespace", str),
                          opt("max_restarts", int)),
+    # coalesced unnamed-actor registration (one RPC per driver-side burst)
+    "register_actors": _m("register_actors", req("specs", list),
+                          req("job_id", bytes)),
     "report_resources": _m("report_resources", req("node_id", bytes),
                            req("snapshot", dict), req("seq", int),
                            opt("pending", list), opt("stats", dict),

@@ -48,41 +48,62 @@ def cmd_start(args) -> int:
     labels = json.loads(args.labels) if args.labels else {}
 
     if args.head:
-        from ray_tpu.gcs.server import GcsServer
-        from ray_tpu.raylet.raylet import Raylet
+        head = None
+        if args.control_plane_procs or GLOBAL_CONFIG.get(
+                "control_plane_procs"):
+            # multi-process shape: GCS + raylet in their own processes;
+            # this CLI process supervises them (and hosts the dashboard /
+            # client server, which are ordinary RPC clients of the GCS)
+            from ray_tpu.control_plane import ProcHead
 
-        gcs = GcsServer(args.host, args.port, persist_dir=args.persist_dir)
-        gcs.start()
-        raylet = Raylet(gcs.address, resources=resources or None,
-                        labels=labels or None)
-        gcs.attach_export_logger(raylet.session_dir)
-        raylet.start()
+            head = ProcHead(resources=resources or None,
+                            labels=labels or None,
+                            persist_dir=args.persist_dir,
+                            host=args.host, port=args.port,
+                            system_config=GLOBAL_CONFIG.system_config_json())
+            gcs_address = head.gcs_address
+            session_dir = head.session_dir
+            stops = [head.stop]
+        else:
+            from ray_tpu.gcs.server import GcsServer
+            from ray_tpu.raylet.raylet import Raylet
+
+            gcs = GcsServer(args.host, args.port,
+                            persist_dir=args.persist_dir)
+            gcs.start()
+            raylet = Raylet(gcs.address, resources=resources or None,
+                            labels=labels or None)
+            gcs.attach_export_logger(raylet.session_dir)
+            raylet.start()
+            gcs_address = gcs.address
+            session_dir = raylet.session_dir
+            stops = [lambda: raylet.stop(), lambda: gcs.stop()]
         dash = None
         if args.dashboard:
             from ray_tpu.dashboard import Dashboard
 
-            dash = Dashboard(gcs.address, raylet.session_dir,
+            dash = Dashboard(gcs_address, session_dir,
                              port=args.dashboard_port)
             dash.start()
         cserver = None
         if args.client_server:
             from ray_tpu.client import ClientServer
 
-            cserver = ClientServer(gcs.address, port=args.client_port)
+            cserver = ClientServer(gcs_address, port=args.client_port)
             cserver.start()
         _write_pidfile("head")
-        print(f"RAY_TPU_HEAD {gcs.address[0]}:{gcs.address[1]}", flush=True)
+        print(f"RAY_TPU_HEAD {gcs_address[0]}:{gcs_address[1]}", flush=True)
         if dash is not None:
             print(f"RAY_TPU_DASHBOARD {dash.url}", flush=True)
         if cserver is not None:
             print(f"RAY_TPU_CLIENT ray://{cserver.address[0]}:"
                   f"{cserver.address[1]}", flush=True)
         print("To connect: ray_tpu.init(address="
-              f"'{gcs.address[0]}:{gcs.address[1]}')", flush=True)
-        _block([lambda: raylet.stop(), lambda: gcs.stop()]
-               + ([lambda: dash.stop()] if dash else [])
-               + ([lambda: cserver.stop()] if cserver else []))
-        return 0
+              f"'{gcs_address[0]}:{gcs_address[1]}')", flush=True)
+        return _block(([lambda: dash.stop()] if dash else [])
+                      + ([lambda: cserver.stop()] if cserver else [])
+                      + stops,
+                      fatal=(lambda: head.fatal) if head else None)
     if not args.address:
         print("either --head or --address is required", file=sys.stderr)
         return 2
@@ -95,11 +116,13 @@ def cmd_start(args) -> int:
     _write_pidfile("node")
     print(f"RAY_TPU_NODE {raylet.server.address[0]}:"
           f"{raylet.server.address[1]}", flush=True)
-    _block([lambda: raylet.stop()])
-    return 0
+    return _block([lambda: raylet.stop()])
 
 
-def _block(stops):
+def _block(stops, fatal=None) -> int:
+    """Serve until SIGTERM/SIGINT — or until ``fatal()`` reports a dead
+    control-plane process (multi-process head), which tears down and
+    exits nonzero instead of serving a half-dead cluster."""
     stop_now = {"flag": False}
 
     def handler(_sig, _frm):
@@ -107,8 +130,16 @@ def _block(stops):
 
     signal.signal(signal.SIGTERM, handler)
     signal.signal(signal.SIGINT, handler)
+    rc = 0
     try:
         while not stop_now["flag"]:
+            if fatal is not None:
+                err = fatal()
+                if err is not None:
+                    print(f"RAY_TPU_FATAL {err}", file=sys.stderr,
+                          flush=True)
+                    rc = 1
+                    break
             time.sleep(0.2)
     finally:
         for s in stops:
@@ -116,6 +147,7 @@ def _block(stops):
                 s()
             except Exception:  # noqa: BLE001
                 pass
+    return rc
 
 
 def cmd_stop(_args) -> int:
@@ -322,6 +354,10 @@ def main(argv=None) -> int:
     ps.add_argument("--labels", help="JSON dict")
     ps.add_argument("--persist-dir", help="GCS fault-tolerance log dir")
     ps.add_argument("--system-config", help="JSON dict")
+    ps.add_argument("--control-plane-procs", action="store_true",
+                    help="head: run the GCS and raylet as dedicated OS "
+                    "processes (multi-process deployment shape) instead "
+                    "of on this process's IO loop")
     ps.set_defaults(fn=cmd_start)
 
     pstop = sub.add_parser("stop", help="stop nodes started on this machine")
@@ -375,9 +411,15 @@ def main(argv=None) -> int:
     if "--" in argv:
         cut = argv.index("--")
         argv, entrypoint = argv[:cut], argv[cut + 1:]
-    args = p.parse_args(argv)
+    # parse_known_args, not parse_args: argparse matches the greedy `rest`
+    # positional BEFORE later optionals, so `job status --address URL SID`
+    # leaves SID "unrecognized" — fold non-flag leftovers back into rest
+    args, extra = p.parse_known_args(argv)
+    stray_flags = [a for a in extra if a.startswith("-")]
+    if stray_flags or (extra and getattr(args, "job_cmd", None) is None):
+        p.error(f"unrecognized arguments: {' '.join(extra)}")
     if getattr(args, "job_cmd", None) is not None:
-        rest = list(getattr(args, "rest", []) or [])
+        rest = list(getattr(args, "rest", []) or []) + list(extra)
         if args.job_cmd == "submit":
             args.entrypoint = entrypoint or rest
             if not args.entrypoint:

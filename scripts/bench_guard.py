@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Bench regression guard: diff a fresh BENCH_core.json against the
+checked-in one and fail loudly on a same-box regression of the round-8
+target rows.
+
+The checked-in BENCH_core.json is the committed performance record (its
+values were measured on the box named in its captions); a fresh run on
+the SAME box that loses more than ``--threshold`` (default 15%) on any
+guarded row means a regression slipped into the runtime.  Cross-box
+comparisons are meaningless (PERF_PLAN.md hardware notes) — run this only
+against numbers recorded on comparable hardware, e.g. as the opt-in
+``RT_BENCH_GUARD=1`` stage of scripts/run_tests.sh which produces the
+fresh file and diffs it in one session.
+
+Usage:
+    python scripts/bench_guard.py --fresh /tmp/bench/BENCH_core.json \
+        [--checked-in BENCH_core.json] [--threshold 0.15]
+
+Exit codes: 0 = within tolerance, 1 = regression, 2 = bad/missing input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# The round-8 target rows (ISSUE 6 / PERF_PLAN round-8 acceptance): the
+# three throughput rows the native-dispatch + warm-pool + control-plane
+# work is graded on.
+GUARDED_ROWS = (
+    "multi_client_tasks_async",
+    "actors_per_second",
+    "tasks_per_second_10k_pending",
+)
+
+
+def _rows(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return {r["metric"]: r for r in doc.get("results", [])}
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fresh", required=True,
+                   help="BENCH_core.json from the run under test")
+    p.add_argument("--checked-in",
+                   default=os.path.join(repo_root, "BENCH_core.json"),
+                   help="committed reference (default: repo BENCH_core.json)")
+    p.add_argument("--threshold", type=float, default=0.15,
+                   help="max tolerated fractional regression (default 0.15)")
+    args = p.parse_args(argv)
+
+    for path in (args.fresh, args.checked_in):
+        if not os.path.exists(path):
+            print(f"bench_guard: missing {path}", file=sys.stderr)
+            return 2
+    fresh = _rows(args.fresh)
+    ref = _rows(args.checked_in)
+
+    failures = []
+    for metric in GUARDED_ROWS:
+        if metric not in ref:
+            print(f"bench_guard: {metric}: not in checked-in file — "
+                  "skipping", file=sys.stderr)
+            continue
+        if metric not in fresh:
+            failures.append(f"{metric}: missing from fresh run "
+                            "(bench crashed before this row?)")
+            continue
+        want = float(ref[metric]["value"])
+        got = float(fresh[metric]["value"])
+        delta = (got - want) / want if want else 0.0
+        verdict = "OK" if delta >= -args.threshold else "REGRESSION"
+        print(f"bench_guard: {metric:32s} checked-in={want:10.1f} "
+              f"fresh={got:10.1f} delta={delta:+.1%} {verdict}")
+        if verdict != "OK":
+            failures.append(
+                f"{metric}: {want:.1f} -> {got:.1f} ({delta:+.1%}, "
+                f"tolerance -{args.threshold:.0%})")
+    if failures:
+        print("bench_guard: FAILED", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("bench_guard: all guarded rows within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,5 +54,30 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
     bash scripts/flake_triage.sh -n "$TRIAGE_RUNS" "${failed_files[@]}" \
     | tee -a "$RUN_LOG"
 fi
+# Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench
+# fresh and diff the round-8 target rows against the committed
+# BENCH_core.json (>15% same-box regression fails the run). Off by
+# default — the bench needs minutes and quiet CPUs.
+if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
+  echo "bench guard: running bench_core.py (this takes minutes)..." \
+    | tee -a "$RUN_LOG"
+  BG_DIR=$(mktemp -d /tmp/rt_bench_guard.XXXXXX)
+  if (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 1800 \
+        python "$OLDPWD/bench_core.py" > bench.log 2>&1); then
+    # subshell pipefail: the verdict must be bench_guard's exit status,
+    # not tee's
+    if (set -o pipefail; python scripts/bench_guard.py \
+        --fresh "$BG_DIR/BENCH_core.json" | tee -a "$RUN_LOG"); then
+      echo "bench guard: ok" | tee -a "$RUN_LOG"
+    else
+      echo "bench guard: REGRESSION (see above)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
+  else
+    echo "bench guard: bench run itself failed (log: $BG_DIR/bench.log)" \
+      | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
+fi
 echo "run log: $RUN_LOG"
 [[ $fail -eq 0 ]]

@@ -27,6 +27,12 @@ os.environ.setdefault("RT_worker_factory_procs", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Pin the partitionable-threefry RNG regime for the WHOLE session before
+# any test draws random values: ray_tpu.parallel.sharding flips it on
+# jax < 0.5 (sharded-init parity — see _ensure_partitionable_rng), and a
+# mid-session flip would hand earlier tests a different stream than later
+# ones.
+import ray_tpu.parallel.sharding  # noqa: E402,F401
 assert jax.default_backend() == "cpu", (
     "tests must run on the virtual CPU mesh, got " + jax.default_backend())
 assert jax.device_count() == 8

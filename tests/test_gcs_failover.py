@@ -117,6 +117,41 @@ def test_env_var_standby_wiring(primary, tmp_path, monkeypatch):
         c.close()
 
 
+def test_promotion_across_process_boundary(tmp_path):
+    """The primary GCS runs as a real OS process (the multi-process
+    control-plane shape, ray_tpu/control_plane.py); a warm standby in
+    THIS process replicates from it over the wire, the primary process is
+    SIGKILLed — no clean shutdown, a true crash — and the standby still
+    promotes with the replicated state and serves clients that rotate."""
+    from ray_tpu.control_plane import launch_gcs
+
+    proc, addr = launch_gcs(str(tmp_path / "session"),
+                            persist_dir=str(tmp_path / "primary"))
+    sb = None
+    c = None
+    try:
+        c = GcsClient(addr, standby_addresses=())
+        c.kv_put("ns", b"cross-proc", b"survives")
+        sb = GcsStandby(addr, str(tmp_path / "replica"),
+                        poll_interval_s=0.1, failure_threshold=3).start()
+        _wait(lambda: sb._offset > 0, msg="replication from the process")
+        proc.kill()  # SIGKILL: the GCS gets no chance to flush or say bye
+        _wait(sb.promoted.is_set, timeout=30.0, msg="promotion")
+        c2 = GcsClient(sb.address)
+        try:
+            assert c2.kv_get("ns", b"cross-proc") == b"survives"
+            info = c2.call("get_leader_info")
+            assert info["epoch"] >= 2 and not info["deposed"]
+        finally:
+            c2.close()
+    finally:
+        if c is not None:
+            c.close()
+        if sb is not None:
+            sb.stop()
+        proc.stop(grace_s=2.0)
+
+
 def test_unpromoted_standby_reports_state(primary, tmp_path):
     sb = GcsStandby(primary.address, str(tmp_path / "replica"),
                     poll_interval_s=0.1).start()
