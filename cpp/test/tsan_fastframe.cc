@@ -1,22 +1,45 @@
-// ThreadSanitizer/ASAN stress harness for the fastloop wire layer
-// (ray_tpu/rpc/native/fastframe.h) — the frame codec + robust fd writer
-// shared by the native dispatch channel (actor calls AND the lease-cached
-// normal-task channel). The production concurrency shape is reproduced
-// exactly: N writer threads share one connection fd behind a mutex (as
-// fastloop's send_reply/inline-reply paths do), one reader thread parses
-// the interleaved stream with ff_next_frame into a growing buffer (as
-// both server_dispatch and client_main do).
+// ThreadSanitizer/ASAN/UBSAN stress harness for the fastloop wire layer
+// (ray_tpu/rpc/native/fastframe.h) — the frame codec, the robust fd
+// writer, and the fastspec-v2 record codec shared by the native dispatch
+// channel (actor calls AND the lease-cached normal-task channel).
+//
+// Three scenarios, each reproducing a production concurrency shape:
+//
+//   scenario_frames      N writer threads share one connection fd behind
+//                        a mutex (fastloop's send_reply/inline-reply
+//                        paths); one reader thread parses the
+//                        interleaved stream with ff_next_frame into a
+//                        growing buffer (server_dispatch / client_main).
+//
+//   scenario_records     same concurrent-writer shape, but every frame
+//                        payload is a packed fastspec-v2 task record
+//                        (ff_task_write) and the reader re-parses each
+//                        record (ff_task_parse) and verifies every blob
+//                        — the lease-cached dispatch channel's actual
+//                        payload path.
+//
+//   scenario_reply_slots the production C-reader-thread shape on the
+//                        client side: caller threads write requests and
+//                        block on fixed reply slots; an echo peer
+//                        answers; ONE reader thread completes slots via
+//                        the pending-map handoff, and every slot is
+//                        REUSED for the caller's next request (the
+//                        Python client's req_id->future dict, modeled at
+//                        C level so TSAN sees the slot lifecycle).
 //
 //   g++ -O1 -g -fsanitize=thread -std=c++17 -Iray_tpu/rpc/native \
 //       cpp/test/tsan_fastframe.cc -o /tmp/tsan_fastframe -lpthread \
 //       && /tmp/tsan_fastframe
 //
-// Exit 0 + no TSAN report = pass. scripts/run_tsan.sh wraps this.
+// Exit 0 + no sanitizer report = pass. scripts/run_tsan.sh wraps this
+// (TSAN, ASAN+UBSAN, and gcc -fanalyzer stages).
 
 #include <cassert>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <sys/socket.h>
 #include <thread>
@@ -29,14 +52,59 @@ static constexpr int kWriters = 4;
 static constexpr int kFramesPerWriter = 2000;
 static constexpr uint32_t kMaxPayload = 700;
 
-// payload bytes are derived from the req_id so the reader can verify
+// payload bytes are derived from the req_id so readers can verify
 // content integrity without shared state
 static void fill_payload(uint64_t req_id, char *buf, uint32_t len) {
     for (uint32_t i = 0; i < len; i++)
         buf[i] = (char)((req_id * 131 + i) & 0xff);
 }
 
-int main() {
+static uint32_t len_for(uint64_t req_id) {
+    return (uint32_t)((req_id * 2654435761u) % kMaxPayload);
+}
+
+// growth/compaction read loop copied from the production read loops;
+// calls `on_frame` for every complete frame
+template <typename F>
+static long read_loop(int rfd, long want, F &&on_frame) {
+    unsigned char *buf = nullptr;
+    size_t cap = 0, len = 0;
+    long received = 0;
+    while (received < want) {
+        if (cap - len < 65536) {
+            size_t ncap = cap ? cap * 2 : 131072;
+            while (ncap - len < 65536) ncap *= 2;
+            buf = (unsigned char *)realloc(buf, ncap);
+            cap = ncap;
+        }
+        ssize_t n = read(rfd, buf + len, cap - len);
+        if (n <= 0) break;
+        len += (size_t)n;
+        size_t off = 0;
+        for (;;) {
+            uint64_t req_id;
+            const unsigned char *payload;
+            uint32_t plen;
+            int fr = ff_next_frame(buf, len, &off, &req_id, &payload,
+                                   &plen);
+            if (fr < 0) { free(buf); return -1; }
+            if (fr == 0) break;
+            on_frame(req_id, payload, plen);
+            received++;
+        }
+        if (off > 0) {
+            memmove(buf, buf + off, len - off);
+            len -= off;
+        }
+    }
+    free(buf);
+    return received;
+}
+
+// ------------------------------------------------------------------
+// Scenario 1: concurrent frame writers vs one parsing reader
+// ------------------------------------------------------------------
+static int scenario_frames() {
     int sv[2];
     if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
         perror("socketpair");
@@ -50,10 +118,9 @@ int main() {
         writers.emplace_back([&, w] {
             char payload[kMaxPayload];
             for (int i = 0; i < kFramesPerWriter; i++) {
-                // distinct id spaces per writer; id encodes (writer, seq)
                 uint64_t req_id =
                     ((uint64_t)(w + 1) << 32) | (uint64_t)(i + 1);
-                uint32_t len = (uint32_t)((req_id * 2654435761u) % kMaxPayload);
+                uint32_t len = len_for(req_id);
                 fill_payload(req_id, payload, len);
                 std::lock_guard<std::mutex> g(wmutex);
                 if (ff_write_frame_fd(wfd, req_id, payload, len) != 0) {
@@ -64,48 +131,22 @@ int main() {
         });
     }
 
-    long received = 0, bad = 0;
+    long bad = 0;
+    std::vector<int> next_seq(kWriters + 1, 1);
+    long received = 0;
     std::thread reader([&] {
-        // growth/compaction loop copied from the production read loops
-        unsigned char *buf = nullptr;
-        size_t cap = 0, len = 0;
-        const long want = (long)kWriters * kFramesPerWriter;
-        std::vector<int> next_seq(kWriters + 1, 1);
-        while (received < want) {
-            if (cap - len < 65536) {
-                size_t ncap = cap ? cap * 2 : 131072;
-                while (ncap - len < 65536) ncap *= 2;
-                buf = (unsigned char *)realloc(buf, ncap);
-                cap = ncap;
-            }
-            ssize_t n = read(rfd, buf + len, cap - len);
-            if (n <= 0) break;
-            len += (size_t)n;
-            size_t off = 0;
-            for (;;) {
-                uint64_t req_id;
-                const unsigned char *payload;
-                uint32_t plen;
-                int fr = ff_next_frame(buf, len, &off, &req_id, &payload,
-                                       &plen);
-                if (fr < 0) { bad++; break; }
-                if (fr == 0) break;
-                int w = (int)(req_id >> 32), seq = (int)(req_id & 0xffffffffu);
+        received = read_loop(
+            rfd, (long)kWriters * kFramesPerWriter,
+            [&](uint64_t req_id, const unsigned char *payload,
+                uint32_t plen) {
+                int w = (int)(req_id >> 32),
+                    seq = (int)(req_id & 0xffffffffu);
                 if (w < 1 || w > kWriters || seq != next_seq[w]++) bad++;
-                uint32_t want_len =
-                    (uint32_t)((req_id * 2654435761u) % kMaxPayload);
-                if (plen != want_len) bad++;
+                if (plen != len_for(req_id)) bad++;
                 char expect[kMaxPayload];
                 fill_payload(req_id, expect, plen);
                 if (plen && memcmp(payload, expect, plen) != 0) bad++;
-                received++;
-            }
-            if (off > 0) {
-                memmove(buf, buf + off, len - off);
-                len -= off;
-            }
-        }
-        free(buf);
+            });
     });
 
     for (auto &t : writers) t.join();
@@ -114,7 +155,7 @@ int main() {
     close(wfd);
     close(rfd);
 
-    // corrupt-length guard: a poisoned prefix must be rejected, not parsed
+    // corrupt-length guard: a poisoned prefix must be rejected
     unsigned char evil[FF_HDR_SIZE] = {0};
     ff_put_u32(evil, FF_MAX_FRAME + 1);
     size_t off = 0;
@@ -127,7 +168,274 @@ int main() {
     }
 
     const long want = (long)kWriters * kFramesPerWriter;
-    printf("fastframe: %ld/%ld frames, %ld integrity failures\n", received,
-           want, bad);
+    printf("frames:      %ld/%ld frames, %ld integrity failures\n",
+           received, want, bad);
     return (received == want && bad == 0) ? 0 : 1;
+}
+
+// ------------------------------------------------------------------
+// Scenario 2: fastspec-v2 records packed by concurrent writers,
+// parsed + blob-verified by the reader
+// ------------------------------------------------------------------
+static void fill_record(uint64_t req_id, std::vector<unsigned char> &store,
+                        ff_task_record *rec) {
+    rec->num_returns = (uint32_t)(req_id & 0x7);
+    rec->port = (uint32_t)(req_id & 0xffff);
+    // blob lengths vary per (req_id, blob index); contents derived so
+    // the reader verifies without shared state
+    size_t total = 0;
+    uint32_t lens[FF_TASK_NBLOBS];
+    for (unsigned b = 0; b < FF_TASK_NBLOBS; b++) {
+        lens[b] = (uint32_t)((req_id * 31 + b * 7) % 97);
+        total += lens[b];
+    }
+    store.resize(total);
+    size_t off = 0;
+    for (unsigned b = 0; b < FF_TASK_NBLOBS; b++) {
+        for (uint32_t i = 0; i < lens[b]; i++)
+            store[off + i] = (unsigned char)((req_id * 17 + b * 131 + i)
+                                             & 0xff);
+        rec->blobs[b].ptr = store.data() + off;
+        rec->blobs[b].len = lens[b];
+        off += lens[b];
+    }
+}
+
+static int scenario_records() {
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        perror("socketpair");
+        return 1;
+    }
+    const int wfd = sv[0], rfd = sv[1];
+    std::mutex wmutex;
+
+    std::vector<std::thread> writers;
+    for (int w = 0; w < kWriters; w++) {
+        writers.emplace_back([&, w] {
+            std::vector<unsigned char> store, packed;
+            for (int i = 0; i < kFramesPerWriter; i++) {
+                uint64_t req_id =
+                    ((uint64_t)(w + 1) << 32) | (uint64_t)(i + 1);
+                ff_task_record rec;
+                fill_record(req_id, store, &rec);
+                packed.resize(ff_task_size(&rec));
+                size_t n = ff_task_write(&rec, packed.data());
+                if (n != packed.size()) abort();
+                std::lock_guard<std::mutex> g(wmutex);
+                if (ff_write_frame_fd(wfd, req_id,
+                                      (const char *)packed.data(),
+                                      packed.size()) != 0)
+                    abort();
+            }
+        });
+    }
+
+    long bad = 0;
+    long received = 0;
+    std::thread reader([&] {
+        received = read_loop(
+            rfd, (long)kWriters * kFramesPerWriter,
+            [&](uint64_t req_id, const unsigned char *payload,
+                uint32_t plen) {
+                ff_task_record rec;
+                if (ff_task_parse(payload, plen, &rec) != 0) {
+                    bad++;
+                    return;
+                }
+                std::vector<unsigned char> store;
+                ff_task_record want;
+                fill_record(req_id, store, &want);
+                if (rec.num_returns != want.num_returns ||
+                    rec.port != want.port)
+                    bad++;
+                for (unsigned b = 0; b < FF_TASK_NBLOBS; b++) {
+                    if (rec.blobs[b].len != want.blobs[b].len ||
+                        (rec.blobs[b].len &&
+                         memcmp(rec.blobs[b].ptr, want.blobs[b].ptr,
+                                rec.blobs[b].len) != 0))
+                        bad++;
+                }
+            });
+    });
+
+    for (auto &t : writers) t.join();
+    shutdown(wfd, SHUT_WR);
+    reader.join();
+    close(wfd);
+    close(rfd);
+
+    // corrupt-record guards: truncation and bad magic must be rejected
+    {
+        std::vector<unsigned char> store, packed;
+        ff_task_record rec;
+        fill_record(0x123456789abcdefULL, store, &rec);
+        packed.resize(ff_task_size(&rec));
+        ff_task_write(&rec, packed.data());
+        ff_task_record out;
+        if (ff_task_parse(packed.data(), packed.size() - 1, &out) == 0) {
+            fprintf(stderr, "truncated record accepted\n");
+            return 1;
+        }
+        packed[0] ^= 0xff;
+        if (ff_task_parse(packed.data(), packed.size(), &out) == 0) {
+            fprintf(stderr, "bad-magic record accepted\n");
+            return 1;
+        }
+    }
+
+    const long want = (long)kWriters * kFramesPerWriter;
+    printf("records:     %ld/%ld records, %ld integrity failures\n",
+           received, want, bad);
+    return (received == want && bad == 0) ? 0 : 1;
+}
+
+// ------------------------------------------------------------------
+// Scenario 3: reply-slot reuse — callers block on fixed slots, one
+// reader thread completes them via the pending map, slots are reused
+// ------------------------------------------------------------------
+struct ReplySlot {
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    std::vector<unsigned char> payload;
+};
+
+static int scenario_reply_slots() {
+    constexpr int kCallers = 3;
+    constexpr int kReqsPerCaller = 1500;
+
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        perror("socketpair");
+        return 1;
+    }
+    const int cfd = sv[0]; // client side: callers write, reader reads
+    const int pfd = sv[1]; // peer side: echo server
+
+    // echo peer: reads request frames, replies with transformed payload
+    // on the same req_id (a worker's deferred send_reply)
+    std::thread peer([&] {
+        std::mutex pmutex;
+        read_loop(pfd, (long)kCallers * kReqsPerCaller,
+                  [&](uint64_t req_id, const unsigned char *payload,
+                      uint32_t plen) {
+                      std::vector<char> reply(plen);
+                      for (uint32_t i = 0; i < plen; i++)
+                          reply[i] = (char)(payload[i] ^ 0x5a);
+                      std::lock_guard<std::mutex> g(pmutex);
+                      if (ff_write_frame_fd(pfd, req_id, reply.data(),
+                                            plen) != 0)
+                          abort();
+                  });
+        shutdown(pfd, SHUT_WR);
+    });
+
+    // the pending map: req_id -> slot, exactly the client's
+    // req_id -> future dict
+    std::mutex pending_mutex;
+    std::map<uint64_t, ReplySlot *> pending;
+    std::mutex wmutex; // client connection write mutex (Client_call)
+
+    // ONE reader thread completes slots — the production C reader
+    long orphan = 0;
+    std::thread reader([&] {
+        read_loop(cfd, (long)kCallers * kReqsPerCaller,
+                  [&](uint64_t req_id, const unsigned char *payload,
+                      uint32_t plen) {
+                      ReplySlot *slot = nullptr;
+                      {
+                          std::lock_guard<std::mutex> g(pending_mutex);
+                          auto it = pending.find(req_id);
+                          if (it != pending.end()) {
+                              slot = it->second;
+                              pending.erase(it);
+                          }
+                      }
+                      if (!slot) { orphan++; return; }
+                      {
+                          // notify UNDER the slot mutex: signalling after
+                          // unlock races the woken caller destroying /
+                          // reusing the slot (TSAN catches the
+                          // cond-destroy race if this regresses)
+                          std::lock_guard<std::mutex> g(slot->m);
+                          slot->payload.assign(payload, payload + plen);
+                          slot->done = true;
+                          slot->cv.notify_one();
+                      }
+                  });
+    });
+
+    // callers: each owns ONE slot and reuses it for every request
+    std::vector<long> caller_bad(kCallers, 0);
+    std::vector<std::thread> callers;
+    for (int c = 0; c < kCallers; c++) {
+        callers.emplace_back([&, c] {
+            ReplySlot slot; // reused across all of this caller's calls
+            char payload[kMaxPayload];
+            for (int i = 0; i < kReqsPerCaller; i++) {
+                uint64_t req_id =
+                    ((uint64_t)(c + 1) << 32) | (uint64_t)(i + 1);
+                uint32_t len = len_for(req_id);
+                fill_payload(req_id, payload, len);
+                // reset + register the slot BEFORE the write: the reply
+                // can arrive before the writer returns
+                {
+                    std::lock_guard<std::mutex> g(slot.m);
+                    slot.done = false;
+                    slot.payload.clear();
+                }
+                {
+                    std::lock_guard<std::mutex> g(pending_mutex);
+                    pending[req_id] = &slot;
+                }
+                {
+                    std::lock_guard<std::mutex> g(wmutex);
+                    if (ff_write_frame_fd(cfd, req_id, payload, len) != 0)
+                        abort();
+                }
+                std::unique_lock<std::mutex> lk(slot.m);
+                slot.cv.wait(lk, [&] { return slot.done; });
+                if (slot.payload.size() != len) caller_bad[c]++;
+                for (uint32_t b = 0; b < len && b < slot.payload.size();
+                     b++)
+                    if (slot.payload[b] !=
+                        (unsigned char)(payload[b] ^ 0x5a))
+                        caller_bad[c]++;
+            }
+        });
+    }
+
+    for (auto &t : callers) t.join();
+    shutdown(cfd, SHUT_WR);
+    peer.join();
+    reader.join();
+    close(cfd);
+    close(pfd);
+
+    long bad = orphan;
+    for (long b : caller_bad) bad += b;
+    printf("reply_slots: %d callers x %d reqs, %ld failures\n", kCallers,
+           kReqsPerCaller, bad);
+    return bad == 0 ? 0 : 1;
+}
+
+int main() {
+    // keep ff_get_u32/ff_get_u64/ff_put_u64 under direct sanitizer
+    // coverage too (the analysis pass requires every fastframe.h export
+    // referenced here): round-trip the byte helpers
+    unsigned char scratch[12];
+    ff_put_u32(scratch, 0xdeadbeefu);
+    ff_put_u64(scratch + 4, 0x0123456789abcdefULL);
+    if (ff_get_u32(scratch) != 0xdeadbeefu ||
+        ff_get_u64(scratch + 4) != 0x0123456789abcdefULL) {
+        fprintf(stderr, "byte codec round-trip failed\n");
+        return 1;
+    }
+
+    int rc = 0;
+    rc |= scenario_frames();
+    rc |= scenario_records();
+    rc |= scenario_reply_slots();
+    return rc;
 }

@@ -1,0 +1,25 @@
+"""rt-analyze — project-native static analysis (see ANALYSIS.md).
+
+The invariants this package enforces were each learned the expensive way
+(PERF_PLAN rounds 8-9): nothing blocks an event loop, nothing recompiles
+in a steady-state jitted hot path, the native wire layer stays race-free
+and sanitizer-covered, and the RPC wire schema can't silently drift from
+its handlers.  Every pass is AST/structural — no imports of the analyzed
+code — so the suite runs in seconds and is safe in CI
+(``scripts/run_analysis.sh``, gated in ``scripts/run_tests.sh``).
+"""
+
+from ray_tpu.analysis.core import (AnalysisContext, AnalysisPass, Baseline,
+                                   Finding, get_pass, iter_passes,
+                                   register_pass, run_passes)
+
+__all__ = [
+    "AnalysisContext",
+    "AnalysisPass",
+    "Baseline",
+    "Finding",
+    "get_pass",
+    "iter_passes",
+    "register_pass",
+    "run_passes",
+]

@@ -1,0 +1,105 @@
+"""CLI: ``python -m ray_tpu.analysis`` — run the rt-analyze suite.
+
+Exit codes: 0 = clean (or suppressed), 1 = findings above baseline,
+2 = bad usage / broken baseline.  See ANALYSIS.md for the workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from ray_tpu.analysis.core import (AnalysisContext, Baseline,
+                                   DEFAULT_BASELINE, iter_passes,
+                                   run_passes)
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="project-native static analysis "
+                    "(loop-blocker, jit-recompile-hazard, "
+                    "native-race-audit, rpc-schema-drift)")
+    p.add_argument("--root", default=repo_root,
+                   help="repo root to analyze (default: this checkout)")
+    p.add_argument("--passes", default="",
+                   help="comma-separated pass ids (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help=f"suppression file (default: <root>/"
+                        f"{DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the suppression file (show everything)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings into the baseline "
+                        "file and exit 0 (each entry still needs a "
+                        "hand-written reason before it parses in CI)")
+    p.add_argument("--list-passes", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="only the summary line")
+    args = p.parse_args(argv)
+
+    if args.list_passes:
+        for ps in iter_passes():
+            print(f"{ps.id:24s} {ps.description}")
+        return 0
+
+    pass_ids = [s.strip() for s in args.passes.split(",") if s.strip()]
+    known = {ps.id for ps in iter_passes()}
+    for pid in pass_ids:
+        if pid not in known:
+            print(f"unknown pass {pid!r}; known: {sorted(known)}",
+                  file=sys.stderr)
+            return 2
+
+    ctx = AnalysisContext(args.root)
+    t0 = time.monotonic()
+    findings = run_passes(
+        ctx, pass_ids or None,
+        progress=None if args.quiet
+        else (lambda pid: print(f"== {pid} ==", file=sys.stderr)))
+    elapsed = time.monotonic() - t0
+
+    baseline_path = args.baseline or os.path.join(ctx.root,
+                                                  DEFAULT_BASELINE)
+    if args.write_baseline:
+        # preserve existing argued reasons (lenient load: a half-edited
+        # file with TODOs must not block reseeding); only NEW
+        # fingerprints get the TODO placeholder — which load() rejects
+        # in CI until a real reason replaces it
+        existing = Baseline.load(baseline_path, strict=False)
+        existing.save(baseline_path, findings,
+                      comment=Baseline.TODO_COMMENT)
+        print(f"wrote {len(set(f.fingerprint() for f in findings))} "
+              f"fingerprints to {baseline_path} (existing reasons "
+              "preserved; TODO entries won't parse in CI until argued)")
+        return 0
+
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except ValueError as e:
+            print(f"broken baseline: {e}", file=sys.stderr)
+            return 2
+
+    new, suppressed, stale = baseline.split(findings)
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"note: stale baseline entry (fixed? refactored?): {fp}",
+                  file=sys.stderr)
+    n_passes = len(pass_ids) if pass_ids else len(known)
+    print(f"rt-analyze: {n_passes} passes, {len(findings)} findings "
+          f"({len(new)} above baseline, {len(suppressed)} suppressed, "
+          f"{len(stale)} stale suppressions) in {elapsed:.1f}s")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

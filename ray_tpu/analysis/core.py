@@ -1,0 +1,307 @@
+"""Framework for the rt-analyze passes: findings, registry, baseline.
+
+Design goals (ISSUE 8):
+- **Stable fingerprints.**  A finding is suppressed by *what* it is
+  (pass, file, enclosing symbol, rule, subject), never by line number —
+  a refactor that moves code must not invalidate the baseline, and a NEW
+  hazard in a touched function must not ride an old suppression.
+- **No imports of analyzed code.**  Passes work on source text / ASTs,
+  so analyzing ``ray_tpu/raylet/raylet.py`` cannot start a raylet, and
+  the suite stays O(seconds).
+- **Two suppression channels.**  The committed ``analysis_baseline.txt``
+  (argued false positives, each with a reason comment) and inline
+  ``# rt-analyze: ok(<pass-id>) — reason`` comments for point waivers
+  that belong next to the code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_BASELINE = "analysis_baseline.txt"
+
+# inline waiver: "# rt-analyze: ok(pass-id[,pass-id...]) — reason"
+_INLINE_RE = re.compile(r"rt-analyze:\s*ok\(([^)]*)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    pass_id: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    context: str       # enclosing function/class qualname, or file symbol
+    code: str          # short rule code, e.g. "blocking-call"
+    subject: str       # what tripped the rule, e.g. "time.sleep"
+    message: str
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the suppression baseline."""
+        return "|".join((self.pass_id, self.path, self.context, self.code,
+                         self.subject))
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}/{self.code}] "
+                f"{self.context}: {self.message}")
+
+
+class AnalysisContext:
+    """Shared file access for the passes: cached source + ASTs + inline
+    waivers, rooted at the repo checkout."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._source: Dict[str, str] = {}
+        self._trees: Dict[str, ast.AST] = {}
+        self._waived_lines: Dict[str, Dict[int, Tuple[str, ...]]] = {}
+
+    # ------------------------------------------------------------ files
+    def rel(self, path: str) -> str:
+        return os.path.relpath(os.path.join(self.root, path),
+                               self.root).replace(os.sep, "/")
+
+    def exists(self, relpath: str) -> bool:
+        return os.path.exists(os.path.join(self.root, relpath))
+
+    def source(self, relpath: str) -> str:
+        if relpath not in self._source:
+            with open(os.path.join(self.root, relpath), "r",
+                      encoding="utf-8", errors="replace") as f:
+                self._source[relpath] = f.read()
+        return self._source[relpath]
+
+    def tree(self, relpath: str) -> ast.AST:
+        if relpath not in self._trees:
+            self._trees[relpath] = ast.parse(self.source(relpath),
+                                             filename=relpath)
+        return self._trees[relpath]
+
+    def glob(self, patterns: Sequence[str],
+             exclude: Sequence[str] = ()) -> List[str]:
+        """Repo-relative paths matching any pattern (``**`` aware),
+        skipping __pycache__ and anything matching ``exclude``."""
+        out: List[str] = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", "build")]
+            for fname in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, fname),
+                                      self.root).replace(os.sep, "/")
+                if any(_match(rel, p) for p in patterns) and \
+                        not any(_match(rel, p) for p in exclude):
+                    out.append(rel)
+        return sorted(out)
+
+    # ---------------------------------------------------- inline waivers
+    def waived(self, relpath: str, line: int, pass_id: str) -> bool:
+        """True when ``line`` (or its enclosing statement's first line)
+        carries an inline ``# rt-analyze: ok(<pass-id>)`` waiver."""
+        if relpath not in self._waived_lines:
+            try:
+                self._waived_lines[relpath] = self._scan_waivers(relpath)
+            except OSError:
+                # findings may point at files that no longer exist
+                # (missing-file findings); nothing to waive there
+                self._waived_lines[relpath] = {}
+        passes = self._waived_lines[relpath].get(line, ())
+        return pass_id in passes or "*" in passes
+
+    def _scan_waivers(self, relpath: str) -> Dict[int, Tuple[str, ...]]:
+        out: Dict[int, Tuple[str, ...]] = {}
+        src = self.source(relpath)
+        try:
+            tokens = tokenize.generate_tokens(iter(src.splitlines(True)
+                                                   ).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _INLINE_RE.search(tok.string)
+                if m:
+                    ids = tuple(p.strip() for p in m.group(1).split(",")
+                                if p.strip())
+                    out[tok.start[0]] = ids or ("*",)
+        except tokenize.TokenError:
+            pass
+        return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None — the shared call-
+    target resolver used by the AST passes."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _match(rel: str, pattern: str) -> bool:
+    """Path-aware glob: ``*`` stays within a segment, ``**/`` matches
+    zero or more segments (fnmatch's ``*`` crosses ``/`` and its ``**``
+    demands one, both wrong here)."""
+    regex = ""
+    i = 0
+    while i < len(pattern):
+        if pattern.startswith("**/", i):
+            regex += "(?:[^/]+/)*"
+            i += 3
+        elif pattern.startswith("**", i):
+            regex += ".*"
+            i += 2
+        elif pattern[i] == "*":
+            regex += "[^/]*"
+            i += 1
+        elif pattern[i] == "?":
+            regex += "[^/]"
+            i += 1
+        else:
+            regex += re.escape(pattern[i])
+            i += 1
+    return re.fullmatch(regex, rel) is not None
+
+
+# --------------------------------------------------------------- registry
+class AnalysisPass:
+    """Base class: subclass, set ``id``/``description``, implement
+    :meth:`run`, and decorate with :func:`register_pass`."""
+
+    id: str = ""
+    description: str = ""
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        raise NotImplementedError
+
+    # helper for subclasses: drop findings carrying an inline waiver
+    def _apply_waivers(self, ctx: AnalysisContext,
+                       findings: Iterable[Finding]) -> List[Finding]:
+        return [f for f in findings
+                if not ctx.waived(f.path, f.line, f.pass_id)]
+
+
+_REGISTRY: Dict[str, AnalysisPass] = {}
+
+
+def register_pass(cls: type) -> type:
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"{cls.__name__} has no pass id")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def iter_passes() -> List[AnalysisPass]:
+    _load_builtin_passes()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_pass(pass_id: str) -> AnalysisPass:
+    _load_builtin_passes()
+    return _REGISTRY[pass_id]
+
+
+def _load_builtin_passes() -> None:
+    # import for side effect: each module registers its pass
+    from ray_tpu.analysis import passes  # noqa: F401
+
+
+# --------------------------------------------------------------- baseline
+class Baseline:
+    """The committed suppression file: one fingerprint per line, inline
+    ``#`` comment REQUIRED (every suppression is an argued false
+    positive — the argument lives next to the entry)."""
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None):
+        self.entries: Dict[str, str] = dict(entries or {})
+
+    #: the placeholder --write-baseline seeds; load() rejects it so an
+    #: unargued suppression can never pass CI
+    TODO_COMMENT = "TODO: argue why this is a false positive"
+
+    @classmethod
+    def load(cls, path: str, strict: bool = True) -> "Baseline":
+        """Parse the baseline.  ``strict`` (the CI path) rejects entries
+        without a real reason comment; ``strict=False`` keeps whatever
+        is there (used by --write-baseline to preserve existing argued
+        reasons while reseeding)."""
+        entries: Dict[str, str] = {}
+        if not os.path.exists(path):
+            return cls(entries)
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                fp, sep, comment = line.partition("#")
+                fp = fp.strip()
+                comment = comment.strip()
+                if strict and (not sep or not comment
+                               or comment.startswith("TODO")):
+                    raise ValueError(
+                        f"{path}:{lineno}: baseline entry without an "
+                        f"argued reason comment (every suppression must "
+                        f"say why it is a false positive): {line!r}")
+                if fp.count("|") != 4:
+                    raise ValueError(
+                        f"{path}:{lineno}: malformed fingerprint "
+                        f"(want pass|path|context|code|subject): {fp!r}")
+                entries[fp] = comment
+        return cls(entries)
+
+    def save(self, path: str, findings: Sequence[Finding],
+             comment: str = "seeded by --write-baseline") -> None:
+        lines = [
+            "# rt-analyze suppression baseline — see ANALYSIS.md.",
+            "# One fingerprint per line:",
+            "#   pass|path|context|code|subject  # why this is a false positive",
+            "# The reason comment is REQUIRED; entries without one fail to parse.",
+            "",
+        ]
+        seen = set()
+        for f in sorted(findings, key=lambda f: f.fingerprint()):
+            fp = f.fingerprint()
+            if fp in seen:
+                continue
+            seen.add(fp)
+            lines.append(f"{fp}  # {self.entries.get(fp, comment)}")
+        with open(path, "w", encoding="utf-8") as out:
+            out.write("\n".join(lines) + "\n")
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """Partition findings into (new, suppressed) and list baseline
+        fingerprints that matched nothing (stale — fixed or refactored)."""
+        new: List[Finding] = []
+        suppressed: List[Finding] = []
+        used = set()
+        for f in findings:
+            fp = f.fingerprint()
+            if fp in self.entries:
+                suppressed.append(f)
+                used.add(fp)
+            else:
+                new.append(f)
+        stale = [fp for fp in self.entries if fp not in used]
+        return new, suppressed, stale
+
+
+def run_passes(ctx: AnalysisContext,
+               pass_ids: Optional[Sequence[str]] = None,
+               progress: Optional[Callable[[str], None]] = None
+               ) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in iter_passes():
+        if pass_ids and p.id not in pass_ids:
+            continue
+        if progress:
+            progress(p.id)
+        findings.extend(p.run(ctx))
+    return findings

@@ -1,0 +1,448 @@
+"""jit-recompile-hazard — the PR 3 bug family, caught statically.
+
+PR 3 paid a 20x step-cost regression to learn that variable-shape
+``.at[idx].set`` scatters executed EAGERLY compile one program per
+index-vector length; PR 3's fix was a once-compiled fixed-shape
+``where()``.  The other members of the family: Python-value branching on
+tracers (``TracerBoolConversionError`` at best, silent retrace at
+worst), ``int()``/``.item()`` concretization inside jit, and
+unhashable/numpy-array static args (every call is a cache miss).
+
+Jitted scopes found statically:
+- functions decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+  ``@partial(jax.jit, ...)`` / ``@shard_map`` variants;
+- functions wrapped at assignment or call sites: ``f = jax.jit(g)``,
+  ``jax.jit(fn, ...)`` — this is how the ``make_*`` program builders in
+  ``models/decoding.py`` produce their programs;
+- lambdas passed directly to ``jax.jit(...)``.
+
+Taint model (deliberately simple, tuned against this tree): function
+parameters minus declared static args are traced; assignment propagates
+taint; ``x.shape``/``x.ndim``/``x.dtype``/``x.size``/``len(x)`` are
+STATIC at trace time and break taint — branching on shapes is fine and
+common, so flagging it would bury the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding,
+                                   dotted_name as _dotted, register_pass)
+
+DEFAULT_PATHS = (
+    "ray_tpu/models/**/*.py",
+    "ray_tpu/serve/**/*.py",
+    "ray_tpu/rl/**/*.py",
+    "ray_tpu/ops/**/*.py",
+    "ray_tpu/train/**/*.py",
+    "ray_tpu/collective/**/*.py",
+    "ray_tpu/parallel/**/*.py",
+    "ray_tpu/llm/**/*.py",
+)
+EXCLUDE_PATHS = ()
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit",
+              "shard_map", "jax.experimental.shard_map.shard_map"}
+# attributes whose access yields a trace-time STATIC value
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+# calls returning static values from traced args
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id"}
+# concretization calls: Python value out of a tracer
+_CONCRETIZE_CALLS = {"int", "float", "bool", "complex"}
+_CONCRETIZE_METHODS = {"item", "tolist", "__index__"}
+_SCATTER_METHODS = {"set", "add", "mul", "min", "max", "get", "apply",
+                    "divide", "power"}
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and (d in _JIT_NAMES or d.endswith(".jit")
+                              or d.endswith(".pjit")
+                              or d.endswith(".shard_map"))
+
+
+def _static_args_from_call(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums / static_argnames from a jit(...) call node."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+def _jit_decoration(fn: ast.AST) -> Optional[ast.Call]:
+    """The jit/pjit/shard_map decorator call on a def, if any.  Returns a
+    synthetic empty Call for bare ``@jax.jit`` decorators."""
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            if _is_jit_callable(dec.func):
+                return dec
+            # @partial(jax.jit, static_argnames=...)
+            d = _dotted(dec.func)
+            if d in ("partial", "functools.partial") and dec.args and \
+                    _is_jit_callable(dec.args[0]):
+                return dec
+        elif _is_jit_callable(dec):
+            return ast.Call(func=dec, args=[], keywords=[])
+    return None
+
+
+class _TaintScanner:
+    """Scan one jitted function body with a taint set of traced names."""
+
+    def __init__(self, tainted: Set[str], static_names: Set[str]):
+        self.tainted = set(tainted) - static_names
+        self.static_names = set(static_names)
+        self.findings: List[Tuple[int, str, str, str]] = []
+
+    # -------------------------------------------------------------- taint
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        """Does evaluating ``node`` produce a traced (non-static) value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] is static; x[0] of traced x is traced
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in _STATIC_CALLS:
+                return False
+            if d in _CONCRETIZE_CALLS:
+                # int(t) — flagged separately; result is "python", and
+                # flagging downstream uses too would double-report
+                return False
+            # method call on traced receiver, or traced args → traced
+            if isinstance(node.func, ast.Attribute) and \
+                    self._expr_tainted(node.func.value):
+                return True
+            return any(self._expr_tainted(a) for a in node.args)
+        if isinstance(node, (ast.BinOp,)):
+            return self._expr_tainted(node.left) or \
+                self._expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a trace-time identity test
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self._expr_tainted(node.left) or \
+                any(self._expr_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._expr_tainted(node.body) or \
+                self._expr_tainted(node.orelse)
+        return False
+
+    # --------------------------------------------------------------- walk
+    def scan(self, fn: ast.AST) -> None:
+        for stmt in fn.body:
+            self._walk(stmt)
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Assign):
+            taint = self._expr_tainted(node.value)
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        if taint:
+                            self.tainted.add(n.id)
+                        else:
+                            self.tainted.discard(n.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            if self._expr_tainted(node.test):
+                self.findings.append(
+                    (node.lineno, "tracer-branch",
+                     ast.unparse(node.test)[:60],
+                     "Python-value branch on a traced value — raises "
+                     "TracerBoolConversionError or silently retraces; "
+                     "use jnp.where / lax.cond / lax.select"))
+        elif isinstance(node, ast.Assert):
+            if self._expr_tainted(node.test):
+                self.findings.append(
+                    (node.lineno, "tracer-branch",
+                     ast.unparse(node.test)[:60],
+                     "assert on a traced value concretizes it; use "
+                     "checkify or drop the assert"))
+        elif isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _check_call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        # int(t) / float(t) / bool(t)
+        if d in _CONCRETIZE_CALLS and node.args and \
+                self._expr_tainted(node.args[0]):
+            self.findings.append(
+                (node.lineno, "concretize", f"{d}()",
+                 f"`{d}()` on a traced value forces a concrete Python "
+                 "value — host sync + retrace per distinct value; keep "
+                 "it on-device"))
+            return
+        # t.item() / t.tolist()
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONCRETIZE_METHODS and \
+                self._expr_tainted(node.func.value):
+            self.findings.append(
+                (node.lineno, "concretize", f".{node.func.attr}()",
+                 f"`.{node.func.attr}()` on a traced value forces a "
+                 "host sync; keep the value on-device"))
+            return
+        # np.asarray(traced) inside jit
+        if d in ("np.asarray", "np.array", "numpy.asarray",
+                 "numpy.array") and node.args and \
+                self._expr_tainted(node.args[0]):
+            self.findings.append(
+                (node.lineno, "concretize", d,
+                 f"`{d}` on a traced value concretizes it inside jit"))
+            return
+        # closure-shape scatter: .at[np.flatnonzero(...)]-style index
+        self._check_scatter(node)
+
+    def _check_scatter(self, node: ast.Call) -> None:
+        # shape: <expr>.at[<index>].set(...)  — node is the .set call
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _SCATTER_METHODS
+                and isinstance(f.value, ast.Subscript)
+                and isinstance(f.value.value, ast.Attribute)
+                and f.value.value.attr == "at"):
+            return
+        index = f.value.slice
+        if _index_is_variable_length(index):
+            self.findings.append(
+                (node.lineno, "variable-scatter",
+                 ast.unparse(index)[:60],
+                 "`.at[...]` scatter with a host-built index vector — "
+                 "inside jit the vector is baked per trace; each "
+                 "distinct length compiles a new program (the PR 3 "
+                 "cascade); use a fixed-shape mask/where instead"))
+
+
+def _index_is_variable_length(index: ast.AST) -> bool:
+    """Host-built, data-dependent-length index expressions: np.* calls
+    (nonzero/where/flatnonzero/array-of-list), list displays, and list
+    comprehensions.  Constant ints, slices, traced names, and tuples of
+    those are fine."""
+    if isinstance(index, (ast.Constant, ast.Slice, ast.Name)):
+        return False
+    if isinstance(index, ast.Tuple):
+        return any(_index_is_variable_length(e) for e in index.elts)
+    if isinstance(index, (ast.List, ast.ListComp)):
+        return True
+    if isinstance(index, ast.Call):
+        d = _dotted(index.func) or ""
+        head = d.split(".")[0]
+        tail = d.split(".")[-1]
+        if head in ("np", "numpy") and tail in (
+                "array", "asarray", "nonzero", "flatnonzero", "where",
+                "argwhere", "concatenate", "arange"):
+            # np.arange(CONST) is fixed-length; flag only when its args
+            # aren't all constants
+            if tail == "arange" and all(
+                    isinstance(a, ast.Constant) for a in index.args):
+                return False
+            return True
+    return False
+
+
+class _EagerScatterScanner:
+    """Flag eager variable-length scatters in loops — the literal PR 3
+    shape: `cache = cache.at[idx].set(vals)` per engine step."""
+
+    def __init__(self):
+        self.findings: List[Tuple[int, str, str, str]] = []
+
+    def scan_module(self, tree: ast.AST,
+                    jitted_ids: Set[int]) -> None:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(fn) in jitted_ids:
+                continue
+            self._scan_fn(fn)
+
+    def _scan_fn(self, fn: ast.AST) -> None:
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr in _SCATTER_METHODS
+                        and isinstance(f.value, ast.Subscript)
+                        and isinstance(f.value.value, ast.Attribute)
+                        and f.value.value.attr == "at"):
+                    continue
+                index = f.value.slice
+                # eager: ANY non-constant index in a loop is shape-keyed
+                # compilation per distinct length
+                if isinstance(index, (ast.Constant, ast.Slice)):
+                    continue
+                if isinstance(index, ast.Tuple) and all(
+                        isinstance(e, (ast.Constant, ast.Slice))
+                        for e in index.elts):
+                    continue
+                self.findings.append(
+                    (node.lineno, "eager-scatter",
+                     ast.unparse(index)[:60],
+                     "eager `.at[...]` scatter inside a loop — every "
+                     "distinct index-vector shape compiles its own "
+                     "program (20x step cost in PR 3); hoist into a "
+                     "jitted fixed-shape update or install via a "
+                     "once-compiled where()"))
+
+
+@register_pass
+class JitRecompilePass(AnalysisPass):
+    id = "jit-recompile-hazard"
+    description = ("tracer branches, int()/.item() concretization, "
+                   "variable-length .at[] scatters, and unhashable "
+                   "static args in jitted scopes")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for relpath in ctx.glob(DEFAULT_PATHS, exclude=EXCLUDE_PATHS):
+            findings.extend(self._analyze_module(ctx, relpath))
+        return self._apply_waivers(ctx, findings)
+
+    # ------------------------------------------------------------ helpers
+    def _analyze_module(self, ctx: AnalysisContext,
+                        relpath: str) -> List[Finding]:
+        tree = ctx.tree(relpath)
+        findings: List[Finding] = []
+
+        # name -> def node for wrap-site resolution (f = jax.jit(g))
+        defs: Dict[str, ast.AST] = {}
+        qualname: Dict[int, str] = {}
+
+        def _collect(node: ast.AST, stack: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    defs.setdefault(child.name, child)
+                    qualname[id(child)] = ".".join(stack + [child.name])
+                    _collect(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    _collect(child, stack + [child.name])
+                else:
+                    _collect(child, stack)
+
+        _collect(tree, [])
+
+        # jitted scopes: (def node, static_argnums, static_argnames)
+        jitted: List[Tuple[ast.AST, Set[int], Set[str]]] = []
+        jitted_ids: Set[int] = set()
+
+        for name, fn in defs.items():
+            dec = _jit_decoration(fn)
+            if dec is not None:
+                nums, names = _static_args_from_call(dec)
+                jitted.append((fn, nums, names))
+                jitted_ids.add(id(fn))
+
+        # wrap sites: jax.jit(g, ...) anywhere in the module
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit_callable(node.func) and node.args):
+                continue
+            target = node.args[0]
+            nums, names = _static_args_from_call(node)
+            if isinstance(target, ast.Name) and target.id in defs:
+                fn = defs[target.id]
+                if id(fn) not in jitted_ids:
+                    jitted.append((fn, nums, names))
+                    jitted_ids.add(id(fn))
+            elif isinstance(target, ast.Lambda):
+                # scan the lambda body as a single expression
+                scanner = _TaintScanner(
+                    {a.arg for a in target.args.args}, names)
+                if scanner._expr_tainted(target.body) and isinstance(
+                        target.body, ast.IfExp):
+                    findings.append(Finding(
+                        self.id, relpath, target.lineno, "<lambda>",
+                        "tracer-branch", ast.unparse(target.body)[:60],
+                        "conditional on a traced value in a jitted "
+                        "lambda; use jnp.where"))
+            # unhashable static args at the wrap/call site
+            findings.extend(self._check_static_args(
+                relpath, node, nums, names))
+
+        for fn, nums, names in jitted:
+            params = [a.arg for a in fn.args.args
+                      if a.arg not in ("self", "cls")]
+            static = set(names)
+            for i in nums:
+                if i < len(params):
+                    static.add(params[i])
+            scanner = _TaintScanner(set(params), static)
+            scanner.scan(fn)
+            qual = qualname.get(id(fn), fn.name)
+            for line, code, subject, msg in scanner.findings:
+                findings.append(Finding(self.id, relpath, line, qual,
+                                        code, subject, msg))
+
+        # eager scatter cascade (the literal PR 3 bug) outside jit
+        eager = _EagerScatterScanner()
+        eager.scan_module(tree, jitted_ids)
+        for line, code, subject, msg in eager.findings:
+            ctx_name = self._enclosing(tree, line)
+            findings.append(Finding(self.id, relpath, line, ctx_name,
+                                    code, subject, msg))
+        return findings
+
+    def _check_static_args(self, relpath: str, call: ast.Call,
+                           nums: Set[int],
+                           names: Set[str]) -> List[Finding]:
+        """jit(fn, static_argnames=...) where a same-expression call site
+        can't be checked; what IS checkable statically: a static arg
+        bound to a list/dict/np.array literal in THIS call's keywords
+        (e.g. partial application patterns)."""
+        out: List[Finding] = []
+        for kw in call.keywords:
+            if kw.arg in names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)):
+                out.append(Finding(
+                    self.id, relpath, kw.value.lineno,
+                    _dotted(call.func) or "jit", "unhashable-static",
+                    kw.arg,
+                    f"static arg `{kw.arg}` bound to an unhashable "
+                    "literal — every call is a jit cache miss; pass a "
+                    "tuple"))
+        return out
+
+    @staticmethod
+    def _enclosing(tree: ast.AST, line: int) -> str:
+        best = "<module>"
+        best_span = None
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.lineno <= line and \
+                    (fn.end_lineno or fn.lineno) >= line:
+                span = (fn.end_lineno or fn.lineno) - fn.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = fn.name, span
+        return best

@@ -1,0 +1,359 @@
+"""loop-blocker — blocking calls reachable from event-loop contexts.
+
+The PR 7 lesson: the raylet's 100ms report tick did ``/proc`` + shm stat
+reads ON the IO loop; ~45% of loop samples under fork churn, ping p90
+50ms, found only by SIGUSR1 stack sampling.  This pass makes that bug
+class (and the rest of the family: ``time.sleep``, sync file/socket IO,
+``subprocess.*``, sync GCS/raylet RPC helpers, ``IoContext.run`` on the
+loop itself) fail analysis instead of needing a profiler.
+
+What counts as an event-loop context:
+- the body of every ``async def`` (coroutines and async generators);
+- sync functions registered as loop callbacks (``call_soon``,
+  ``call_later``, ``call_at``, ``call_soon_threadsafe``,
+  ``add_done_callback``, ``add_reader``/``add_writer``,
+  ``add_signal_handler``);
+- ONE level of sync helpers called directly from either of the above and
+  defined in the same module/class — the call-graph walk that catches
+  ``async def f(): self._helper()`` where the helper blocks.
+
+What does NOT count (the false-positive guards that make the pass
+usable): nested ``def``/``lambda`` bodies are only scanned when the
+async body actually calls them — a sync closure handed to
+``asyncio.to_thread``/``run_in_executor`` is exactly the *fix* for this
+bug class, and callables passed as to_thread arguments are references,
+not calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding,
+                                   dotted_name as _dotted, register_pass)
+
+# dotted-name calls that block the calling thread outright
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)` or move the caller "
+                  "off-loop",
+    "subprocess.run": "run it via `asyncio.to_thread` or "
+                      "`asyncio.create_subprocess_exec`",
+    "subprocess.call": "run it via `asyncio.to_thread`",
+    "subprocess.check_call": "run it via `asyncio.to_thread`",
+    "subprocess.check_output": "run it via `asyncio.to_thread`",
+    "subprocess.getoutput": "run it via `asyncio.to_thread`",
+    "subprocess.getstatusoutput": "run it via `asyncio.to_thread`",
+    "subprocess.Popen": "fork+exec stalls the loop ~10ms (PERF_PLAN "
+                        "round-8 boot trace); wrap in asyncio.to_thread",
+    "os.unlink": "unlink(2) was the hottest syscall of the small-task "
+                 "loop (PR 6); move it off-loop",
+    "os.remove": "move it off-loop (see os.unlink)",
+    "os.rename": "move it off-loop",
+    "os.replace": "move it off-loop",
+    "os.rmdir": "move it off-loop",
+    "os.makedirs": "move it off-loop",
+    "os.listdir": "directory scan blocks; wrap in asyncio.to_thread",
+    "os.scandir": "directory scan blocks; wrap in asyncio.to_thread",
+    "shutil.rmtree": "tree removal blocks; wrap in asyncio.to_thread",
+    "shutil.copy": "wrap in asyncio.to_thread",
+    "shutil.copy2": "wrap in asyncio.to_thread",
+    "shutil.copytree": "wrap in asyncio.to_thread",
+    "shutil.move": "wrap in asyncio.to_thread",
+    "urllib.request.urlopen": "sync HTTP on the loop; use to_thread or "
+                              "an async client",
+    "socket.create_connection": "sync connect on the loop",
+    "requests.get": "sync HTTP on the loop",
+    "requests.post": "sync HTTP on the loop",
+    "requests.put": "sync HTTP on the loop",
+    "requests.request": "sync HTTP on the loop",
+}
+
+_OPEN_CALLS = {"open", "io.open"}
+
+# attribute calls that block regardless of receiver module
+_ATTR_BLOCKING = {
+    "read_text": "file read blocks; wrap in asyncio.to_thread",
+    "read_bytes": "file read blocks; wrap in asyncio.to_thread",
+    "write_text": "file write blocks; wrap in asyncio.to_thread",
+    "write_bytes": "file write blocks; wrap in asyncio.to_thread",
+    "communicate": "blocks until the child exits; use to_thread or the "
+                   "asyncio subprocess API",
+}
+
+# sync GCS/raylet RPC helper names (gcs/client.py typed accessors); only
+# flagged when the receiver names a control-plane client
+_SYNC_RPC_HELPERS = {
+    "call", "kv_put", "kv_get", "kv_del", "kv_keys", "get_all_nodes",
+    "cluster_resources", "register_node", "get_actor", "list_actors",
+    "get_next_job_id", "register_job", "finish_job",
+}
+_RPC_RECEIVER_TOKENS = ("gcs", "raylet")
+
+# loop-callback registrars: method name -> index of the callback argument
+_CALLBACK_REGISTRARS = {
+    "call_soon": 0,
+    "call_soon_threadsafe": 0,
+    "call_later": 1,
+    "call_at": 1,
+    "add_done_callback": 0,
+    "add_reader": 1,
+    "add_writer": 1,
+    "add_signal_handler": 1,
+}
+
+DEFAULT_PATHS = (
+    "ray_tpu/*.py",
+    "ray_tpu/raylet/**/*.py",
+    "ray_tpu/gcs/**/*.py",
+    "ray_tpu/core_worker/**/*.py",
+    "ray_tpu/rpc/**/*.py",
+    "ray_tpu/dashboard/**/*.py",
+    "ray_tpu/autoscaler/**/*.py",
+    "ray_tpu/job/**/*.py",
+    "ray_tpu/client/**/*.py",
+    "ray_tpu/serve/**/*.py",
+    "ray_tpu/runtime_env/**/*.py",
+    "ray_tpu/object_store/**/*.py",
+    "ray_tpu/scheduling/**/*.py",
+    "ray_tpu/util/**/*.py",
+)
+EXCLUDE_PATHS = ("ray_tpu/analysis/**",)
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """qualname -> def node, plus class method maps, for call resolution."""
+
+    def __init__(self):
+        self.functions: Dict[str, ast.AST] = {}   # module-level + nested
+        self.methods: Dict[str, Dict[str, ast.AST]] = {}  # class -> name
+        self.qualnames: Dict[int, str] = {}        # id(node) -> qualname
+        self._stack: List[str] = []
+        self._class: Optional[str] = None
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        prev = self._class
+        self._class = node.name
+        self.methods.setdefault(node.name, {})
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+        self._class = prev
+
+    def _visit_def(self, node):
+        qual = ".".join(self._stack + [node.name])
+        self.qualnames[id(node)] = qual
+        if self._class and len(self._stack) >= 1 \
+                and self._stack[-1] == self._class:
+            self.methods[self._class][node.name] = node
+        self.functions.setdefault(node.name, node)
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+class _BodyScanner:
+    """Scan one function body (without descending into nested defs) for
+    blocking calls and direct calls to same-module sync helpers."""
+
+    def __init__(self, index: _ModuleIndex, cls: Optional[str]):
+        self.index = index
+        self.cls = cls
+        self.blocking: List[Tuple[int, str, str, str]] = []
+        #               (line, code, subject, advice)
+        self.called: List[Tuple[ast.AST, int]] = []  # resolved def, line
+        self.registered_callbacks: List[Tuple[ast.AST, int]] = []
+
+    def scan(self, fn_node: ast.AST) -> None:
+        for stmt in fn_node.body:
+            self._walk(stmt)
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs run only when called
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    # ---------------------------------------------------------- the rules
+    def _check_call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        line = node.lineno
+        if dotted is None:
+            return
+        if dotted in _BLOCKING_CALLS:
+            self.blocking.append((line, "blocking-call", dotted,
+                                  _BLOCKING_CALLS[dotted]))
+            return
+        if dotted in _OPEN_CALLS:
+            self.blocking.append(
+                (line, "blocking-open", dotted,
+                 "file IO on the loop; wrap the open+read/write in a sync "
+                 "def and run it via asyncio.to_thread"))
+            return
+        parts = dotted.split(".")
+        tail = parts[-1]
+        if len(parts) >= 2 and tail in _ATTR_BLOCKING:
+            self.blocking.append((line, "blocking-call", dotted,
+                                  _ATTR_BLOCKING[tail]))
+            return
+        # sync RPC helper on a control-plane client receiver
+        if len(parts) >= 2 and tail in _SYNC_RPC_HELPERS:
+            receiver = ".".join(parts[:-1]).lower()
+            if any(t in receiver for t in _RPC_RECEIVER_TOKENS):
+                self.blocking.append(
+                    (line, "sync-rpc", dotted,
+                     "sync RPC parks the loop on a network round trip "
+                     "(and self-deadlocks when the server shares the "
+                     "loop); use the *_async variant"))
+                return
+        # IoContext.run blocks the calling thread on the loop — called
+        # FROM the loop it deadlocks outright
+        if len(parts) >= 2 and tail == "run" \
+                and parts[-2] in ("_io", "io", "ioctx", "_ioctx"):
+            self.blocking.append(
+                (line, "loop-reentrant-block", dotted,
+                 "IoContext.run blocks its caller on the loop; from a "
+                 "coroutine this deadlocks — await the coroutine "
+                 "directly"))
+            return
+        # loop-callback registration: the callback becomes loop context
+        if tail in _CALLBACK_REGISTRARS:
+            idx = _CALLBACK_REGISTRARS[tail]
+            if len(node.args) > idx:
+                resolved = self._resolve(node.args[idx])
+                if resolved is not None and \
+                        not isinstance(resolved, ast.AsyncFunctionDef):
+                    self.registered_callbacks.append((resolved, line))
+            return
+        # plain same-module call: candidate for the one-level walk
+        resolved = self._resolve(node.func)
+        if resolved is not None and \
+                not isinstance(resolved, ast.AsyncFunctionDef):
+            self.called.append((resolved, line))
+
+    def _resolve(self, node: ast.AST) -> Optional[ast.AST]:
+        """Resolve a Name / self.attr reference to a same-module def."""
+        if isinstance(node, ast.Name):
+            return self.index.functions.get(node.id)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and self.cls:
+            return self.index.methods.get(self.cls, {}).get(node.attr)
+        return None
+
+
+@register_pass
+class LoopBlockerPass(AnalysisPass):
+    id = "loop-blocker"
+    description = ("blocking calls (sleep/file/socket/subprocess/sync RPC) "
+                   "reachable inside async defs and loop callbacks, with a "
+                   "one-level call-graph walk")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for relpath in ctx.glob(DEFAULT_PATHS, exclude=EXCLUDE_PATHS):
+            findings.extend(self._analyze_module(ctx, relpath))
+        return self._apply_waivers(ctx, findings)
+
+    def _analyze_module(self, ctx: AnalysisContext,
+                        relpath: str) -> List[Finding]:
+        tree = ctx.tree(relpath)
+        index = _ModuleIndex()
+        index.visit(tree)
+
+        # enclosing class per def (for self.* resolution)
+        owner_class: Dict[int, Optional[str]] = {}
+
+        def _annotate(node: ast.AST, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    _annotate(child, child.name)
+                else:
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        owner_class[id(child)] = cls
+                    _annotate(child, cls)
+
+        _annotate(tree, None)
+
+        findings: List[Finding] = []
+        seen_sites: Set[Tuple[int, str, str]] = set()
+
+        def _emit(line: int, code: str, subject: str, advice: str,
+                  context: str, via: str = "") -> None:
+            key = (line, code, subject)
+            if key in seen_sites:
+                return
+            seen_sites.add(key)
+            msg = f"`{subject}` {advice}"
+            if via:
+                msg += f" [{via}]"
+            findings.append(Finding(self.id, relpath, line, context, code,
+                                    subject, msg))
+
+        # roots: every async def + every loop-registered sync callback
+        all_defs = [(n, index.qualnames[id(n)])
+                    for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and id(n) in index.qualnames]
+        async_defs = [(n, q) for n, q in all_defs
+                      if isinstance(n, ast.AsyncFunctionDef)]
+
+        scanned_helpers: Set[int] = set()
+        callback_roots: List[Tuple[ast.AST, str]] = []
+
+        # module-wide registrar sweep: loop callbacks can be registered
+        # from SYNC code (start()/setup() methods), so every function
+        # body — async or not — is searched for call_soon/call_later/
+        # add_done_callback/... registrations
+        for fn_node, qual in all_defs:
+            scanner = _BodyScanner(index, owner_class.get(id(fn_node)))
+            scanner.scan(fn_node)
+            for cb, reg_line in scanner.registered_callbacks:
+                if id(cb) not in scanned_helpers:
+                    scanned_helpers.add(id(cb))
+                    callback_roots.append(
+                        (cb, f"registered as loop callback from {qual}:"
+                             f"{reg_line}"))
+
+        def _scan_root(fn_node: ast.AST, qual: str, via: str = ""):
+            scanner = _BodyScanner(index, owner_class.get(id(fn_node)))
+            scanner.scan(fn_node)
+            for line, code, subject, advice in scanner.blocking:
+                _emit(line, code, subject, advice, qual, via)
+            return scanner
+
+        # pass 1: async bodies; collect one-level helper calls
+        helper_calls: List[Tuple[ast.AST, str, int]] = []
+        for fn_node, qual in async_defs:
+            scanner = _scan_root(fn_node, qual)
+            for helper, call_line in scanner.called:
+                helper_calls.append((helper, qual, call_line))
+
+        # pass 1b: loop-registered callbacks are loop context too
+        for cb, via in callback_roots:
+            cb_qual = index.qualnames.get(id(cb), "<callback>")
+            scanner = _scan_root(cb, cb_qual, via)
+            for helper, call_line in scanner.called:
+                helper_calls.append((helper, cb_qual, call_line))
+
+        # pass 2: ONE level into sync helpers called from loop context
+        scanned: Set[int] = set()
+        for helper, caller_qual, call_line in helper_calls:
+            if id(helper) in scanned or \
+                    isinstance(helper, ast.AsyncFunctionDef):
+                continue
+            scanned.add(id(helper))
+            helper_qual = index.qualnames.get(id(helper), helper.name)
+            scanner = _BodyScanner(index, owner_class.get(id(helper)))
+            scanner.scan(helper)
+            for line, code, subject, advice in scanner.blocking:
+                _emit(line, code, subject, advice, helper_qual,
+                      f"called from {caller_qual}:{call_line}")
+        return findings

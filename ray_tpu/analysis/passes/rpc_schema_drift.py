@@ -1,0 +1,255 @@
+"""rpc-schema-drift — wire schema vs handler signatures vs call sites.
+
+``rpc/schema.py`` is the explicit wire contract: the server validates
+inbound kwargs against it and STRIPS unknown fields before dispatch
+(rolling-upgrade rule).  That stripping is exactly what makes silent
+drift possible, in both directions:
+
+- a field declared in the schema but missing from the handler signature
+  passes validation and crashes the handler with a ``TypeError``;
+- a required handler parameter not declared as a required schema field
+  lets an old client omit it — ``TypeError`` again, at runtime;
+- a call site sending a kwarg the schema doesn't know gets it silently
+  stripped — a renamed field becomes a server-side default instead of a
+  loud failure (the "renamed field fails analysis instead of a runtime
+  KeyError" case this pass exists for);
+- a call site omitting a required field fails at runtime with a
+  ``SchemaError`` the test suite may never reach.
+
+Everything here is AST-only: the schema table, the ``h_<method>``
+handler defs in the GCS/raylet/worker services, and every
+``.call("m", kw=...)`` / ``.call_async("m", kw=...)`` site in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding,
+                                   register_pass)
+
+SCHEMA_FILE = "ray_tpu/rpc/schema.py"
+
+# the modules hosting h_<method> handlers for schema'd services
+HANDLER_FILES = (
+    "ray_tpu/gcs/server.py",
+    "ray_tpu/raylet/raylet.py",
+    "ray_tpu/core_worker/worker.py",
+)
+
+# where calls into schema'd methods live
+CALLSITE_PATHS = ("ray_tpu/**/*.py",)
+CALLSITE_EXCLUDE = ("ray_tpu/analysis/**",)
+
+
+class _SchemaField:
+    __slots__ = ("name", "required")
+
+    def __init__(self, name: str, required: bool):
+        self.name = name
+        self.required = required
+
+
+def _parse_schema_table(tree: ast.AST
+                        ) -> Dict[str, Tuple[List[_SchemaField], int]]:
+    """RPC_SCHEMAS = { "method": _m("name", req("f"), opt("g"),
+    Field("h", ..., required=False)), ... } -> {method: (fields, line)}"""
+    out: Dict[str, Tuple[List[_SchemaField], int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            target = node.targets[0] if node.targets else None
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "RPC_SCHEMAS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key, val in zip(node.value.keys, node.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(val, ast.Call)):
+                continue
+            method = key.value
+            fields: List[_SchemaField] = []
+            # _m("name", field, field, ...)
+            for arg in val.args[1:]:
+                f = _parse_field(arg)
+                if f is not None:
+                    fields.append(f)
+            out[method] = (fields, key.lineno)
+    return out
+
+
+def _parse_field(node: ast.AST) -> Optional[_SchemaField]:
+    if not isinstance(node, ast.Call):
+        return None
+    fname = node.func.id if isinstance(node.func, ast.Name) else None
+    if fname not in ("req", "opt", "Field"):
+        return None
+    if not (node.args and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)):
+        return None
+    name = node.args[0].value
+    if fname == "req":
+        return _SchemaField(name, True)
+    if fname == "opt":
+        return _SchemaField(name, False)
+    required = True  # Field(...) defaults to required=True
+    for kw in node.keywords:
+        if kw.arg == "required" and isinstance(kw.value, ast.Constant):
+            required = bool(kw.value.value)
+    return _SchemaField(name, required)
+
+
+class _Handler:
+    __slots__ = ("path", "line", "qual", "params", "required_params",
+                 "has_kwargs")
+
+    def __init__(self, path: str, node: ast.AST, qual: str):
+        self.path = path
+        self.line = node.lineno
+        self.qual = qual
+        args = node.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 if a.arg != "self"]
+        kwonly = [a.arg for a in args.kwonlyargs]
+        self.params: Set[str] = set(names) | set(kwonly)
+        n_defaults = len(args.defaults)
+        required = names[:len(names) - n_defaults] if n_defaults else names
+        required_kwonly = [a.arg for a, d in
+                           zip(args.kwonlyargs, args.kw_defaults)
+                           if d is None]
+        self.required_params: Set[str] = set(required) | set(required_kwonly)
+        self.has_kwargs = args.kwarg is not None
+
+
+def _collect_handlers(ctx: AnalysisContext) -> Dict[str, List[_Handler]]:
+    handlers: Dict[str, List[_Handler]] = {}
+    for relpath in HANDLER_FILES:
+        if not ctx.exists(relpath):
+            continue
+        tree = ctx.tree(relpath)
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name.startswith("h_"):
+                    method = fn.name[2:]
+                    handlers.setdefault(method, []).append(
+                        _Handler(relpath, fn, f"{cls.name}.{fn.name}"))
+    return handlers
+
+
+@register_pass
+class RpcSchemaDriftPass(AnalysisPass):
+    id = "rpc-schema-drift"
+    description = ("cross-checks rpc/schema.py message definitions "
+                   "against h_* handler signatures and call sites")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        if not ctx.exists(SCHEMA_FILE):
+            return []
+        schema = _parse_schema_table(ctx.tree(SCHEMA_FILE))
+        handlers = _collect_handlers(ctx)
+        findings: List[Finding] = []
+        findings.extend(self._check_handlers(schema, handlers))
+        findings.extend(self._check_call_sites(ctx, schema))
+        return self._apply_waivers(ctx, findings)
+
+    # ----------------------------------------------------- schema↔handler
+    def _check_handlers(self, schema, handlers) -> List[Finding]:
+        findings: List[Finding] = []
+        for method, (fields, line) in schema.items():
+            hs = handlers.get(method)
+            if not hs:
+                findings.append(Finding(
+                    self.id, SCHEMA_FILE, line, "RPC_SCHEMAS",
+                    "missing-handler", method,
+                    f"schema declares {method!r} but no h_{method} "
+                    "handler exists in any service module"))
+                continue
+            field_names = {f.name for f in fields}
+            required_names = {f.name for f in fields if f.required}
+            for h in hs:
+                if h.has_kwargs:
+                    continue
+                for f in fields:
+                    if f.name not in h.params:
+                        findings.append(Finding(
+                            self.id, h.path, h.line, h.qual,
+                            "field-not-in-handler",
+                            f"{method}.{f.name}",
+                            f"schema field {f.name!r} is validated and "
+                            f"passed through, but {h.qual} has no such "
+                            "parameter — TypeError at dispatch"))
+                for p in sorted(h.required_params - field_names):
+                    findings.append(Finding(
+                        self.id, h.path, h.line, h.qual,
+                        "param-not-in-schema", f"{method}.{p}",
+                        f"handler requires parameter {p!r} but the "
+                        f"schema for {method!r} doesn't declare it — "
+                        "the validator strips it from any client that "
+                        "sends it, so dispatch raises TypeError"))
+                for p in sorted(h.required_params & field_names
+                                - required_names):
+                    findings.append(Finding(
+                        self.id, h.path, h.line, h.qual,
+                        "optionality-drift", f"{method}.{p}",
+                        f"{p!r} is required by {h.qual} but optional in "
+                        "the schema — a client omitting it passes "
+                        "validation and crashes dispatch"))
+        return findings
+
+    # --------------------------------------------------------- call sites
+    def _check_call_sites(self, ctx: AnalysisContext,
+                          schema) -> List[Finding]:
+        findings: List[Finding] = []
+        for relpath in ctx.glob(CALLSITE_PATHS, exclude=CALLSITE_EXCLUDE):
+            tree = ctx.tree(relpath)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else None
+                if fname not in ("call", "call_async"):
+                    continue
+                if not (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                method = node.args[0].value
+                if method not in schema:
+                    continue
+                fields, _ = schema[method]
+                field_names = {f.name for f in fields}
+                required = {f.name for f in fields if f.required}
+                sent: Set[str] = set()
+                forwards_unknown = False
+                for kw in node.keywords:
+                    if kw.arg is None:   # **kwargs expansion
+                        forwards_unknown = True
+                    elif kw.arg == "timeout":
+                        continue         # transport arg, not a wire field
+                    else:
+                        sent.add(kw.arg)
+                for name in sorted(sent - field_names):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno,
+                        f"call({method!r})", "unknown-field-sent",
+                        f"{method}.{name}",
+                        f"call site sends {name!r} which the schema for "
+                        f"{method!r} doesn't declare — the server "
+                        "silently strips it (renamed field?)"))
+                if not forwards_unknown and sent:
+                    for name in sorted(required - sent):
+                        findings.append(Finding(
+                            self.id, relpath, node.lineno,
+                            f"call({method!r})", "missing-required-field",
+                            f"{method}.{name}",
+                            f"call site omits required field {name!r} "
+                            f"of {method!r} — SchemaError at runtime"))
+        return findings

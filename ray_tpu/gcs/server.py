@@ -1125,9 +1125,16 @@ class GcsServer:
             self.deposed = True
             self._deposed_by = int(epoch)
             if self._deposed_path:
+                def _persist(path=self._deposed_path,
+                             epoch=self._deposed_by):
+                    with open(path, "w") as f:
+                        f.write(str(epoch))
+
                 try:
-                    with open(self._deposed_path, "w") as f:
-                        f.write(str(self._deposed_by))
+                    # off-loop: the in-memory fence above already rejects
+                    # control-plane calls; the marker write is durability
+                    # only and must not park the (still-draining) loop
+                    await asyncio.to_thread(_persist)
                 except OSError:
                     logger.exception("could not persist deposition")
             logger.warning(

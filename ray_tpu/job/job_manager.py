@@ -133,21 +133,28 @@ class JobManager:
         env["RT_JOB_SUBMISSION_ID"] = info.submission_id
         if info.runtime_env:
             env["RT_JOB_RUNTIME_ENV"] = json.dumps(info.runtime_env)
-        logfile = open(self.log_path(info.submission_id), "ab")
+        def _spawn():
+            # open+fork off-loop (rt-analyze loop-blocker): the log file
+            # open and the fork both block; the child inherits the fd so
+            # the parent copy closes immediately after spawn
+            logfile = open(self.log_path(info.submission_id), "ab")
+            try:
+                return subprocess.Popen(
+                    ["bash", "-c", info.entrypoint], env=env,
+                    cwd=ctx.cwd or os.getcwd(),
+                    stdout=logfile, stderr=subprocess.STDOUT,
+                    start_new_session=True,  # stop_job kills the group
+                )
+            finally:
+                logfile.close()
+
         try:
-            proc = await asyncio.to_thread(
-                subprocess.Popen,
-                ["bash", "-c", info.entrypoint], env=env,
-                cwd=ctx.cwd or os.getcwd(),
-                stdout=logfile, stderr=subprocess.STDOUT,
-                start_new_session=True,  # stop_job kills the whole group
-            )
+            proc = await asyncio.to_thread(_spawn)
         except Exception as e:  # noqa: BLE001
             info.status = JobStatus.FAILED
             info.message = f"failed to start entrypoint: {e}"
             info.end_time = time.time()
             await self._save_async(info)
-            logfile.close()
             self._env_agent.release(ctx.env_key)
             return
         self._procs[info.submission_id] = proc
@@ -172,7 +179,6 @@ class JobManager:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 proc.kill()
-            logfile.close()
             self._procs.pop(info.submission_id, None)
             self._env_agent.release(ctx.env_key)
             return
@@ -180,7 +186,6 @@ class JobManager:
                     info.submission_id, proc.pid, info.entrypoint)
         while proc.poll() is None:
             await asyncio.sleep(0.2)
-        logfile.close()
         self._procs.pop(info.submission_id, None)
         self._env_agent.release(ctx.env_key)
 
@@ -245,27 +250,32 @@ class JobManager:
             pass
         return True
 
+    @staticmethod
+    def _read_chunk(path: str, pos: int) -> bytes:
+        """Blocking log read — runs via to_thread; one tailing dashboard
+        client must not park the shared IO loop on disk every 300ms."""
+        if not os.path.exists(path):
+            return b""
+        with open(path, "rb") as f:
+            f.seek(pos)
+            return f.read()
+
     async def tail_logs(self, submission_id: str) -> AsyncIterator[bytes]:
         """Yield log chunks until the job reaches a terminal state."""
         path = self.log_path(submission_id)
         pos = 0
         while True:
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    f.seek(pos)
-                    chunk = f.read()
-                if chunk:
-                    pos += len(chunk)
-                    yield chunk
+            chunk = await asyncio.to_thread(self._read_chunk, path, pos)
+            if chunk:
+                pos += len(chunk)
+                yield chunk
             info = await self._get_info_async(submission_id)
             if info is None or JobStatus.is_terminal(info.status):
                 # final drain
-                if os.path.exists(path):
-                    with open(path, "rb") as f:
-                        f.seek(pos)
-                        chunk = f.read()
-                    if chunk:
-                        yield chunk
+                chunk = await asyncio.to_thread(self._read_chunk, path,
+                                                pos)
+                if chunk:
+                    yield chunk
                 return
             await asyncio.sleep(0.3)
 

@@ -763,12 +763,23 @@ class Raylet:
             except Exception:  # noqa: BLE001 — fall back to exec spawn
                 logger.warning("factory spawn failed; exec fallback",
                                exc_info=True)
-        logfile = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core_worker.worker_main"],
-            env=env, stdout=logfile, stderr=subprocess.STDOUT,
-            cwd=ctx.cwd or os.getcwd(),
-        )
+        def _exec_spawn():
+            # open+fork+exec off-loop: the exec fallback runs whenever no
+            # factory is attached (pip/conda envs, early boot) and a fork
+            # stalls the IO loop ~10ms (PERF_PLAN round-8 boot trace)
+            logfile = open(log_path, "ab")
+            try:
+                return subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu.core_worker.worker_main"],
+                    env=env, stdout=logfile, stderr=subprocess.STDOUT,
+                    cwd=ctx.cwd or os.getcwd(),
+                )
+            finally:
+                # the child inherited the fd; the parent copy only leaks
+                logfile.close()
+
+        proc = await asyncio.to_thread(_exec_spawn)
         w = WorkerHandle(worker_id=worker_id, proc=proc, env_key=ctx.env_key)
         self.runtime_env_agent.acquire(ctx.env_key)
         if self.cgroups is not None:

@@ -95,4 +95,85 @@ static inline int ff_write_frame_fd(int fd, uint64_t req_id,
     return 0;
 }
 
+/* ------------------------------------------------------------------ */
+/* fastspec v2 (normal-task) record codec — pure C, shared by          */
+/* fastspec.c (the CPython extension) and cpp/test/tsan_fastframe.cc   */
+/* (the sanitizer harness), so concurrent record parse is TSAN/ASAN    */
+/* covered without an embedded interpreter.  Wire form (fastspec.c     */
+/* header comment):                                                    */
+/*   magic "RTFS" | ver u8=2 | num_returns u32 | port u32 |            */
+/*   8 x (len u32 | bytes): task_id, job_id, caller_worker_id, host,   */
+/*                          qualname, serialized_func, args_payload,   */
+/*                          display_name                               */
+/* ------------------------------------------------------------------ */
+
+#define FF_SPEC_MAGIC "RTFS"
+#define FF_SPEC_TASK_VERSION 2u
+#define FF_TASK_NBLOBS 8u
+#define FF_TASK_HDR (4u + 1u + 4u + 4u)
+
+typedef struct {
+    const unsigned char *ptr;
+    uint32_t len;
+} ff_span;
+
+typedef struct {
+    uint32_t num_returns;
+    uint32_t port;
+    ff_span blobs[FF_TASK_NBLOBS];
+} ff_task_record;
+
+/* Packed byte size of a v2 record (callers allocate; this layer never
+ * does — fastframe.h stays allocation-free by contract, enforced by the
+ * native-race-audit analysis pass). */
+static inline size_t ff_task_size(const ff_task_record *rec) {
+    size_t total = FF_TASK_HDR;
+    for (unsigned i = 0; i < FF_TASK_NBLOBS; i++)
+        total += 4 + (size_t)rec->blobs[i].len;
+    return total;
+}
+
+/* Serialize into `out` (at least ff_task_size(rec) bytes); returns the
+ * number of bytes written. */
+static inline size_t ff_task_write(const ff_task_record *rec,
+                                   unsigned char *out) {
+    unsigned char *p = out;
+    memcpy(p, FF_SPEC_MAGIC, 4); p += 4;
+    *p++ = (unsigned char)FF_SPEC_TASK_VERSION;
+    ff_put_u32(p, rec->num_returns); p += 4;
+    ff_put_u32(p, rec->port); p += 4;
+    for (unsigned i = 0; i < FF_TASK_NBLOBS; i++) {
+        ff_put_u32(p, rec->blobs[i].len); p += 4;
+        if (rec->blobs[i].len) {
+            memcpy(p, rec->blobs[i].ptr, rec->blobs[i].len);
+            p += rec->blobs[i].len;
+        }
+    }
+    return (size_t)(p - out);
+}
+
+/* Parse a v2 record.  Blob spans alias `buf` (zero-copy; the caller
+ * keeps buf alive).  Returns 0 on success, -1 when buf is not a v2
+ * record, -2 when truncated/corrupt. */
+static inline int ff_task_parse(const unsigned char *buf, size_t len,
+                                ff_task_record *rec) {
+    if (len < FF_TASK_HDR || memcmp(buf, FF_SPEC_MAGIC, 4) != 0)
+        return -1;
+    if (buf[4] != FF_SPEC_TASK_VERSION)
+        return -1;
+    const unsigned char *p = buf + 5;
+    const unsigned char *end = buf + len;
+    rec->num_returns = ff_get_u32(p); p += 4;
+    rec->port = ff_get_u32(p); p += 4;
+    for (unsigned i = 0; i < FF_TASK_NBLOBS; i++) {
+        if ((size_t)(end - p) < 4) return -2;
+        uint32_t n = ff_get_u32(p); p += 4;
+        if ((size_t)(end - p) < (size_t)n) return -2;
+        rec->blobs[i].ptr = p;
+        rec->blobs[i].len = n;
+        p += n;
+    }
+    return 0;
+}
+
 #endif /* RT_FASTFRAME_H */

@@ -146,6 +146,12 @@ corrupt:
     return NULL;
 }
 
+/* v2 pack/unpack ride the pure-C record codec in fastframe.h
+ * (ff_task_write/ff_task_parse) — the same functions the sanitizer
+ * harness (cpp/test/tsan_fastframe.cc) drives under TSAN/ASAN with
+ * concurrent writers, so the production parse path IS the audited
+ * path. */
+
 static PyObject *
 fastspec_pack_task(PyObject *self, PyObject *args)
 {
@@ -160,7 +166,9 @@ fastspec_pack_task(PyObject *self, PyObject *args)
                           &num_returns, &port)) {
         return NULL;
     }
-    Py_ssize_t total = 4 + 1 + 4 + 4;
+    ff_task_record rec;
+    rec.num_returns = (uint32_t)num_returns;
+    rec.port = (uint32_t)port;
     for (int i = 0; i < N_TASK_BLOBS; i++) {
         if ((uint64_t)blobs[i].len > UINT32_MAX) {
             for (int j = 0; j < N_TASK_BLOBS; j++)
@@ -169,23 +177,17 @@ fastspec_pack_task(PyObject *self, PyObject *args)
                             "fastspec blob exceeds u32 length prefix");
             return NULL;
         }
-        total += 4 + blobs[i].len;
+        rec.blobs[i].ptr = (const unsigned char *)blobs[i].buf;
+        rec.blobs[i].len = (uint32_t)blobs[i].len;
     }
-    PyObject *out = PyBytes_FromStringAndSize(NULL, total);
+    PyObject *out =
+        PyBytes_FromStringAndSize(NULL, (Py_ssize_t)ff_task_size(&rec));
     if (out == NULL) {
         for (int i = 0; i < N_TASK_BLOBS; i++) PyBuffer_Release(&blobs[i]);
         return NULL;
     }
-    char *p = PyBytes_AS_STRING(out);
-    memcpy(p, MAGIC, 4); p += 4;
-    *p++ = (char)TASK_VERSION;
-    put_u32(&p, (uint32_t)num_returns);
-    put_u32(&p, (uint32_t)port);
-    for (int i = 0; i < N_TASK_BLOBS; i++) {
-        put_u32(&p, (uint32_t)blobs[i].len);
-        memcpy(p, blobs[i].buf, blobs[i].len); p += blobs[i].len;
-        PyBuffer_Release(&blobs[i]);
-    }
+    ff_task_write(&rec, (unsigned char *)PyBytes_AS_STRING(out));
+    for (int i = 0; i < N_TASK_BLOBS; i++) PyBuffer_Release(&blobs[i]);
     return out;
 }
 
@@ -196,53 +198,48 @@ fastspec_unpack_task(PyObject *self, PyObject *args)
     if (!PyArg_ParseTuple(args, "y*", &buf)) {
         return NULL;
     }
-    const char *p = (const char *)buf.buf;
-    const char *end = p + buf.len;
-    if (buf.len < 4 + 1 + 4 + 4 || memcmp(p, MAGIC, 4) != 0) {
+    ff_task_record rec;
+    int rc = ff_task_parse((const unsigned char *)buf.buf,
+                           (size_t)buf.len, &rec);
+    if (rc != 0) {
+        const unsigned char *b = (const unsigned char *)buf.buf;
+        if (rc == -1 && buf.len >= 5 && memcmp(b, MAGIC, 4) == 0 &&
+            b[4] != FF_SPEC_TASK_VERSION) {
+            PyErr_Format(PyExc_ValueError,
+                         "fastspec task version %d unsupported", b[4]);
+        } else if (rc == -1 && (buf.len < 4 ||
+                                memcmp(b, MAGIC, 4) != 0)) {
+            PyErr_SetString(PyExc_ValueError, "not a fastspec buffer");
+        } else {
+            /* magic + supported version but short/corrupt body (parse
+             * returned -1 for len < header or -2 mid-blob) */
+            PyErr_SetString(PyExc_ValueError,
+                            "truncated fastspec buffer");
+        }
         PyBuffer_Release(&buf);
-        PyErr_SetString(PyExc_ValueError, "not a fastspec buffer");
         return NULL;
     }
-    p += 4;
-    uint8_t ver = (uint8_t)*p++;
-    if (ver != TASK_VERSION) {
-        PyBuffer_Release(&buf);
-        PyErr_Format(PyExc_ValueError,
-                     "fastspec task version %d unsupported", ver);
-        return NULL;
-    }
-    uint32_t num_returns = get_u32(&p);
-    uint32_t port = get_u32(&p);
-
     PyObject *tuple = PyTuple_New(N_TASK_BLOBS + 2);
     if (tuple == NULL) {
         PyBuffer_Release(&buf);
         return NULL;
     }
     for (int i = 0; i < N_TASK_BLOBS; i++) {
-        if (p + 4 > end) goto corrupt;
-        uint32_t len = get_u32(&p);
-        if ((Py_ssize_t)len > end - p) goto corrupt;
-        PyObject *b = PyBytes_FromStringAndSize(p, (Py_ssize_t)len);
+        PyObject *b = PyBytes_FromStringAndSize(
+            (const char *)rec.blobs[i].ptr, (Py_ssize_t)rec.blobs[i].len);
         if (b == NULL) {
             Py_DECREF(tuple);
             PyBuffer_Release(&buf);
             return NULL;
         }
         PyTuple_SET_ITEM(tuple, i, b);
-        p += len;
     }
     PyTuple_SET_ITEM(tuple, N_TASK_BLOBS,
-                     PyLong_FromUnsignedLong(num_returns));
-    PyTuple_SET_ITEM(tuple, N_TASK_BLOBS + 1, PyLong_FromUnsignedLong(port));
+                     PyLong_FromUnsignedLong(rec.num_returns));
+    PyTuple_SET_ITEM(tuple, N_TASK_BLOBS + 1,
+                     PyLong_FromUnsignedLong(rec.port));
     PyBuffer_Release(&buf);
     return tuple;
-
-corrupt:
-    Py_DECREF(tuple);
-    PyBuffer_Release(&buf);
-    PyErr_SetString(PyExc_ValueError, "truncated fastspec buffer");
-    return NULL;
 }
 
 static PyMethodDef FastspecMethods[] = {

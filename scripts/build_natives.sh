@@ -49,3 +49,15 @@ if failures:
     print("FAILED natives:", ", ".join(failures), file=sys.stderr)
     sys.exit(1)
 EOF
+
+# The sanitizer harness must keep compiling against the CURRENT wire
+# header (fastframe.h now also carries the fastspec-v2 record codec the
+# harness drives): a header change that breaks cpp/test/tsan_fastframe.cc
+# would otherwise surface only when someone runs run_tsan.sh — i.e. a
+# stale harness silently stops covering the real wire layer.  Skipped
+# only when g++ is absent (the runtime falls back to pure Python there).
+if command -v g++ >/dev/null 2>&1; then
+  g++ -fsyntax-only -std=c++17 -Iray_tpu/rpc/native \
+      cpp/test/tsan_fastframe.cc
+  echo "ok: tsan_fastframe harness compiles against fastframe.h"
+fi

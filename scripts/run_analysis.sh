@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# rt-analyze CI gate: run the full static-analysis suite
+# (python -m ray_tpu.analysis — loop-blocker, jit-recompile-hazard,
+# native-race-audit, rpc-schema-drift) against the committed suppression
+# baseline (analysis_baseline.txt).
+#
+# Exit 0  = no findings above baseline (suppressed FPs are fine)
+# Exit 1  = NEW findings — fix them or (for an argued false positive)
+#           add a fingerprint + reason to analysis_baseline.txt
+# Exit 2  = broken baseline / bad usage
+#
+# The whole suite is AST/structural and runs in a few seconds; it is a
+# default-on stage of scripts/run_tests.sh (RT_ANALYZE=0 skips).
+# See ANALYSIS.md for the pass catalog and the suppression workflow.
+set -u
+cd "$(dirname "$0")/.."
+
+# deep native stage (gcc -fanalyzer over fastloop.c/fastspec.c) when a
+# compiler is present; pure-Python environments still run the
+# structural checks
+if [[ -z "${RT_ANALYZE_NATIVE_CC:-}" ]] && command -v gcc >/dev/null 2>&1
+then
+  export RT_ANALYZE_NATIVE_CC=1
+fi
+
+exec python -m ray_tpu.analysis "$@"

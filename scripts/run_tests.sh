@@ -25,6 +25,21 @@ FILTER="${1:-}"
 
 : > "$RUN_LOG"
 pass=0; fail=0; failed_files=()
+
+# Static-analysis gate (default ON, RT_ANALYZE=0 skips): the rt-analyze
+# suite is AST-only and runs in seconds — findings above the committed
+# analysis_baseline.txt fail the run BEFORE any tests spend minutes.
+if [[ "${RT_ANALYZE:-1}" == "1" ]]; then
+  echo "rt-analyze: static analysis gate..." | tee -a "$RUN_LOG"
+  if (set -o pipefail; bash scripts/run_analysis.sh -q 2>&1 \
+        | tee -a "$RUN_LOG"); then
+    echo "rt-analyze: ok" | tee -a "$RUN_LOG"
+  else
+    echo "rt-analyze: FINDINGS ABOVE BASELINE (rerun without -q for" \
+         "detail: bash scripts/run_analysis.sh)" | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
+fi
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
   start=$(date +%s)

@@ -1,10 +1,19 @@
 #!/bin/bash
-# TSAN + ASAN runs for the concurrency-critical native code
-# (reference: .bazelrc build:tsan/build:asan CI configs, SURVEY.md §4.5):
-# the shared-memory object store/channel, and the fastloop wire layer
-# (fastframe.h) that the actor-call AND lease-cached task-dispatch
-# channels ride — concurrent writers behind the connection mutex vs one
-# frame-parsing reader, exactly the production thread shape.
+# Sanitizer + static-analyzer audit for the concurrency-critical native
+# code (reference: .bazelrc build:tsan/build:asan CI configs, SURVEY
+# §4.5): the shared-memory object store/channel, and the fastloop wire
+# layer (fastframe.h: frame codec + robust fd writer + fastspec-v2
+# record codec) that the actor-call AND lease-cached task-dispatch
+# channels ride.  The fastframe harness runs three scenarios —
+# concurrent frame writers vs one parsing reader, fastspec-v2 record
+# parse under concurrent writers, and reply-slot reuse in the
+# production C-reader-thread shape (cpp/test/tsan_fastframe.cc).
+#
+# Stages: TSAN, ASAN+UBSAN (-fsanitize=address,undefined), and a
+# link-free `gcc -fanalyzer` static pass over the production C sources
+# (fastloop.c/fastspec.c compile against Python.h; analyzed only, never
+# run here).  The native-race-audit analysis pass cross-checks that
+# this script keeps all of these stages.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -13,12 +22,13 @@ SRC="cpp/test/tsan_shm.cc \
      ray_tpu/object_store/native/shm_channel.cc"
 FF_SRC="cpp/test/tsan_fastframe.cc"
 FF_INC="-Iray_tpu/rpc/native"
+PY_INC="$(python -c 'import sysconfig; print(sysconfig.get_paths()["include"])')"
 
 echo "== TSAN (shm) =="
 g++ -O1 -g -fsanitize=thread -std=c++17 -o /tmp/tsan_shm $SRC -lpthread -lrt
 TSAN_OPTIONS="halt_on_error=1" /tmp/tsan_shm
 
-echo "== TSAN (fastframe) =="
+echo "== TSAN (fastframe: frames + fastspec-v2 records + reply slots) =="
 g++ -O1 -g -fsanitize=thread -std=c++17 $FF_INC -o /tmp/tsan_fastframe \
     $FF_SRC -lpthread
 TSAN_OPTIONS="halt_on_error=1" /tmp/tsan_fastframe
@@ -27,9 +37,17 @@ echo "== ASAN (shm) =="
 g++ -O1 -g -fsanitize=address -std=c++17 -o /tmp/asan_shm $SRC -lpthread -lrt
 /tmp/asan_shm
 
-echo "== ASAN (fastframe) =="
-g++ -O1 -g -fsanitize=address -std=c++17 $FF_INC -o /tmp/asan_fastframe \
-    $FF_SRC -lpthread
+echo "== ASAN+UBSAN (fastframe) =="
+g++ -O1 -g -fsanitize=address,undefined -fno-sanitize-recover=undefined \
+    -std=c++17 $FF_INC -o /tmp/asan_fastframe $FF_SRC -lpthread
 /tmp/asan_fastframe
+
+echo "== gcc -fanalyzer (fastloop.c / fastspec.c, syntax-only) =="
+# static path exploration over the production sources; -Werror on the
+# analyzer's own diagnostics so a new leak/deadlock path fails the audit
+gcc -fanalyzer -fsyntax-only -Wall -Werror=analyzer-malloc-leak \
+    -I"$PY_INC" $FF_INC ray_tpu/rpc/native/fastloop.c
+gcc -fanalyzer -fsyntax-only -Wall -Werror=analyzer-malloc-leak \
+    -I"$PY_INC" $FF_INC ray_tpu/rpc/native/fastspec.c
 
 echo "sanitizer runs clean"

@@ -1,0 +1,518 @@
+"""rt-analyze suite tests: known-bad / known-good fixtures per pass,
+suppression round-trip, CLI exit codes, and the real tree staying clean
+against the committed baseline (ISSUE 8 acceptance)."""
+
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.analysis import (AnalysisContext, Baseline, get_pass,
+                              iter_passes, run_passes)
+from ray_tpu.analysis.__main__ import main as cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, relpath, text):
+    path = os.path.join(root, relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(text))
+    return path
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# --------------------------------------------------------------- registry
+def test_four_passes_registered():
+    ids = {p.id for p in iter_passes()}
+    assert {"loop-blocker", "jit-recompile-hazard", "native-race-audit",
+            "rpc-schema-drift"} <= ids
+
+
+# ------------------------------------------------------------ loop-blocker
+class TestLoopBlocker:
+    def run(self, tmp_path, src):
+        _write(tmp_path, "ray_tpu/gcs/fixture.py", src)
+        return get_pass("loop-blocker").run(AnalysisContext(str(tmp_path)))
+
+    def test_coroutine_calling_time_sleep_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import time
+            async def tick():
+                time.sleep(1)
+            """)
+        assert [f.subject for f in fs] == ["time.sleep"]
+        assert fs[0].context == "tick"
+
+    def test_sync_function_sleep_not_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import time
+            def retry_loop():
+                time.sleep(1)
+            """)
+        assert fs == []
+
+    def test_open_and_subprocess_in_async_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import subprocess
+            async def handler():
+                with open('/proc/stat') as f:
+                    data = f.read()
+                subprocess.run(['ls'])
+            """)
+        assert _codes(fs) == ["blocking-call", "blocking-open"]
+
+    def test_one_level_helper_walk(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import os
+            class Raylet:
+                async def report(self):
+                    self._probe()
+                def _probe(self):
+                    os.unlink('/tmp/x')
+            """)
+        assert len(fs) == 1
+        assert fs[0].subject == "os.unlink"
+        assert fs[0].context == "Raylet._probe"
+        assert "called from Raylet.report" in fs[0].message
+
+    def test_to_thread_pattern_not_flagged(self, tmp_path):
+        # the FIX for this bug class must not itself be flagged: the
+        # nested sync def is only referenced, never called on the loop
+        fs = self.run(tmp_path, """
+            import asyncio, subprocess
+            async def handler(path):
+                def work():
+                    with open(path) as f:
+                        return f.read()
+                data = await asyncio.to_thread(work)
+                proc = await asyncio.to_thread(subprocess.Popen, ['ls'])
+                return data, proc
+            """)
+        assert fs == []
+
+    def test_loop_callback_registration_is_loop_context(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import time
+            def setup(loop):
+                loop.call_soon(tick_cb)
+            def tick_cb():
+                time.sleep(0.1)
+            """)
+        assert len(fs) == 1
+        assert fs[0].context == "tick_cb"
+        assert "loop callback" in fs[0].message
+
+    def test_sync_gcs_rpc_helper_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            class Manager:
+                async def refresh(self):
+                    return self._gcs.kv_get('ns', 'k')
+            """)
+        assert _codes(fs) == ["sync-rpc"]
+
+    def test_inline_waiver_suppresses(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import time
+            async def tick():
+                time.sleep(1)  # rt-analyze: ok(loop-blocker) fixture
+            """)
+        assert fs == []
+
+
+# ----------------------------------------------------- jit-recompile-hazard
+class TestJitRecompile:
+    def run(self, tmp_path, src):
+        _write(tmp_path, "ray_tpu/models/fixture.py", src)
+        return get_pass("jit-recompile-hazard").run(
+            AnalysisContext(str(tmp_path)))
+
+    def test_tracer_branch_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import jax
+            @jax.jit
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+            """)
+        assert _codes(fs) == ["tracer-branch"]
+
+    def test_shape_branch_not_flagged(self, tmp_path):
+        # shapes/dtypes are trace-time static: branching on them is the
+        # NORMAL way to build programs and must not drown the signal
+        fs = self.run(tmp_path, """
+            import jax
+            @jax.jit
+            def step(x):
+                if x.shape[0] > 1 and x.ndim == 2:
+                    return x * 2
+                return x
+            """)
+        assert fs == []
+
+    def test_static_arg_branch_not_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("training",))
+            def step(x, training):
+                if training:
+                    return x * 2
+                return x
+            """)
+        assert fs == []
+
+    def test_concretize_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import jax
+            @jax.jit
+            def step(x):
+                n = int(x)
+                m = x.item()
+                return n + m
+            """)
+        assert _codes(fs) == ["concretize"]
+        assert len(fs) == 2
+
+    def test_wrap_site_and_variable_scatter(self, tmp_path):
+        # the make_* builder shape: inner def wrapped by jax.jit(...)
+        fs = self.run(tmp_path, """
+            import jax
+            import numpy as np
+            def make_prog(idxs):
+                def inner(cache, vals):
+                    return cache.at[np.asarray(idxs)].set(vals)
+                return jax.jit(inner)
+            """)
+        assert "variable-scatter" in _codes(fs)
+
+    def test_eager_scatter_in_loop_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            def engine_step(cache, batches):
+                for idxs, vals in batches:
+                    cache = cache.at[idxs].set(vals)
+                return cache
+            """)
+        assert _codes(fs) == ["eager-scatter"]
+
+    def test_constant_index_scatter_not_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            def reset(cache, n):
+                for _ in range(n):
+                    cache = cache.at[0].set(0.0)
+                    cache = cache.at[1:3].set(1.0)
+                return cache
+            """)
+        assert fs == []
+
+    def test_unhashable_static_literal_flagged(self, tmp_path):
+        fs = self.run(tmp_path, """
+            import jax
+            def build(fn):
+                return jax.jit(fn, static_argnames=("cfg",), cfg=[1, 2])
+            """)
+        assert _codes(fs) == ["unhashable-static"]
+
+
+# -------------------------------------------------------- rpc-schema-drift
+class TestSchemaDrift:
+    SCHEMA = """
+        RPC_SCHEMAS = {
+            "register_node": _m("register_node", req("node_id"),
+                                req("address"), opt("labels")),
+            "ghost_method": _m("ghost_method", req("x")),
+        }
+        """
+
+    def run(self, tmp_path, schema=None, handler=None, callsite=None):
+        _write(tmp_path, "ray_tpu/rpc/schema.py", schema or self.SCHEMA)
+        _write(tmp_path, "ray_tpu/gcs/server.py", handler or """
+            class GcsServer:
+                async def h_register_node(self, node_id, address,
+                                          labels=None):
+                    return True
+                async def h_ghost_method(self, x):
+                    return x
+            """)
+        if callsite:
+            _write(tmp_path, "ray_tpu/gcs/client.py", callsite)
+        return get_pass("rpc-schema-drift").run(
+            AnalysisContext(str(tmp_path)))
+
+    def test_aligned_schema_clean(self, tmp_path):
+        assert self.run(tmp_path) == []
+
+    def test_drifted_field_name_flagged(self, tmp_path):
+        # schema renamed a field the handler still spells the old way —
+        # the exact runtime-KeyError family this pass exists for
+        fs = self.run(tmp_path, handler="""
+            class GcsServer:
+                async def h_register_node(self, node_id, addr,
+                                          labels=None):
+                    return True
+                async def h_ghost_method(self, x):
+                    return x
+            """)
+        codes = _codes(fs)
+        assert "field-not-in-handler" in codes    # 'address' unknown
+        assert "param-not-in-schema" in codes     # 'addr' undeclared
+
+    def test_missing_handler_flagged(self, tmp_path):
+        fs = self.run(tmp_path, handler="""
+            class GcsServer:
+                async def h_register_node(self, node_id, address,
+                                          labels=None):
+                    return True
+            """)
+        assert [f.subject for f in fs] == ["ghost_method"]
+        assert fs[0].code == "missing-handler"
+
+    def test_call_site_unknown_and_missing_fields(self, tmp_path):
+        fs = self.run(tmp_path, callsite="""
+            class C:
+                def go(self):
+                    return self._rpc.call("register_node",
+                                          node_id=b"x",
+                                          adress=("h", 1))
+            """)
+        codes = _codes(fs)
+        assert "unknown-field-sent" in codes       # 'adress' typo
+        assert "missing-required-field" in codes   # 'address' omitted
+
+    def test_optional_field_optionality_drift(self, tmp_path):
+        fs = self.run(tmp_path, schema="""
+            RPC_SCHEMAS = {
+                "register_node": _m("register_node", req("node_id"),
+                                    req("address"), opt("labels")),
+                "ghost_method": _m("ghost_method", opt("x")),
+            }
+            """)
+        # ghost handler REQUIRES x but schema says optional
+        assert _codes(fs) == ["optionality-drift"]
+
+
+# ------------------------------------------------------- native-race-audit
+class TestNativeRace:
+    def _seed_good_tree(self, tmp_path):
+        """Copy the real native layer into a scratch tree."""
+        for rel in ("ray_tpu/rpc/native/fastframe.h",
+                    "ray_tpu/rpc/native/fastloop.c",
+                    "ray_tpu/rpc/native/fastspec.c",
+                    "cpp/test/tsan_fastframe.cc",
+                    "scripts/run_tsan.sh"):
+            with open(os.path.join(REPO_ROOT, rel)) as f:
+                _write(tmp_path, rel, f.read())
+
+    def run(self, tmp_path):
+        return get_pass("native-race-audit").run(
+            AnalysisContext(str(tmp_path)))
+
+    def test_real_tree_shape_clean(self, tmp_path):
+        self._seed_good_tree(tmp_path)
+        assert self.run(tmp_path) == []
+
+    def test_malloc_in_header_flagged(self, tmp_path):
+        self._seed_good_tree(tmp_path)
+        hdr = os.path.join(tmp_path, "ray_tpu/rpc/native/fastframe.h")
+        with open(hdr) as f:
+            src = f.read()
+        with open(hdr, "w") as f:
+            f.write(src.replace(
+                "#endif /* RT_FASTFRAME_H */",
+                "static inline void *ff_scratch(void) "
+                "{ return malloc(16); }\n#endif /* RT_FASTFRAME_H */"))
+        codes = _codes(self.run(tmp_path))
+        assert "header-purity" in codes
+        # the new export also lacks harness coverage
+        assert "uncovered-export" in codes
+
+    def test_unbalanced_lock_flagged(self, tmp_path):
+        self._seed_good_tree(tmp_path)
+        c = os.path.join(tmp_path, "ray_tpu/rpc/native/fastloop.c")
+        with open(c, "a") as f:
+            f.write("\nstatic void bad_path(Conn *c) {\n"
+                    "    pthread_mutex_lock(&c->wmutex);\n"
+                    "    if (c->dead) return;\n"
+                    "    pthread_mutex_unlock(&c->wmutex);\n"
+                    "}\n"
+                    "static void worse_path(Conn *c) {\n"
+                    "    pthread_mutex_lock(&c->wmutex);\n"
+                    "    pthread_mutex_lock(&c->wmutex);\n"
+                    "    pthread_mutex_unlock(&c->wmutex);\n"
+                    "}\n")
+        fs = self.run(tmp_path)
+        assert any(f.code == "lock-balance" and f.subject == "worse_path"
+                   for f in fs)
+
+    def test_lost_scenario_flagged(self, tmp_path):
+        self._seed_good_tree(tmp_path)
+        h = os.path.join(tmp_path, "cpp/test/tsan_fastframe.cc")
+        with open(h) as f:
+            src = f.read()
+        with open(h, "w") as f:
+            f.write(src.replace("scenario_reply_slots", "scenario_gone"))
+        fs = self.run(tmp_path)
+        assert any(f.code == "missing-scenario"
+                   and f.subject == "scenario_reply_slots" for f in fs)
+
+    def test_lost_sanitizer_stage_flagged(self, tmp_path):
+        self._seed_good_tree(tmp_path)
+        s = os.path.join(tmp_path, "scripts/run_tsan.sh")
+        with open(s) as f:
+            src = f.read()
+        with open(s, "w") as f:
+            f.write(src.replace("-fanalyzer", "-fnothing"))
+        fs = self.run(tmp_path)
+        assert any(f.code == "missing-stage" and f.subject == "-fanalyzer"
+                   for f in fs)
+
+
+# ----------------------------------------------------- baseline round-trip
+class TestBaseline:
+    def _findings(self, tmp_path):
+        _write(tmp_path, "ray_tpu/gcs/fix.py", """
+            import time
+            async def a():
+                time.sleep(1)
+            async def b():
+                time.sleep(2)
+            """)
+        return get_pass("loop-blocker").run(AnalysisContext(str(tmp_path)))
+
+    def test_round_trip_suppresses_everything(self, tmp_path):
+        findings = self._findings(tmp_path)
+        assert len(findings) == 2
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        Baseline().save(path, findings, comment="fixture waiver")
+        new, suppressed, stale = Baseline.load(path).split(findings)
+        assert new == [] and len(suppressed) == 2 and stale == []
+
+    def test_fingerprints_survive_line_churn(self, tmp_path):
+        findings = self._findings(tmp_path)
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        Baseline().save(path, findings, comment="fixture waiver")
+        # shift every line down; fingerprints must still match
+        fix = os.path.join(tmp_path, "ray_tpu/gcs/fix.py")
+        with open(fix) as f:
+            src = f.read()
+        with open(fix, "w") as f:
+            f.write("# moved\n# moved\n" + src)
+        moved = get_pass("loop-blocker").run(
+            AnalysisContext(str(tmp_path)))
+        new, suppressed, stale = Baseline.load(path).split(moved)
+        assert new == [] and len(suppressed) == 2
+
+    def test_stale_entries_reported(self, tmp_path):
+        findings = self._findings(tmp_path)
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        Baseline().save(path, findings, comment="fixture waiver")
+        _, _, stale = Baseline.load(path).split([])
+        assert len(stale) == 2
+
+    def test_entry_without_comment_rejected(self, tmp_path):
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        with open(path, "w") as f:
+            f.write("loop-blocker|x.py|f|blocking-call|time.sleep\n")
+        with pytest.raises(ValueError, match="reason comment"):
+            Baseline.load(path)
+
+    def test_malformed_fingerprint_rejected(self, tmp_path):
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        with open(path, "w") as f:
+            f.write("loop-blocker|x.py|bad  # not enough fields\n")
+        with pytest.raises(ValueError, match="malformed"):
+            Baseline.load(path)
+
+    def test_todo_placeholder_rejected_in_ci(self, tmp_path):
+        # --write-baseline's TODO seed must NOT pass the strict (CI)
+        # parse — an unargued suppression is not a suppression
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        with open(path, "w") as f:
+            f.write(f"loop-blocker|x.py|f|blocking-call|time.sleep  "
+                    f"# {Baseline.TODO_COMMENT}\n")
+        with pytest.raises(ValueError, match="argued reason"):
+            Baseline.load(path)
+        assert len(Baseline.load(path, strict=False).entries) == 1
+
+    def test_write_baseline_preserves_argued_reasons(self, tmp_path):
+        findings = self._findings(tmp_path)
+        path = os.path.join(tmp_path, "analysis_baseline.txt")
+        cli_main(["--root", str(tmp_path), "--passes", "loop-blocker",
+                  "--baseline", path, "--write-baseline", "-q"])
+        # argue one entry by hand, leave the other as TODO
+        with open(path) as f:
+            lines = f.read().splitlines()
+        argued_fp = None
+        for i, line in enumerate(lines):
+            if Baseline.TODO_COMMENT in line:
+                argued_fp = line.split("  #")[0].strip()
+                lines[i] = f"{argued_fp}  # argued: fixture waiver"
+                break
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        # reseeding must keep the hand-written reason
+        cli_main(["--root", str(tmp_path), "--passes", "loop-blocker",
+                  "--baseline", path, "--write-baseline", "-q"])
+        kept = Baseline.load(path, strict=False)
+        assert kept.entries[argued_fp] == "argued: fixture waiver"
+
+
+# ------------------------------------------------------------------- CLI
+class TestCli:
+    def test_scratch_bug_makes_cli_exit_nonzero(self, tmp_path, capsys):
+        # ISSUE 8 acceptance: a deliberately-introduced loop-blocking
+        # call in a scratch diff must make the suite exit nonzero
+        _write(tmp_path, "ray_tpu/gcs/scratch.py", """
+            import time
+            async def poll():
+                time.sleep(5)
+            """)
+        assert cli_main(["--root", str(tmp_path), "--passes",
+                         "loop-blocker,jit-recompile-hazard", "-q"]) == 1
+
+    def test_tracer_branch_makes_cli_exit_nonzero(self, tmp_path):
+        _write(tmp_path, "ray_tpu/models/scratch.py", """
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """)
+        assert cli_main(["--root", str(tmp_path), "--passes",
+                         "jit-recompile-hazard", "-q"]) == 1
+
+    def test_baselined_tree_exits_zero(self, tmp_path):
+        _write(tmp_path, "ray_tpu/gcs/scratch.py", """
+            import time
+            async def poll():
+                time.sleep(5)
+            """)
+        baseline = os.path.join(tmp_path, "analysis_baseline.txt")
+        ctx = AnalysisContext(str(tmp_path))
+        Baseline().save(baseline, run_passes(ctx, ["loop-blocker"]),
+                        comment="fixture")
+        assert cli_main(["--root", str(tmp_path), "--passes",
+                         "loop-blocker", "-q"]) == 0
+
+    def test_unknown_pass_exits_2(self, tmp_path):
+        assert cli_main(["--root", str(tmp_path), "--passes", "nope",
+                         "-q"]) == 2
+
+
+# ------------------------------------------------- the real tree is clean
+def test_real_tree_clean_against_committed_baseline():
+    """The committed checkout must pass its own gate: everything the
+    passes find is either fixed or argued in analysis_baseline.txt."""
+    ctx = AnalysisContext(REPO_ROOT)
+    findings = run_passes(ctx)
+    baseline = Baseline.load(
+        os.path.join(REPO_ROOT, "analysis_baseline.txt"))
+    new, _suppressed, stale = baseline.split(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
