@@ -23,6 +23,7 @@ _API_SYMBOLS = {
     "cluster_resources",
     "get",
     "get_actor",
+    "get_async",
     "get_runtime_context",
     "init",
     "is_initialized",
@@ -44,13 +45,20 @@ _SUBMODULES = {
 
 
 def __getattr__(name):
+    # Memoize into the module dict (PEP 562 lazy-attr idiom): repeated
+    # `ray_tpu.get(...)`-style access in hot loops otherwise re-enters the
+    # import machinery every call (~10µs each at serve request rates).
     if name in _API_SYMBOLS:
-        return getattr(importlib.import_module("ray_tpu.api"), name)
-    if name in _PG_SYMBOLS:
-        return getattr(importlib.import_module("ray_tpu.core_worker.placement_group"), name)
-    if name in _SUBMODULES:
-        return importlib.import_module(f"ray_tpu.{name}")
-    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+        value = getattr(importlib.import_module("ray_tpu.api"), name)
+    elif name in _PG_SYMBOLS:
+        value = getattr(importlib.import_module(
+            "ray_tpu.core_worker.placement_group"), name)
+    elif name in _SUBMODULES:
+        value = importlib.import_module(f"ray_tpu.{name}")
+    else:
+        raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+    globals()[name] = value
+    return value
 
 
 def __dir__():

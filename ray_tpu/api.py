@@ -452,6 +452,19 @@ def get(refs, *, timeout: Optional[float] = None):
     return values[0] if single else values
 
 
+async def get_async(ref: "ObjectRef", *, timeout: Optional[float] = None):
+    """Awaitable single-ref ``get`` for async actors and event-loop code:
+    resolves on the calling loop with no executor thread parked on a
+    condition variable (reference: ``await object_ref`` / CoreWorker
+    GetAsync).  Not available in ray:// client mode."""
+    if _client is not None:
+        raise NotImplementedError(
+            "get_async is not supported in ray:// client mode")
+    if not isinstance(ref, ObjectRef):
+        raise TypeError(f"get_async() expects an ObjectRef, got {type(ref)}")
+    return await _core_worker().get_async(ref, timeout)
+
+
 def wait(refs, *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
     if _client is not None:

@@ -29,7 +29,7 @@ Design points (TPU-first redesign, not a port):
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ray_tpu.common.ids import ObjectID, TaskID
 from .reference import ObjectRef
@@ -48,6 +48,8 @@ class _StreamState:
         self.total: Optional[int] = None              # set when stream ends
         self.error: Optional[bytes] = None            # terminal task failure
         self.space_waiters = []                       # (loop, future) pairs
+        self.item_waiters = []                        # (loop, future): async
+        # consumers parked on the NEXT item (push wakeup, no poll thread)
         self.spec = spec                              # for lineage of items
 
     # ------------------------------------------------------------- producer
@@ -60,7 +62,8 @@ class _StreamState:
             self.seen.add(index)
             self.items[index] = ref
             self.cv.notify_all()
-            return True
+        self._wake_item_waiters()
+        return True
 
     def finish(self, total: Optional[int]) -> None:
         with self.cv:
@@ -68,12 +71,14 @@ class _StreamState:
                 self.total = total if total is not None else len(self.seen)
             self.cv.notify_all()
         self._wake_space_waiters()
+        self._wake_item_waiters()
 
     def fail(self, error_blob: bytes) -> None:
         with self.cv:
             self.error = error_blob
             self.cv.notify_all()
         self._wake_space_waiters()
+        self._wake_item_waiters()
 
     def outstanding(self, index: int) -> int:
         with self.lock:
@@ -117,9 +122,47 @@ class _StreamState:
         self._wake_space_waiters()
         return ref
 
+    def next_ref_or_park(self, loop) -> Tuple[Optional[ObjectRef],
+                                              Optional["object"]]:
+        """Async-consumer step: returns ``(ref, None)`` when the next item
+        is available now, or ``(None, future)`` with a future on ``loop``
+        that the producer resolves when state changes (item arrival,
+        end-of-stream, failure).  Raises StopIteration at end-of-stream and
+        the task's error on failure.  Registering the waiter under the same
+        lock the producer's ``add`` takes makes the wakeup race-free."""
+        fut = None
+        with self.cv:
+            if self.next_emit in self.items:
+                ref = self.items.pop(self.next_emit)
+                self.next_emit += 1
+                self.consumed += 1
+            elif self.total is not None and self.next_emit >= self.total:
+                raise StopIteration
+            elif self.error is not None:
+                import pickle
+
+                raise pickle.loads(self.error)
+            else:
+                ref = None
+                fut = loop.create_future()
+                self.item_waiters.append((loop, fut))
+        if ref is not None:
+            self._wake_space_waiters()
+        return ref, fut
+
     def _wake_space_waiters(self):
         with self.lock:
             waiters, self.space_waiters = self.space_waiters, []
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(
+                    lambda f=fut: f.done() or f.set_result(None))
+            except RuntimeError:
+                pass  # loop closed
+
+    def _wake_item_waiters(self):
+        with self.lock:
+            waiters, self.item_waiters = self.item_waiters, []
         for loop, fut in waiters:
             try:
                 loop.call_soon_threadsafe(
@@ -133,8 +176,9 @@ class ObjectRefGenerator:
 
     ``__next__`` blocks until the producer reports the next item (or the
     stream ends / fails). Dropping the generator cancels the stream at the
-    producer. Also usable with ``async for`` (each ``__anext__`` runs the
-    blocking wait on a thread-pool executor).
+    producer. Also usable with ``async for``: ``__anext__`` is
+    push-native — the producer wakes the awaiting loop directly, no
+    thread parked per consumer.
     """
 
     def __init__(self, core_worker, task_id: TaskID):
@@ -162,21 +206,24 @@ class ObjectRefGenerator:
         return self
 
     async def __anext__(self) -> ObjectRef:
+        """Push-native async iteration: items wake this coroutine directly
+        (producer → ``_wake_item_waiters`` → this loop) — no executor
+        thread parked per consumer, which is what lets one proxy loop
+        drive many concurrent SSE streams."""
         import asyncio
 
-        _end = object()  # StopIteration cannot cross a Future boundary
-
-        def step():
-            try:
-                return self.__next__()
-            except StopIteration:
-                return _end
-
         loop = asyncio.get_running_loop()
-        ref = await loop.run_in_executor(None, step)
-        if ref is _end:
-            raise StopAsyncIteration
-        return ref
+        while True:
+            st = self._cw._generators.get(self.task_id)
+            if st is None:
+                raise StopAsyncIteration
+            try:
+                ref, fut = st.next_ref_or_park(loop)
+            except StopIteration:
+                raise StopAsyncIteration from None
+            if ref is not None:
+                return ref
+            await fut
 
     # ----------------------------------------------------------------- misc
     def completed(self) -> bool:

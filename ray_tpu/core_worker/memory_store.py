@@ -239,6 +239,22 @@ class MemoryStore:
         except Exception:  # noqa: BLE001
             pass
 
+    def remove_done_callback(self, object_id: ObjectID, callback) -> None:
+        """Deregister a callback added by :meth:`add_done_callback` that
+        will no longer be awaited (e.g. an async getter timed out) — a
+        wedged producer must not accumulate one dead closure per
+        timed-out wait."""
+        with self._cv:
+            callbacks = self._done_callbacks.get(object_id)
+            if not callbacks:
+                return
+            try:
+                callbacks.remove(callback)
+            except ValueError:
+                return
+            if not callbacks:
+                del self._done_callbacks[object_id]
+
     def mark_pending(self, object_id: ObjectID) -> None:
         with self._cv:
             self._note(object_id, "mark_pending")
@@ -334,6 +350,22 @@ class MemoryStore:
             if e.location is not None:
                 return {"location": e.location}
             return {}
+
+    def get_ready_no_restore(self, object_id: ObjectID
+                             ) -> Tuple[Optional[Entry], bool]:
+        """Atomic peek for async getters: ``(entry, False)`` when the
+        entry is ready in memory, ``(None, True)`` when it is ready but
+        spilled (the caller should run the restoring :meth:`get_if_ready`
+        on a thread — disk I/O must not run on an event loop, and a
+        separate peek-then-read pair would race the spiller), and
+        ``(None, False)`` when not ready."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.is_ready:
+                return None, False
+            if e.value is None and e.spilled_path is not None:
+                return None, True
+            return e, False
 
     def peek_shm_backed(self, object_id: ObjectID) -> bool:
         """True when a ready entry holds a pinned shm view — WITHOUT

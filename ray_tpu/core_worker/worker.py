@@ -545,6 +545,126 @@ class CoreWorker:
                 raise ObjectLostError(ref.object_id, "entry has no value")
         return out
 
+    async def get_async(self, ref: ObjectRef,
+                        timeout: Optional[float] = None) -> Any:
+        """Awaitable single-ref get, usable from ANY event loop (the
+        caller's, not just the IO loop).
+
+        This is the async-native data-plane primitive (reference:
+        ``CoreWorker::GetAsync`` / fiber events): readiness rides the
+        memory store's done callback straight into the awaiting loop —
+        no executor thread parked on a condition variable, no sync-get
+        wakeup.  The hot path (value already in local memory) resolves
+        with zero thread hops; only the rare cold paths (spilled-to-disk
+        restore, remotely-held large value whose holder died) touch a
+        thread."""
+        self._ensure_local(ref, timeout)
+        oid = ref.object_id
+        entry, needs_restore = self.memory_store.get_ready_no_restore(oid)
+        if needs_restore:
+            # ready but spilled: the restore pays disk I/O — a thread,
+            # never this loop
+            entry = await asyncio.get_running_loop().run_in_executor(
+                None, self.memory_store.get_if_ready, oid)
+            if entry is None:
+                raise ObjectLostError(oid, "spilled value lost from disk")
+        if entry is None:
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+
+            def _ready():
+                # fires on whatever thread stored the value (IO loop, C
+                # reply reader): hop into the awaiting loop
+                try:
+                    loop.call_soon_threadsafe(
+                        lambda: fut.done() or fut.set_result(None))
+                except RuntimeError:
+                    pass  # loop closed: the awaiter is gone
+            self.memory_store.add_done_callback(oid, _ready)
+            if timeout is not None:
+                try:
+                    await asyncio.wait_for(fut, timeout)
+                except asyncio.TimeoutError:
+                    # deregister: a wedged producer must not accumulate
+                    # one dead closure per timed-out request
+                    self.memory_store.remove_done_callback(oid, _ready)
+                    raise RtTimeoutError(
+                        f"timed out waiting for {oid}") from None
+            else:
+                await fut
+            entry, needs_restore = \
+                self.memory_store.get_ready_no_restore(oid)
+            if needs_restore:
+                # spilled while pending-to-ready raced us: restore off-loop
+                entry = await asyncio.get_running_loop().run_in_executor(
+                    None, self.memory_store.get_if_ready, oid)
+            if entry is None:
+                raise ObjectLostError(oid, "entry freed while awaited")
+        if entry.error is not None:
+            raise self.deserialize(entry.error)
+        if entry.value is not None:
+            return await self._device_resolve_async(
+                self.deserialize(entry.value))
+        if entry.location is not None:
+            blob = await self._fetch_location_async(ref, entry.location,
+                                                    timeout)
+            return await self._device_resolve_async(self.deserialize(blob))
+        raise ObjectLostError(ref.object_id, "entry has no value")
+
+    async def _device_resolve_async(self, value: Any) -> Any:
+        """Plain values (the data-plane hot path) resolve inline with zero
+        hops; a device-object marker needs the blocking pull machinery in
+        :meth:`_maybe_device_resolve` (sync RPC + ``IoContext.run``), so
+        it goes to a thread rather than wedging the awaiting loop."""
+        from ray_tpu.object_store import device as devmod
+
+        if not isinstance(value, devmod.DeviceObjectMarker):
+            return value
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._maybe_device_resolve, value)
+
+    async def _fetch_location_async(self, ref: ObjectRef, location,
+                                    timeout) -> bytes:
+        """Async twin of :meth:`_fetch_from_location`: large value held by
+        a (possibly remote) executor.  Same-node shm read happens off-loop
+        (first probe may compile the native lib; big reads memcpy); the
+        holder-death → reconstruct fallback reuses the blocking path on a
+        thread — it is the rare recovery branch, not the data plane."""
+        loop = asyncio.get_running_loop()
+        if self._shm not in (False, None):
+            blob = await loop.run_in_executor(None, self._shm_read,
+                                              ref.object_id)
+            if blob is not None:
+                return blob
+        try:
+            # pin the holder client's whole lifetime (connect, read loop,
+            # close) to the IO loop: call_async works from a foreign loop,
+            # but close() schedules on the IO loop — one loop end to end
+            # leaves no cross-loop transport operation at all
+            cf = asyncio.run_coroutine_threadsafe(
+                self._fetch_location_io(ref, location), self._io.loop)
+            return await asyncio.wrap_future(cf)
+        except (RtError, Exception):  # noqa: BLE001 — holder died
+            return await loop.run_in_executor(
+                None, lambda: self._fetch_from_location_rpc(
+                    ref, location, timeout))
+
+    async def _fetch_location_io(self, ref: ObjectRef, location) -> bytes:
+        """Runs ON the IO loop (see _fetch_location_async)."""
+        holder = RpcClient(tuple(location))
+        try:
+            r = await holder.call_async(
+                "object_info", object_id=ref.object_id.binary(),
+                timeout=30.0)
+            if r.get("value") is not None:
+                return r["value"]
+            if r.get("size") is not None:
+                return await self._pull_chunks(
+                    location, ref.object_id, r["size"])
+        finally:
+            holder.close()
+        raise ObjectLostError(ref.object_id, "holder lost the value")
+
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         if fetch_local:
@@ -635,26 +755,12 @@ class CoreWorker:
 
     def _fetch_from_location_rpc(self, ref: ObjectRef, location,
                                  timeout) -> bytes:
-        """Owner-side blocking fetch of a large result held by the executor."""
-        async def go():
-            holder = RpcClient(tuple(location))
-            try:
-                r = await holder.call_async(
-                    "object_info", object_id=ref.object_id.binary(), timeout=30.0)
-                if r.get("value") is not None:
-                    return r["value"]
-                if r.get("size") is not None:
-                    return await self._pull_chunks(
-                        location, ref.object_id, r["size"])
-                return None
-            finally:
-                holder.close()
-
+        """Owner-side blocking fetch of a large result held by the
+        executor (same holder protocol as the async path: ONE
+        implementation, :meth:`_fetch_location_io`, run on the IO loop)."""
         try:
-            value = self._io.run(go(), timeout)
-            if value is None:
-                raise ObjectLostError(ref.object_id, "holder lost the value")
-            return value
+            return self._io.run(
+                self._fetch_location_io(ref, location), timeout)
         except (RtError, Exception) as e:  # holder dead → reconstruct
             if self._try_reconstruct(ref.object_id):
                 entry = self.memory_store.get_blocking(ref.object_id, timeout)

@@ -21,11 +21,20 @@ logger = logging.getLogger(__name__)
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+class _ItemError:
+    """Per-item failure inside a batched call: the other items' results
+    still flow; the proxy re-raises this one for its own request only."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class Replica:
     """Replica harness actor: wraps the user callable, tracks load
     (reference ``python/ray/serve/_private/replica.py``)."""
 
-    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs,
+                 max_ongoing: int = 8):
         import cloudpickle
 
         cls = cloudpickle.loads(cls_blob)
@@ -33,6 +42,8 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        self._max_ongoing = max(1, int(max_ongoing))
+        self._batch_pool = None  # lazy: only batched callers pay for it
 
     def ping(self) -> bool:
         return True
@@ -64,6 +75,36 @@ class Replica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+
+    def handle_request_batch(self, method: str, calls):
+        """Coalesced dispatch (round 11): the proxy ships every request
+        queued behind an in-flight call as ONE actor call, amortizing the
+        per-call submit/reply machinery.  Items run CONCURRENTLY on the
+        harness pool (sized to ``max_ongoing_requests``) so a batch of
+        blocking handlers keeps the latency profile of independent calls;
+        per-item exceptions come back as :class:`_ItemError` so one bad
+        request cannot fail its batchmates."""
+        if len(calls) == 1:
+            args, kwargs = calls[0]
+            try:
+                return [self.handle_request(method, args, kwargs)]
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                return [_ItemError(e)]
+        if self._batch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._batch_pool = ThreadPoolExecutor(
+                max_workers=self._max_ongoing,
+                thread_name_prefix="replica-batch")
+
+        def run(args, kwargs):
+            try:
+                return self.handle_request(method, args, kwargs)
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                return _ItemError(e)
+
+        futures = [self._batch_pool.submit(run, a, k) for a, k in calls]
+        return [f.result() for f in futures]
 
     def handle_request(self, method: str, args, kwargs):
         from ray_tpu.serve import multiplex
@@ -423,7 +464,8 @@ class ServeController:
         remote_cls = ray_tpu.remote(Replica)
         logger.info("starting replica of %s", name)
         return remote_cls.options(**opts).remote(
-            app["cls_blob"], app["args"], app["kwargs"])
+            app["cls_blob"], app["args"], app["kwargs"],
+            max_ongoing=dep.max_ongoing_requests)
 
     def _autoscale_target(self, dep, replicas: List[Any],
                           current: int) -> int:

@@ -10,10 +10,67 @@ accounting stays consistent across method handles.
 
 from __future__ import annotations
 
+import asyncio
+import collections
 import random
 import threading
 import time
 from typing import Any, Dict, List
+
+
+class _SharedDecay:
+    """ONE process-wide timer thread for load-count decay.
+
+    The out-of-worker fallback in :meth:`DeploymentHandle._attach_completion`
+    used to spawn a ``threading.Timer`` per call — a churny client outside
+    any CoreWorker leaked a thread per request.  All decays share a fixed
+    delay, so a single daemon thread draining a FIFO of
+    ``(deadline, callback)`` covers every handle in the process."""
+
+    _instance: "_SharedDecay" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, delay_s: float = 1.0):
+        self.delay_s = delay_s
+        self._items: "collections.deque" = collections.deque()
+        self._cv = threading.Condition()
+        self._thread: threading.Thread = None
+
+    @classmethod
+    def instance(cls) -> "_SharedDecay":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def schedule(self, callback) -> None:
+        with self._cv:
+            self._items.append((time.monotonic() + self.delay_s, callback))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="serve-handle-decay")
+                self._thread.start()
+            self._cv.notify()
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._items:
+                    self._cv.wait()
+                deadline, cb = self._items[0]
+                delay = deadline - time.monotonic()
+                if delay > 0:
+                    self._cv.wait(delay)
+                    continue
+                self._items.popleft()
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observer errors stay local
+                pass
 
 
 class _RouterState:
@@ -46,17 +103,12 @@ class _RouterState:
 
     REFRESH_INTERVAL_S = 1.0
 
-    def refresh(self, force: bool = False):
-        import ray_tpu
-
-        now = time.monotonic()
+    def _is_fresh(self) -> bool:
         with self.lock:
-            fresh = (now - self.last_refresh < self.REFRESH_INTERVAL_S
-                     and self.replicas)
-        if not force and fresh:
-            return
-        version, replicas, max_ongoing, router = ray_tpu.get(
-            [self.controller.get_replicas.remote(self.name)], timeout=30.0)[0]
+            return bool(time.monotonic() - self.last_refresh
+                        < self.REFRESH_INTERVAL_S and self.replicas)
+
+    def _apply_refresh(self, version, replicas, max_ongoing, router) -> None:
         with self.lock:
             if version != self.version:
                 self.version = version
@@ -66,7 +118,28 @@ class _RouterState:
                 self._model_owner.clear()
             self.max_ongoing = max_ongoing
             self.router = router
-            self.last_refresh = now
+            self.last_refresh = time.monotonic()
+
+    def refresh(self, force: bool = False):
+        import ray_tpu
+
+        if not force and self._is_fresh():
+            return
+        version, replicas, max_ongoing, router = ray_tpu.get(
+            [self.controller.get_replicas.remote(self.name)], timeout=30.0)[0]
+        self._apply_refresh(version, replicas, max_ongoing, router)
+
+    async def refresh_async(self, force: bool = False):
+        """Loop-native refresh: awaits the controller reply instead of
+        parking a thread in a blocking ``get`` (the proxy's dispatch path
+        must never block its event loop — rt-analyze loop-blocker gate)."""
+        import ray_tpu
+
+        if not force and self._is_fresh():
+            return
+        version, replicas, max_ongoing, router = await ray_tpu.get_async(
+            self.controller.get_replicas.remote(self.name), timeout=30.0)
+        self._apply_refresh(version, replicas, max_ongoing, router)
 
     @classmethod
     def _prefix_hashes(cls, key) -> List[int]:
@@ -105,8 +178,10 @@ class _RouterState:
 
     MODEL_TABLE_CAP = 1024
 
-    def acquire_replica(self, routing_key=None, model_id=None):
-        """Pick + increment under ONE lock hold; returns
+    def acquire_replica(self, routing_key=None, model_id=None,
+                        count: int = 1):
+        """Pick + increment (by ``count`` — a coalesced batch of N
+        requests loads its replica as N) under ONE lock hold; returns
         (replica, index) or None if no replicas.
 
         pow2: less-loaded of two random replicas. prefix_aware
@@ -149,12 +224,13 @@ class _RouterState:
                 self._model_owner.move_to_end(model_id)
                 while len(self._model_owner) > self.MODEL_TABLE_CAP:
                     self._model_owner.popitem(last=False)
-            self.outstanding[idx] = self.outstanding.get(idx, 0) + 1
+            self.outstanding[idx] = self.outstanding.get(idx, 0) + count
             return self.replicas[idx], idx
 
-    def release(self, idx: int):
+    def release(self, idx: int, count: int = 1):
         with self.lock:
-            self.outstanding[idx] = max(0, self.outstanding.get(idx, 1) - 1)
+            self.outstanding[idx] = max(
+                0, self.outstanding.get(idx, count) - count)
 
 
 def _rebuild_handle(name, controller, method, model_id=None):
@@ -193,16 +269,31 @@ class DeploymentHandle:
             _model_id=(multiplexed_model_id if multiplexed_model_id
                        is not None else self._model_id))
 
-    def remote(self, *args, **kwargs):
-        deadline = time.monotonic() + 30.0
+    ACQUIRE_TIMEOUT_S = 30.0
+
+    def _routing_key(self, args):
         # prefix_aware routing keys off the first positional argument of
         # REQUEST-carrying methods only (the prompt for LLM deployments);
         # bookkeeping methods like poll(request_id) must not churn the
         # affinity table or be routed by a meaningless key
-        routing_key = None
         if self._method in ("__call__", "generate", "submit") and args \
                 and isinstance(args[0], (str, bytes, list, tuple)):
-            routing_key = args[0]
+            return args[0]
+        return None
+
+    def _submit_to(self, acquired, args, kwargs):
+        replica, idx = acquired
+        try:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        except BaseException:
+            self._state.release(idx)
+            raise
+        self._attach_completion(ref, idx)
+        return ref
+
+    def remote(self, *args, **kwargs):
+        deadline = time.monotonic() + self.ACQUIRE_TIMEOUT_S
+        routing_key = self._routing_key(args)
         if self._model_id is not None:
             kwargs = dict(kwargs)
             kwargs["_multiplexed_model_id"] = self._model_id
@@ -217,21 +308,59 @@ class DeploymentHandle:
                         f"deployment {self._name!r} has no running replicas")
                 time.sleep(0.1)
                 self._state.refresh(force=True)
-        replica, idx = acquired
+        return self._submit_to(acquired, args, kwargs)
+
+    async def _acquire_async(self, routing_key=None, model_id=None,
+                             count: int = 1):
+        """Loop-native acquire-with-retry (ONE copy for both async
+        dispatch flavors): every wait is an ``await`` — no ``time.sleep``,
+        no blocking controller ``get``."""
+        deadline = time.monotonic() + self.ACQUIRE_TIMEOUT_S
+        while True:
+            await self._state.refresh_async()
+            acquired = self._state.acquire_replica(routing_key, model_id,
+                                                   count)
+            if acquired is not None:
+                return acquired
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no running replicas")
+            await asyncio.sleep(0.1)
+            await self._state.refresh_async(force=True)
+
+    async def remote_async(self, *args, **kwargs):
+        """Async-native dispatch: same routing, acquire/retry, and load
+        accounting as :meth:`remote`, runnable directly on a server's
+        event loop (the proxy's per-request path)."""
+        routing_key = self._routing_key(args)
+        if self._model_id is not None:
+            kwargs = dict(kwargs)
+            kwargs["_multiplexed_model_id"] = self._model_id
+        acquired = await self._acquire_async(routing_key, self._model_id)
+        return self._submit_to(acquired, args, kwargs)
+
+    async def remote_batch_async(self, calls):
+        """Coalesced dispatch of ``calls`` — a list of ``(args, kwargs)``
+        pairs — as ONE ``handle_request_batch`` actor call to ONE replica
+        (round 11 proxy micro-batching).  Load accounting weights the
+        replica by ``len(calls)``; per-item failures come back as
+        ``_ItemError`` entries in the result list, not exceptions."""
+        count = len(calls)
+        replica, idx = await self._acquire_async(count=count)
         try:
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            ref = replica.handle_request_batch.remote(self._method, calls)
         except BaseException:
-            self._state.release(idx)
+            self._state.release(idx, count)
             raise
-        self._attach_completion(ref, idx)
+        self._attach_completion(ref, idx, count)
         return ref
 
-    def _attach_completion(self, ref, idx: int):
+    def _attach_completion(self, ref, idx: int, count: int = 1):
         """Decrement the outstanding count when the reply lands."""
         state = self._state
 
         def done():
-            state.release(idx)
+            state.release(idx, count)
 
         try:
             from ray_tpu.core_worker.worker import CoreWorker
@@ -239,4 +368,6 @@ class DeploymentHandle:
             cw = CoreWorker.current_or_raise()
             cw.memory_store.add_done_callback(ref.object_id, done)
         except Exception:  # noqa: BLE001 — degrade to time-based decay
-            threading.Timer(1.0, done).start()
+            # on the ONE shared timer thread (never a Timer per call: a
+            # churny out-of-worker client would leak a thread per request)
+            _SharedDecay.instance().schedule(done)

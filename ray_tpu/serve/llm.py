@@ -857,5 +857,33 @@ class LLMServer:
     def poll(self, request_id: str) -> Dict[str, Any]:
         return self.engine.poll(request_id)
 
+    def stream(self, prompt_or_request, **kwargs):
+        """Generator-protocol streaming (round 11): tokens yield as the
+        engine produces them, and the proxy's SSE path PUSHES each one to
+        the client over the streaming-generator protocol — no proxy→
+        replica poll RPCs.  The wait on the engine is replica-local (this
+        generator runs on the replica's executor thread, never an event
+        loop).  ``submit``/``poll`` stay for pre-generator callers."""
+        prompt, kw = self._parse(prompt_or_request, kwargs)
+        request_id = self.engine.submit(
+            prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
+            kw.get("eos_token"), speculation=kw.get("speculation"))
+        from ray_tpu.serve.proxy import SSEBatch
+
+        while True:
+            st = self.engine.poll(request_id)
+            chunks = st["chunks"]
+            if len(chunks) == 1:
+                yield chunks[0]
+            elif chunks:
+                # burst since the last engine poll: ONE streamed item (one
+                # report RPC), fanned back out to per-token SSE events at
+                # the proxy — per-token report RPCs were slower than the
+                # old poll loop
+                yield SSEBatch(chunks)
+            if st["done"]:
+                return
+            time.sleep(0.005)
+
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -1,25 +1,36 @@
 #!/usr/bin/env python
-"""Bench regression guard: diff a fresh BENCH_core.json against the
-checked-in one and fail loudly on a same-box regression of the round-8
-target rows.
+"""Bench regression guard: diff fresh bench artifacts against the
+checked-in ones and fail loudly on a same-box regression of the guarded
+rows.
 
-The checked-in BENCH_core.json is the committed performance record (its
-values were measured on the box named in its captions); a fresh run on
-the SAME box that loses more than ``--threshold`` (default 15%) on any
+Two guarded artifacts:
+
+- ``BENCH_core.json`` (``--fresh``): the round-8 target rows the
+  native-dispatch + warm-pool + control-plane work is graded on.
+- ``BENCH_serve.json`` proxy section (``--fresh-serve``): the round-11
+  Serve data-plane rows (proxy RPS, handle-only calls/s, SSE tokens/s)
+  written by ``python bench_serve.py --proxy``.
+
+The checked-in files are the committed performance record (their values
+were measured on the box named in their captions); a fresh run on the
+SAME box that loses more than ``--threshold`` (default 15%) on any
 guarded row means a regression slipped into the runtime.  Cross-box
 comparisons are meaningless (PERF_PLAN.md hardware notes) — run this only
 against numbers recorded on comparable hardware, e.g. as the opt-in
 ``RT_BENCH_GUARD=1`` stage of scripts/run_tests.sh which produces the
-fresh file and diffs it in one session.
+fresh files and diffs them in one session.
 
 Usage:
     python scripts/bench_guard.py --fresh /tmp/bench/BENCH_core.json \
-        [--checked-in BENCH_core.json] [--threshold 0.15]
+        [--fresh-serve /tmp/bench/BENCH_serve.json] \
+        [--checked-in BENCH_core.json] [--checked-in-serve BENCH_serve.json] \
+        [--threshold 0.15]
 
 Refreshing the committed record after a LEGITIMATE perf change (win or
 accepted trade-off) is ``--capture``: it validates the fresh file has
 every guarded row, prints the per-row deltas it is about to commit, and
-replaces the checked-in file — no more hand-editing BENCH_core.json.
+replaces the checked-in file — preserving captions and per-row history
+fields (before_round8/before_round11) that PERF_PLAN.md references.
 
 Exit codes: 0 = within tolerance (or captured), 1 = regression,
 2 = bad/missing input.
@@ -41,106 +52,197 @@ GUARDED_ROWS = (
     "tasks_per_second_10k_pending",
 )
 
+# The round-11 Serve data-plane rows (ISSUE 9 acceptance): proxy RPS and
+# streaming throughput of the async-native proxy→replica path.
+GUARDED_SERVE_ROWS = (
+    "proxy_rps_plain",
+    "handle_calls_per_second",
+    "sse_tokens_per_second",
+)
 
-def _rows(path: str) -> dict:
+
+def _core_rows(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
     return {r["metric"]: r for r in doc.get("results", [])}
 
 
-def main(argv=None) -> int:
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--fresh", required=True,
-                   help="BENCH_core.json from the run under test")
-    p.add_argument("--checked-in",
-                   default=os.path.join(repo_root, "BENCH_core.json"),
-                   help="committed reference (default: repo BENCH_core.json)")
-    p.add_argument("--threshold", type=float, default=0.15,
-                   help="max tolerated fractional regression (default 0.15)")
-    p.add_argument("--capture", action="store_true",
-                   help="intentionally refresh the checked-in file from "
-                        "--fresh (prints the deltas being committed; "
-                        "refuses a fresh file missing guarded rows)")
-    args = p.parse_args(argv)
+def _serve_rows(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return {r["metric"]: r
+            for r in doc.get("proxy", {}).get("results", [])}
 
-    for path in (args.fresh, args.checked_in):
-        if not os.path.exists(path) and not (args.capture
-                                             and path == args.checked_in):
-            print(f"bench_guard: missing {path}", file=sys.stderr)
-            return 2
-    fresh = _rows(args.fresh)
-    ref = _rows(args.checked_in) if os.path.exists(args.checked_in) else {}
 
-    if args.capture:
-        missing = [m for m in GUARDED_ROWS if m not in fresh]
-        if missing:
-            print("bench_guard: refusing to capture — fresh run is "
-                  f"missing guarded rows: {missing} (bench crashed "
-                  "before them?)", file=sys.stderr)
-            return 2
-        for metric in GUARDED_ROWS:
-            got = float(fresh[metric]["value"])
-            if metric in ref:
-                want = float(ref[metric]["value"])
-                delta = (got - want) / want if want else 0.0
-                print(f"bench_guard: capture {metric:32s} "
-                      f"{want:10.1f} -> {got:10.1f} ({delta:+.1%})")
-            else:
-                print(f"bench_guard: capture {metric:32s} "
-                      f"(new) -> {got:10.1f}")
-        # MERGE, don't wholesale-replace: the committed file carries
-        # top-level keys the bench never emits (the captions dict) and
-        # per-row history fields (before_round8/before_round9) that
-        # PERF_PLAN.md references — a capture updates the measurements
-        # and keeps everything else.
-        with open(args.fresh) as f:
-            fresh_doc = json.load(f)
-        if os.path.exists(args.checked_in):
-            with open(args.checked_in) as f:
-                doc = json.load(f)
-        else:
-            doc = {}
-        merged_rows = []
-        for row in fresh_doc.get("results", []):
-            old = ref.get(row.get("metric"))
-            if old:
-                # history/caption fields the fresh row doesn't carry
-                row = {**{k: v for k, v in old.items()
-                          if k not in row}, **row}
-            merged_rows.append(row)
-        doc.update({k: v for k, v in fresh_doc.items()
-                    if k != "results"})
-        doc["results"] = merged_rows
-        tmp = args.checked_in + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.checked_in)
-        print(f"bench_guard: captured {args.fresh} -> {args.checked_in} "
-              "(captions/history fields preserved)")
-        return 0
-
+def _diff(fresh: dict, ref: dict, guarded, threshold: float,
+          label: str) -> list:
     failures = []
-    for metric in GUARDED_ROWS:
+    for metric in guarded:
         if metric not in ref:
-            print(f"bench_guard: {metric}: not in checked-in file — "
-                  "skipping", file=sys.stderr)
+            print(f"bench_guard: {label}: {metric}: not in checked-in "
+                  "file — skipping", file=sys.stderr)
             continue
         if metric not in fresh:
-            failures.append(f"{metric}: missing from fresh run "
+            failures.append(f"{label}: {metric}: missing from fresh run "
                             "(bench crashed before this row?)")
             continue
         want = float(ref[metric]["value"])
         got = float(fresh[metric]["value"])
         delta = (got - want) / want if want else 0.0
-        verdict = "OK" if delta >= -args.threshold else "REGRESSION"
-        print(f"bench_guard: {metric:32s} checked-in={want:10.1f} "
-              f"fresh={got:10.1f} delta={delta:+.1%} {verdict}")
+        verdict = "OK" if delta >= -threshold else "REGRESSION"
+        print(f"bench_guard: {label}: {metric:28s} "
+              f"checked-in={want:10.1f} fresh={got:10.1f} "
+              f"delta={delta:+.1%} {verdict}")
         if verdict != "OK":
             failures.append(
-                f"{metric}: {want:.1f} -> {got:.1f} ({delta:+.1%}, "
-                f"tolerance -{args.threshold:.0%})")
+                f"{label}: {metric}: {want:.1f} -> {got:.1f} ({delta:+.1%}, "
+                f"tolerance -{threshold:.0%})")
+    return failures
+
+
+def _print_capture(fresh: dict, ref: dict, guarded, label: str) -> None:
+    for metric in guarded:
+        got = float(fresh[metric]["value"])
+        if metric in ref:
+            want = float(ref[metric]["value"])
+            delta = (got - want) / want if want else 0.0
+            print(f"bench_guard: capture {label}: {metric:28s} "
+                  f"{want:10.1f} -> {got:10.1f} ({delta:+.1%})")
+        else:
+            print(f"bench_guard: capture {label}: {metric:28s} "
+                  f"(new) -> {got:10.1f}")
+
+
+def _merge_rows(fresh_rows: list, old_rows: dict) -> list:
+    """Per-row merge keeping history/caption fields the fresh rows don't
+    carry (before_round8/before_round11 etc.)."""
+    merged = []
+    for row in fresh_rows:
+        old = old_rows.get(row.get("metric"))
+        if old:
+            row = {**{k: v for k, v in old.items() if k not in row}, **row}
+        merged.append(row)
+    return merged
+
+
+def _atomic_dump(doc: dict, path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _capture_core(fresh_path: str, checked_in: str, ref: dict) -> None:
+    # MERGE, don't wholesale-replace: the committed file carries
+    # top-level keys the bench never emits (the captions dict) and
+    # per-row history fields that PERF_PLAN.md references.
+    with open(fresh_path) as f:
+        fresh_doc = json.load(f)
+    doc = {}
+    if os.path.exists(checked_in):
+        with open(checked_in) as f:
+            doc = json.load(f)
+    doc.update({k: v for k, v in fresh_doc.items() if k != "results"})
+    doc["results"] = _merge_rows(fresh_doc.get("results", []), ref)
+    _atomic_dump(doc, checked_in)
+    print(f"bench_guard: captured {fresh_path} -> {checked_in} "
+          "(captions/history fields preserved)")
+
+
+def _capture_serve(fresh_path: str, checked_in: str, ref: dict) -> None:
+    # the serve artifact holds engine sections the proxy bench never
+    # touches: capture replaces ONLY the proxy section, row-merged
+    with open(fresh_path) as f:
+        fresh_doc = json.load(f)
+    doc = {}
+    if os.path.exists(checked_in):
+        with open(checked_in) as f:
+            doc = json.load(f)
+    proxy = dict(fresh_doc.get("proxy", {}))
+    proxy["results"] = _merge_rows(proxy.get("results", []), ref)
+    old_proxy = doc.get("proxy", {})
+    for k, v in old_proxy.items():  # keep captions the fresh run lacks
+        proxy.setdefault(k, v)
+    doc["proxy"] = proxy
+    _atomic_dump(doc, checked_in)
+    print(f"bench_guard: captured {fresh_path} proxy section -> "
+          f"{checked_in} (engine sections/history fields preserved)")
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fresh",
+                   help="BENCH_core.json from the run under test")
+    p.add_argument("--fresh-serve",
+                   help="BENCH_serve.json from the run under test "
+                        "(proxy section rows)")
+    p.add_argument("--checked-in",
+                   default=os.path.join(repo_root, "BENCH_core.json"),
+                   help="committed reference (default: repo BENCH_core.json)")
+    p.add_argument("--checked-in-serve",
+                   default=os.path.join(repo_root, "BENCH_serve.json"),
+                   help="committed serve reference (default: repo "
+                        "BENCH_serve.json)")
+    p.add_argument("--threshold", type=float, default=0.15,
+                   help="max tolerated fractional regression (default 0.15)")
+    p.add_argument("--capture", action="store_true",
+                   help="intentionally refresh the checked-in file(s) from "
+                        "the fresh run (prints the deltas being committed; "
+                        "refuses a fresh file missing guarded rows)")
+    args = p.parse_args(argv)
+
+    if not args.fresh and not args.fresh_serve:
+        print("bench_guard: pass --fresh and/or --fresh-serve",
+              file=sys.stderr)
+        return 2
+    legs = []  # (label, fresh_rows, ref_rows, guarded, capture_fn)
+    if args.fresh:
+        if not os.path.exists(args.fresh):
+            print(f"bench_guard: missing {args.fresh}", file=sys.stderr)
+            return 2
+        ref = _core_rows(args.checked_in) \
+            if os.path.exists(args.checked_in) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("core", _core_rows(args.fresh), ref, GUARDED_ROWS,
+                     lambda r: _capture_core(args.fresh, args.checked_in,
+                                             r)))
+    if args.fresh_serve:
+        if not os.path.exists(args.fresh_serve):
+            print(f"bench_guard: missing {args.fresh_serve}",
+                  file=sys.stderr)
+            return 2
+        ref = _serve_rows(args.checked_in_serve) \
+            if os.path.exists(args.checked_in_serve) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in_serve}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("serve", _serve_rows(args.fresh_serve), ref,
+                     GUARDED_SERVE_ROWS,
+                     lambda r: _capture_serve(args.fresh_serve,
+                                              args.checked_in_serve, r)))
+
+    if args.capture:
+        for label, fresh, _ref, guarded, _cap in legs:
+            missing = [m for m in guarded if m not in fresh]
+            if missing:
+                print(f"bench_guard: refusing to capture {label} — fresh "
+                      f"run is missing guarded rows: {missing} (bench "
+                      "crashed before them?)", file=sys.stderr)
+                return 2
+        for label, fresh, ref, guarded, cap in legs:
+            _print_capture(fresh, ref, guarded, label)
+            cap(ref)
+        return 0
+
+    failures = []
+    for label, fresh, ref, guarded, _cap in legs:
+        failures.extend(_diff(fresh, ref, guarded, args.threshold, label))
     if failures:
         print("bench_guard: FAILED", file=sys.stderr)
         for f in failures:

@@ -70,19 +70,32 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
     | tee -a "$RUN_LOG"
 fi
 # Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench
-# fresh and diff the round-8 target rows against the committed
-# BENCH_core.json (>15% same-box regression fails the run). Off by
-# default — the bench needs minutes and quiet CPUs.
+# and the Serve data-plane bench fresh and diff the guarded rows (round-8
+# core targets + round-11 proxy rows) against the committed
+# BENCH_core.json / BENCH_serve.json (>15% same-box regression fails the
+# run). Off by default — the benches need minutes and quiet CPUs.
 if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
   echo "bench guard: running bench_core.py (this takes minutes)..." \
     | tee -a "$RUN_LOG"
   BG_DIR=$(mktemp -d /tmp/rt_bench_guard.XXXXXX)
   if (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 1800 \
         python "$OLDPWD/bench_core.py" > bench.log 2>&1); then
+    echo "bench guard: running bench_serve.py --proxy..." | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          python "$OLDPWD/bench_serve.py" --proxy > bench_serve.log 2>&1)
+    then
+      echo "bench guard: serve bench run failed" \
+           "(log: $BG_DIR/bench_serve.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
     # subshell pipefail: the verdict must be bench_guard's exit status,
     # not tee's
+    SERVE_ARGS=()
+    [[ -f "$BG_DIR/BENCH_serve.json" ]] && \
+      SERVE_ARGS=(--fresh-serve "$BG_DIR/BENCH_serve.json")
     if (set -o pipefail; python scripts/bench_guard.py \
-        --fresh "$BG_DIR/BENCH_core.json" | tee -a "$RUN_LOG"); then
+        --fresh "$BG_DIR/BENCH_core.json" "${SERVE_ARGS[@]}" \
+        | tee -a "$RUN_LOG"); then
       echo "bench guard: ok" | tee -a "$RUN_LOG"
     else
       echo "bench guard: REGRESSION (see above)" | tee -a "$RUN_LOG"

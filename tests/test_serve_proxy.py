@@ -208,6 +208,306 @@ def test_autoscale_under_http_load(proxy_addr):
     serve.delete("slow")
 
 
+def _connect(addr):
+    import socket
+
+    sock = socket.create_connection(
+        (addr["http_host"], addr["http_port"]), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _read_response(sock, buf=b""):
+    """Read one HTTP response off a raw socket; returns (status, body,
+    leftover)."""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed before response head")
+        buf += chunk
+    head, _, buf = buf.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, value = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            clen = int(value.strip())
+    while len(buf) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("closed mid-body")
+        buf += chunk
+    return status, buf[:clen], buf[clen:]
+
+
+def test_malformed_request_line_is_400_listener_stays_healthy(proxy_addr):
+    """Garbage bytes get a 400 RESPONSE (not a silently killed
+    connection), and the listener keeps serving new connections."""
+    sock = _connect(proxy_addr)
+    sock.sendall(b"\xff\xfe\xfd garbage\r\n\r\n")
+    status, body, _ = _read_response(sock)
+    assert status == 400
+    sock.close()
+    # non-UTF-8 header bytes: also a 400, not a dead connection
+    sock = _connect(proxy_addr)
+    sock.sendall(b"GET /-/healthz HTTP/1.1\r\nx-bad: \xff\xfe\r\n\r\n")
+    status, body, _ = _read_response(sock)
+    assert status == 400
+    sock.close()
+    # bad content-length: 400
+    sock = _connect(proxy_addr)
+    sock.sendall(b"GET /-/healthz HTTP/1.1\r\ncontent-length: nope\r\n\r\n")
+    status, _, _ = _read_response(sock)
+    assert status == 400
+    sock.close()
+    # header line over the stream limit: 400, not a silent drop
+    sock = _connect(proxy_addr)
+    sock.sendall(b"GET / HTTP/1.1\r\nx-big: " + b"a" * 200_000 + b"\r\n\r\n")
+    status, _, _ = _read_response(sock)
+    assert status == 400
+    sock.close()
+    # absurd content-length: rejected BEFORE buffering, not an OOM
+    sock = _connect(proxy_addr)
+    sock.sendall(b"POST / HTTP/1.1\r\ncontent-length: 99999999999\r\n\r\n")
+    status, _, _ = _read_response(sock)
+    assert status == 413
+    sock.close()
+    # the listener survived all of it
+    status, _, body = _http(proxy_addr, "/-/healthz")
+    assert status == 200 and body == b"ok"
+
+
+def test_chunked_transfer_encoding_rejected_501(proxy_addr):
+    """A chunked request body used to be silently read as EMPTY and
+    dispatched; now it is rejected explicitly."""
+    sock = _connect(proxy_addr)
+    sock.sendall(b"POST /anywhere HTTP/1.1\r\n"
+                 b"transfer-encoding: chunked\r\n\r\n"
+                 b"5\r\nhello\r\n0\r\n\r\n")
+    status, body, _ = _read_response(sock)
+    assert status == 501
+    assert b"chunked" in body
+    sock.close()
+
+
+def test_pipelined_keepalive_requests(proxy_addr):
+    """Several requests written back-to-back on ONE connection are
+    answered in order on that same connection (HTTP/1.1 pipelining)."""
+    @serve.deployment(name="pecho")
+    class PEcho:
+        def __call__(self, request):
+            return request.text
+
+    serve.run(PEcho.bind())
+    try:
+        sock = _connect(proxy_addr)
+        reqs = b""
+        for i in range(5):
+            body = f"req-{i}".encode()
+            reqs += (f"POST /pecho HTTP/1.1\r\nhost: t\r\n"
+                     f"content-length: {len(body)}\r\n\r\n").encode() + body
+        sock.sendall(reqs)  # pipelined: all five before reading anything
+        buf = b""
+        for i in range(5):
+            status, body, buf = _read_response(sock, buf)
+            assert status == 200 and body == f"req-{i}".encode()
+        sock.close()
+    finally:
+        serve.delete("pecho")
+
+
+def test_concurrent_sse_streams(proxy_addr):
+    """Two SSE streams on one proxy progress CONCURRENTLY (the push path
+    parks on the loop per stream, it does not hold a thread per
+    stream)."""
+    import threading
+
+    @serve.deployment(name="slowstream", max_ongoing_requests=4)
+    class SlowStream:
+        def __call__(self, request):
+            return "ok"
+
+        def stream(self, request):
+            for i in range(4):
+                time.sleep(0.1)
+                yield {"i": i}
+
+    serve.run(SlowStream.bind())
+    try:
+        results, errors = [], []
+
+        def one_stream():
+            url = (f"http://{proxy_addr['http_host']}:"
+                   f"{proxy_addr['http_port']}/slowstream")
+            req = urllib.request.Request(
+                url, data=b"{}", headers={"Accept": "text/event-stream"})
+            events = []
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    for raw in resp:
+                        line = raw.decode().strip()
+                        if line == "data: [DONE]":
+                            break
+                        if line.startswith("data: "):
+                            events.append(json.loads(line[6:]))
+                results.append(events)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=one_stream) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        wall = time.monotonic() - t0
+        assert not errors, errors
+        assert len(results) == 2
+        for events in results:
+            assert events == [{"i": i} for i in range(4)]
+        # concurrent, not serialized: two 0.4s streams well under 2x0.4s
+        # plus overhead (a serialized proxy would take >= 0.8s + setup)
+        assert wall < 3.0
+    finally:
+        serve.delete("slowstream")
+
+
+def test_replica_death_mid_stream_surfaces_error_event(proxy_addr):
+    """A replica dying mid-stream ends the SSE stream with a clean
+    ``event: error`` frame — the client sees a terminal event, not a
+    hung or silently truncated stream."""
+    @serve.deployment(name="dying")
+    class Dying:
+        def __call__(self, request):
+            return "ok"
+
+        def stream(self, request):
+            import os
+
+            yield {"alive": True}
+            os._exit(1)  # hard replica death mid-stream
+
+    serve.run(Dying.bind())
+    try:
+        sock = _connect(proxy_addr)
+        sock.sendall(b"POST /dying HTTP/1.1\r\nhost: t\r\n"
+                     b"accept: text/event-stream\r\n"
+                     b"content-length: 2\r\n\r\n{}")
+        sock.settimeout(60)
+        buf = b""
+        deadline = time.monotonic() + 60
+        while b"event: error" not in buf and time.monotonic() < deadline:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        sock.close()
+        assert b"data: {\"alive\": true}" in buf
+        assert b"event: error" in buf
+    finally:
+        serve.delete("dying")
+
+
+def test_request_hot_path_zero_executor_hops_and_stage_metrics(proxy_addr):
+    """Round-11 acceptance: the request hot path takes ZERO
+    run_in_executor hops (per-stage accounting proves it), every stage
+    reports samples, and concurrent requests coalesce into batched
+    dispatches."""
+    import threading
+
+    @serve.deployment(name="hotpath")
+    class Hot:
+        def __call__(self, request):
+            return {"ok": True}
+
+    serve.run(Hot.bind())
+    try:
+        def hammer():
+            for _ in range(20):
+                _http(proxy_addr, "/hotpath", data=b"x")
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        state = ray_tpu.get([proxy.debug_state.remote()], timeout=30)[0]
+        assert state["executor_hops"] == 0
+        assert state["requests"] >= 80
+        for stage in ("route", "queue", "replica", "render", "write",
+                      "total"):
+            assert state["stages"][stage]["count"] > 0, stage
+        # 4 concurrent closed-loop clients: at least SOME dispatches
+        # must have coalesced into batches of >1
+        assert any(int(k) > 1 for k in state["batch_sizes"]), \
+            state["batch_sizes"]
+    finally:
+        serve.delete("hotpath")
+
+
+def test_batched_dispatch_isolates_item_errors(proxy_addr):
+    """One failing request inside a coalesced batch answers 500 for
+    ITSELF only; its batchmates still answer 200."""
+    import threading
+
+    @serve.deployment(name="mixed")
+    class Mixed:
+        def __call__(self, request):
+            if request.text == "boom":
+                raise ValueError("kaboom")
+            return "fine"
+
+    serve.run(Mixed.bind())
+    try:
+        codes = []
+        lock = threading.Lock()
+
+        def req(body):
+            try:
+                status, _, out = _http(proxy_addr, "/mixed", data=body)
+            except urllib.error.HTTPError as e:
+                status, out = e.code, e.read()
+            with lock:
+                codes.append((body, status, out))
+
+        threads = [threading.Thread(target=req, args=(b,))
+                   for b in [b"ok1", b"boom", b"ok2", b"ok3"] * 3]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(codes) == 12
+        for body, status, out in codes:
+            if body == b"boom":
+                assert status == 500 and b"kaboom" in out
+            else:
+                assert status == 200 and out == b"fine"
+    finally:
+        serve.delete("mixed")
+
+
+def test_shared_decay_no_thread_per_call():
+    """The out-of-worker completion fallback decays on ONE shared timer
+    thread, not a threading.Timer per call."""
+    import threading
+
+    from ray_tpu.serve.handle import _SharedDecay
+
+    decay = _SharedDecay(delay_s=0.05)
+    fired = []
+    before = threading.active_count()
+    for i in range(200):
+        decay.schedule(lambda i=i: fired.append(i))
+    # 200 scheduled callbacks never cost 200 threads
+    assert threading.active_count() <= before + 1
+    deadline = time.monotonic() + 5
+    while len(fired) < 200 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(fired) == 200
+    assert decay.pending() == 0
+
+
 def test_sse_generator_protocol_streaming(proxy_addr):
     """Deployments with a sync-generator ``stream`` method ride the
     streaming-generator protocol (num_returns="streaming"): items PUSH
