@@ -285,6 +285,17 @@ def shutdown() -> None:
                 from ray_tpu.object_store.shm import unlink as shm_unlink
 
                 shm_unlink(node_shm_name(node_id))
+            # reap spill state orphaned by DEAD processes (crashed
+            # sessions, SIGKILLed workers): stale rt_spill_*/
+            # rtshm_spill_* dirs and .tmp.<pid> write fragments. Live
+            # sessions sharing the dir are untouched (pid / segment
+            # liveness checks).
+            try:
+                from ray_tpu.object_store.shm import gc_spill_dirs
+
+                gc_spill_dirs()
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
             _head = None
 
 

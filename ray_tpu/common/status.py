@@ -97,6 +97,19 @@ class ObjectLostError(RtError):
         return (ObjectLostError, (self.object_id, self.reason))
 
 
+class SpillFailedError(RtError):
+    """A spill write to external storage failed (disk full, unwritable
+    dir, dead mount) — the primary copy could NOT be demoted to disk.
+
+    Deliberately NOT an OSError subclass: the spill paths' historical
+    ``except OSError`` guards (arena-full retries, best-effort cleanup)
+    must not swallow it.  Raised by the shm spill engine at the next
+    spill operation after a writer-thread failure, and synchronously by
+    ``put_or_spill``/the demotion loop when the write is refused up
+    front; ``CoreWorker._pack_result`` lets it surface as a task error
+    instead of silently dropping the node-durability guarantee."""
+
+
 class ObjectStoreFullError(RtError):
     pass
 

@@ -83,6 +83,15 @@ class MemoryStore:
         if self._spill_dir is None:
             base = GLOBAL_CONFIG.get("object_spilling_dir") or None
             self._spill_dir = tempfile.mkdtemp(prefix="rt_spill_", dir=base)
+            try:
+                # ownership marker for shutdown GC (object_store/shm.py
+                # gc_spill_dirs): a dir whose recorded owner pid is dead
+                # is an orphan from a crashed session and gets removed
+                with open(os.path.join(self._spill_dir, ".owner"),
+                          "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError:
+                pass
         return self._spill_dir
 
     def _spill_locked(self, need_bytes: int) -> None:

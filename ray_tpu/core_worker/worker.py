@@ -44,6 +44,7 @@ from ray_tpu.common.status import (
     ObjectLostError,
     RtError,
     RtTimeoutError,
+    SpillFailedError,
     TaskCancelledError,
     TaskError,
 )
@@ -2567,6 +2568,7 @@ class CoreWorker:
                 "expected 'device'"))
         results = {}
         stored_device: List[ObjectID] = []
+        stored_host: List[ObjectID] = []
         for oid, value in zip(task.return_ids(), values):
             if tensor_transport == "device":
                 # keep the tensors in THIS process's HBM; ship a marker.
@@ -2584,7 +2586,25 @@ class CoreWorker:
                 stored_device.append(oid)
                 results[oid.binary()] = {"location": self.server.address}
                 continue
-            results[oid.binary()] = self._pack_result(oid, value)
+            try:
+                results[oid.binary()] = self._pack_result(oid, value)
+                stored_host.append(oid)
+            except SpillFailedError as e:
+                # node-durability could not be established (spill disk
+                # full/unwritable): the task fails TYPED instead of the
+                # old silent `except OSError: pass` that dropped the
+                # survive-this-process guarantee on the floor.  Free the
+                # returns already staged (memory store + arena) — the
+                # caller only ever sees the error, so nothing would GC
+                # them (mirrors the device-path cleanup above).  The
+                # FAILING oid is included: _pack_result stores into the
+                # memory store before the spill attempt that raised.
+                for done in stored_host + [oid]:
+                    self.memory_store.free([done])
+                    if self._shm not in (False, None):
+                        self._shm.delete(done.binary())
+                        self._shm.drop_spilled(done.binary())
+                return self._error_reply(task, e)
         return {"results": results}
 
     def _pack_result(self, oid: ObjectID, value: Any) -> dict:
@@ -2616,9 +2636,13 @@ class CoreWorker:
             return {"value": blob}
         self.memory_store.put(oid, value=blob)
         if self.shm is not None:
+            # SpillFailedError deliberately NOT caught here: a refused
+            # spill write means node durability failed — it surfaces as
+            # a typed task error (see _result_reply), never a silent
+            # loss of the survive-this-process guarantee
             try:
                 self.shm.put_or_spill(oid.binary(), blob)
-            except OSError:  # no shm AND no spill dir writable
+            except OSError:  # pure-LRU store (no spill dir configured)
                 pass
         return {"location": self.server.address}
 

@@ -45,9 +45,17 @@ def block_from_batch(batch: Dict[str, np.ndarray]) -> pa.Table:
     cols = {}
     for k, v in batch.items():
         arr = np.asarray(v)
-        if arr.dtype == object or arr.ndim > 1:
-            # ragged / nested columns (lists of token ids, 2-D features):
-            # build from the python values — arrow infers a list type
+        if arr.dtype != object and arr.ndim >= 2:
+            # multi-dim numeric columns (images, payload matrices) become
+            # fixed-shape tensor columns — one buffer wrap, NOT a python
+            # list per row (pa.array(list(v)) walked every cell and made
+            # GB-scale shuffles conversion-bound; same representation
+            # block_from_rows already uses)
+            cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(
+                np.ascontiguousarray(arr))
+        elif arr.dtype == object:
+            # ragged / nested columns (lists of token ids): build from
+            # the python values — arrow infers a list type
             cols[k] = pa.array(list(v))
         else:
             cols[k] = pa.array(arr)
@@ -74,8 +82,13 @@ def block_to_rows(block: pa.Table) -> List[Dict[str, Any]]:
 
 
 def block_to_batch(block: pa.Table) -> Dict[str, np.ndarray]:
-    return {name: np.asarray(col.to_numpy(zero_copy_only=False))
-            for name, col in zip(block.column_names, block.columns)}
+    out = {}
+    for name, col in zip(block.column_names, block.columns):
+        if isinstance(col.type, pa.FixedShapeTensorType):
+            out[name] = col.combine_chunks().to_numpy_ndarray()
+        else:
+            out[name] = np.asarray(col.to_numpy(zero_copy_only=False))
+    return out
 
 
 def block_num_rows(block: pa.Table) -> int:

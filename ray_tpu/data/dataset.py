@@ -686,22 +686,78 @@ class GroupedDataset:
         self._ds = ds
         self._key = key
 
-    def _partitions(self) -> List[Any]:
-        from ray_tpu.data.execution import shuffle_blocks
+    def _stream_source(self):
+        """``("stream", iterator)`` when the upstream is big enough for
+        the streaming engine, else ``("small", refs)``.  Streaming
+        consumes the plan as a STREAM (blocks free as the shuffle window
+        advances — a GB-scale groupby never holds the whole dataset in
+        the object plane); small inputs take the legacy task path, where
+        reducer-actor spawn/reap would dominate (outputs agree either
+        way — parity-tested)."""
+        from ray_tpu.data.context import DataContext
 
-        refs = self._ds._execute()
+        ctx = DataContext.get_current()
+        if not ctx.use_streaming_shuffle:
+            return "small", self._ds._execute()
+        import itertools
+
+        it = iter(self._ds._stream_refs())
+        head = list(itertools.islice(it,
+                                     ctx.streaming_shuffle_min_blocks))
+        try:
+            nxt = next(it)
+        except StopIteration:
+            # the head IS the full materialization: cache it so repeated
+            # aggregations over one GroupedDataset (g.min(); g.max(); …)
+            # don't re-execute the upstream plan (legacy _execute()
+            # semantics for small inputs)
+            if self._ds._cached_refs is None:
+                self._ds._cached_refs = head
+            return "small", head
+        return "stream", itertools.chain(head, [nxt], it)
+
+    def _partitions(self, source=None) -> List[Any]:
+        """Hash-partitioned refs via the legacy task engine (small
+        inputs / streaming disabled)."""
+        from ray_tpu.data.execution import shuffle_blocks_barrier
+
+        refs = self._ds._execute() if source is None else list(source)
         if not refs:
             return []
         n = builtins.max(1, builtins.min(len(refs), 8))
-        return shuffle_blocks(refs, n, mode="hash", key=self._key)
+        return shuffle_blocks_barrier(refs, n, mode="hash", key=self._key)
+
+    def _stream_partitions(self, source, reduce_spec) -> List[Any]:
+        """Streaming engine with the aggregation / group-map pushed INTO
+        the reducers, so only their (small) outputs re-enter the
+        store."""
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.shuffle import streaming_shuffle
+
+        n = DataContext.get_current().shuffle_partitions
+        return streaming_shuffle(source, n, mode="hash", key=self._key,
+                                 reduce_spec=reduce_spec)
 
     def _agg(self, aggs: List[tuple]) -> Dataset:
         """aggs: [(out_col, in_col_or_None, kind)] — one pass over each
-        hash partition computes every requested aggregate per key."""
+        hash partition computes every requested aggregate per key.  On
+        the streaming engine the fold is ALGEBRAIC and per-arrival
+        (sum/count/min/max/sumsq partials inside the reducer actors):
+        reducer memory is O(distinct keys), and no merged partition ever
+        materializes."""
         import ray_tpu
 
         key = self._key
         fns = self._AGG_FNS
+
+        kind, source = self._stream_source()
+        if kind == "stream":
+            parts = self._stream_partitions(source, ("agg", list(aggs)))
+            out = []
+            for blk in ray_tpu.get(parts):
+                out.extend(B.block_to_rows(blk))
+            out.sort(key=lambda r: r[key])
+            return from_items_rows(out)
 
         @ray_tpu.remote
         def _agg_partition(block):
@@ -726,7 +782,8 @@ class GroupedDataset:
 
         out = []
         for blk in ray_tpu.get(
-                [_agg_partition.remote(p) for p in self._partitions()]):
+                [_agg_partition.remote(p)
+                 for p in self._partitions(source)]):
             out.extend(B.block_to_rows(blk))
         out.sort(key=lambda r: r[self._key])
         return from_items_rows(out)
@@ -768,10 +825,23 @@ class GroupedDataset:
         Grouping is columnar: one stable argsort on the key column, then
         row views sliced out of numpy columns — never per-cell Arrow
         ``as_py`` conversion, which made GB-scale groupbys ~20x slower
-        than the shuffle that feeds them."""
+        than the shuffle that feeds them.
+
+        On the streaming engine the group function runs INSIDE the
+        shuffle reducers (``reduce_spec=("groups", fn)``): the merged
+        partitions — which together are the whole dataset — never
+        re-enter the object plane; only ``fn``'s output does."""
         import ray_tpu
 
         key = self._key
+
+        kind, source = self._stream_source()
+        if kind == "stream":
+            import cloudpickle
+
+            refs = self._stream_partitions(
+                source, ("groups", cloudpickle.dumps(fn)))
+            return Dataset([_FromRefs(refs)])
 
         @ray_tpu.remote
         def _map_partition(block):
@@ -798,7 +868,7 @@ class GroupedDataset:
                     out.extend(res)
             return B.block_from_rows(out)
 
-        refs = [_map_partition.remote(p) for p in self._partitions()]
+        refs = [_map_partition.remote(p) for p in self._partitions(source)]
         return Dataset([_FromRefs(refs)])
 
 

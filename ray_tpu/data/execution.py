@@ -222,95 +222,67 @@ class StreamingExecutor:
 
 # --------------------------------------------------------------- shuffle
 
-def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
+def shuffle_blocks(block_refs, num_output_blocks: int, *,
                    mode: str, key: Optional[str] = None,
                    seed: Optional[int] = None,
                    descending: bool = False) -> List[Any]:
     """Distributed map/reduce shuffle (reference hash_shuffle.py):
     mode ∈ {"repartition", "random", "hash", "sort"}. Returns reduce-output
-    block refs; every stage is a task, nothing materializes centrally."""
+    block refs; every stage is a task, nothing materializes centrally.
+
+    Default engine: the streaming shuffle (``data/shuffle.py``) — fused
+    partition objects, windowed map submission, per-arrival reducer
+    merge.  ``block_refs`` may be a lazy iterator there (hash/random
+    consume it incrementally; a LIST is drained so inputs free as the
+    window advances).  ``DataContext.use_streaming_shuffle = False``
+    (or env ``RT_streaming_shuffle=0``) selects the legacy two-barrier
+    task engine — bit-identical outputs, kept for parity testing."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    if ctx.use_streaming_shuffle:
+        block_refs = list(block_refs)
+        # small inputs: reducer-actor spawn/reap costs more than the
+        # whole shuffle — take the task engine (bit-identical outputs)
+        if len(block_refs) >= ctx.streaming_shuffle_min_blocks:
+            from ray_tpu.data.shuffle import streaming_shuffle
+
+            return streaming_shuffle(block_refs, num_output_blocks,
+                                     mode=mode, key=key, seed=seed,
+                                     descending=descending)
+    return shuffle_blocks_barrier(list(block_refs), num_output_blocks,
+                                  mode=mode, key=key, seed=seed,
+                                  descending=descending)
+
+
+def shuffle_blocks_barrier(block_refs: List[Any], num_output_blocks: int, *,
+                           mode: str, key: Optional[str] = None,
+                           seed: Optional[int] = None,
+                           descending: bool = False) -> List[Any]:
+    """Legacy two-barrier engine: one task per block returns N separate
+    partition objects (``num_returns=n`` — M×N store entries), one
+    reduce task per output partition takes all M parts as args.  The
+    streaming engine is the default; this stays as the parity oracle."""
     import ray_tpu
+    from ray_tpu.data import shuffle as S
 
     n = max(1, num_output_blocks)
-
-    @ray_tpu.remote
-    def _sample_keys(block):
-        batch = B.block_to_batch(block)
-        col = batch.get(key)
-        if col is None or len(col) == 0:
-            return np.empty(0)
-        k = max(1, len(col) // 16)
-        idx = np.random.default_rng(0).choice(len(col), size=k, replace=False)
-        return np.asarray(col)[idx]
 
     boundaries = None
     offsets = None
     if mode == "repartition":
-        # order-preserving: rows map to output partitions by GLOBAL row
-        # position (contiguous ranges), so repartition keeps Dataset order
-        @ray_tpu.remote
-        def _count(block):
-            return B.block_num_rows(block)
-
-        counts = ray_tpu.get([_count.remote(r) for r in block_refs])
-        total = max(1, sum(counts))
-        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        offsets = {i: (int(starts[i]), total) for i in range(len(counts))}
+        offsets = S.compute_repartition_offsets(block_refs)
     if mode == "sort":
-        samples = [s for s in ray_tpu.get(
-            [_sample_keys.remote(r) for r in block_refs]) if len(s)]
-        allk = np.sort(np.concatenate(samples)) if samples else np.empty(0)
-        if len(allk):
-            qs = np.linspace(0, 1, n + 1)[1:-1]
-            boundaries = np.quantile(allk, qs)
-        else:
-            boundaries = np.empty(0)
+        boundaries = S.compute_sort_boundaries(block_refs, key, n)
 
     @ray_tpu.remote
     def _partition(block, part_seed, block_index):
         rows = B.block_num_rows(block)
         batch = B.block_to_batch(block)
-        if mode == "repartition":
-            start, total = offsets[block_index]
-            assign = (start + np.arange(rows)) * n // total
-            assign = np.minimum(assign, n - 1)
-        elif mode == "random":
-            rng = np.random.default_rng(part_seed)
-            assign = rng.integers(0, n, size=rows)
-        elif mode == "hash":
-            # Python's hash() is per-process salted for str/bytes: equal
-            # keys in different partition TASKS would land in different
-            # reducers. Use a stable content hash instead.
-            import zlib
-
-            def stable(x):
-                if hasattr(x, "item"):
-                    x = x.item()
-                if isinstance(x, (int, np.integer)):
-                    return int(x)
-                if isinstance(x, float) and x.is_integer():
-                    # a key column that materializes int64 in one block
-                    # and float64 in another (e.g. Arrow nulls) must
-                    # still route equal keys to ONE partition
-                    return int(x)
-                b = x if isinstance(x, bytes) else str(x).encode()
-                return zlib.crc32(b)
-
-            col = np.asarray(batch[key])
-            if np.issubdtype(col.dtype, np.integer):
-                # vectorized: the per-row python hash loop dominated
-                # GB-scale shuffles
-                assign = (col.astype(np.int64) % n).astype(np.int64)
-            else:
-                assign = np.array([stable(x) % n for x in col], np.int64)
-        elif mode == "sort":
-            col = np.asarray(batch[key])
-            assign = np.searchsorted(boundaries, col, side="right") \
-                if len(boundaries) else np.zeros(rows, np.int64)
-            if descending:
-                assign = (n - 1) - assign
-        else:
-            raise ValueError(mode)
+        assign = S.assign_partitions(
+            batch, rows, mode=mode, n=n, key=key, part_seed=part_seed,
+            block_offset=None if offsets is None else offsets[block_index],
+            boundaries=boundaries, descending=descending)
         parts = []
         for p in range(n):
             mask = assign == p
@@ -350,51 +322,63 @@ def shuffle_blocks(block_refs: List[Any], num_output_blocks: int, *,
 # ------------------------------------------------------------------ join
 
 def _stable_hash(x) -> int:
-    """Content hash stable across processes (Python's str/bytes hash is
-    per-process salted, which would scatter equal keys across reducers)."""
-    import zlib
+    """Back-compat alias: the one implementation lives in
+    ``data/shuffle.py`` (both the shuffle router and the join
+    partitioner must agree byte-for-byte)."""
+    from ray_tpu.data.shuffle import _stable_hash as impl
 
-    if hasattr(x, "item"):
-        x = x.item()
-    if isinstance(x, (int, np.integer)):
-        return int(x)
-    b = x if isinstance(x, bytes) else str(x).encode()
-    return zlib.crc32(b)
+    return impl(x)
 
 
 def hash_join(left_refs: List[Any], right_refs: List[Any], on: str,
               right_on: str, how: str, n: int, suffix: str) -> List[Any]:
     """Distributed hash join (reference
     ``_internal/execution/operators/join.py``): hash-partition both sides
-    on the key (one task per block), then one join task per partition
-    builds a dict index on its right partition and probes with the left.
-    Returns joined block refs; nothing materializes centrally."""
+    on the key — one FUSED partition object per input block (all n
+    slices + offset index; the M×N object explosion of one-object-per-
+    partition is gone) — then one join task per partition decodes ONLY
+    its slice of each fused object, builds a dict index on its right
+    rows and probes with the left.  Returns joined block refs; nothing
+    materializes centrally."""
     import ray_tpu
+    from ray_tpu.data import shuffle as S
 
     n = max(1, n)
 
     @ray_tpu.remote
-    def _partition(block, key_col):
+    def _partition(block, key_col, block_index):
         batch = B.block_to_batch(block)
-        if key_col not in batch:
-            empty = B.block_from_batch({c: np.asarray(v)[:0]
-                                        for c, v in batch.items()})
-            return empty if n == 1 else tuple(empty for _ in range(n))
+        rows = B.block_num_rows(block)
+        if key_col not in batch or rows == 0:
+            # rows without the key column can't match anything: route an
+            # empty (schema-preserving) slice set
+            empty = {c: np.asarray(v)[:0] for c, v in batch.items()}
+            return S.make_fused(empty, np.zeros(0, np.int64), n,
+                                block_index)
         assign = np.array([_stable_hash(x) % n for x in batch[key_col]],
                           np.int64)
-        parts = [B.block_from_batch(
-            {c: np.asarray(v)[assign == p] for c, v in batch.items()})
-            for p in range(n)]
-        return parts[0] if n == 1 else tuple(parts)
+        return S.make_fused(batch, assign, n, block_index)
 
     @ray_tpu.remote
-    def _join(n_left, *parts):
+    def _join(p, n_left, fused_refs):
+        # refs ride INSIDE a list (borrowed refs, not task args): each
+        # join task resolves them ONE AT A TIME and keeps only its own
+        # partition's rows — arg-fetching all M+N fused objects would
+        # pin the entire both-side dataset in every join task for the
+        # task's whole lifetime (n× the working set under a capped
+        # arena; the old per-partition objects pinned ~dataset/n).
+        def rows_of(ref):
+            fp = ray_tpu.get([ref])[0]
+            if fp.rows_in(p) == 0:
+                return []
+            return B.block_to_rows(B.block_from_batch(fp.decode_copy(p)))
+
         left_rows = []
-        for b in parts[:n_left]:
-            left_rows.extend(B.block_to_rows(b))
+        for ref in fused_refs[:n_left]:
+            left_rows.extend(rows_of(ref))
         right_rows = []
-        for b in parts[n_left:]:
-            right_rows.extend(B.block_to_rows(b))
+        for ref in fused_refs[n_left:]:
+            right_rows.extend(rows_of(ref))
         left_cols = list(left_rows[0].keys()) if left_rows else []
         right_cols = list(right_rows[0].keys()) if right_rows else []
 
@@ -428,18 +412,12 @@ def hash_join(left_refs: List[Any], right_refs: List[Any], on: str,
                     out.append(out_row(None, r))
         return B.block_from_rows(out)
 
-    def parts_of(refs, key_col):
-        lists = [_partition.options(num_returns=n).remote(r, key_col)
-                 for r in refs]
-        return [p if isinstance(p, list) else [p] for p in lists]
-
-    lparts = parts_of(left_refs, on)
-    rparts = parts_of(right_refs, right_on)
-    return [
-        _join.remote(len(lparts),
-                     *[parts[p] for parts in lparts],
-                     *[parts[p] for parts in rparts])
-        for p in range(n)]
+    lfused = [_partition.remote(r, on, i)
+              for i, r in enumerate(left_refs)]
+    rfused = [_partition.remote(r, right_on, i)
+              for i, r in enumerate(right_refs)]
+    return [_join.remote(p, len(lfused), lfused + rfused)
+            for p in range(n)]
 
 
 # ------------------------------------------------------------ split feed

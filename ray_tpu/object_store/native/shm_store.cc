@@ -590,6 +590,46 @@ int rts_lru_candidate(int h, uint8_t* out_id, uint32_t* out_id_len) {
   return 0;
 }
 
+// Batched victim selection for the spill engine: up to `max_n` LRU
+// sealed refcount-0 victims, oldest first, stopping early once their
+// combined arena allocation reaches `need_bytes` (0 = no byte target,
+// fill max_n).  One lock acquisition and one ctypes crossing replace a
+// per-victim rts_lru_candidate loop — the demotion path's lock traffic
+// under arena pressure was one acquisition per victim per failed put.
+// out_ids is max_n * 32 bytes (kIdBytes per slot); out_id_lens is
+// max_n u32s.  Returns the number of victims written (0 = nothing
+// evictable), or -errno.
+int rts_lru_candidates(int h, uint8_t* out_ids, uint32_t* out_id_lens,
+                       uint32_t max_n, uint64_t need_bytes) {
+  if (!ValidHandle(h) || max_n == 0) return -EINVAL;
+  Header* hdr = g_stores[h].hdr;
+  if (LockHeld(hdr) != 0) return -EINVAL;
+  uint32_t n = 0;
+  uint64_t gathered = 0;
+  // selection sort over the (small) victim set: repeatedly take the
+  // oldest not-yet-taken victim. max_n is small (spill batches), so the
+  // quadratic scan stays cheap relative to the disk writes it feeds.
+  uint64_t last_tick = 0;
+  while (n < max_n && (need_bytes == 0 || gathered < need_bytes)) {
+    Entry* victim = nullptr;
+    for (uint32_t i = 0; i < kTableSize; i++) {
+      Entry& e = hdr->table[i];
+      if (e.used == 1 && e.sealed && !e.pending_delete && e.refcount == 0 &&
+          (n == 0 || e.lru_tick > last_tick) &&
+          (!victim || e.lru_tick < victim->lru_tick))
+        victim = &e;
+    }
+    if (!victim) break;
+    memcpy(out_ids + (uint64_t)n * kIdBytes, victim->id, victim->id_len);
+    out_id_lens[n] = victim->id_len;
+    last_tick = victim->lru_tick;
+    gathered += victim->alloc;
+    n++;
+  }
+  pthread_mutex_unlock(&hdr->lock);
+  return (int)n;
+}
+
 int rts_stats(int h, uint64_t* capacity, uint64_t* used,
               uint64_t* num_objects) {
   if (!ValidHandle(h)) return -EINVAL;

@@ -1339,8 +1339,41 @@ class Raylet:
             "session_dir": self.session_dir,
         }
 
+    def _spill_state(self) -> dict:
+        """Node spill-subsystem snapshot: this handle's engine counters
+        plus the shared spill dir's on-disk footprint.  Disk scan —
+        callers must run it OFF the loop (h_debug_state to_threads it)."""
+        out: dict = {}
+        try:
+            store = getattr(self, "_shm_stats_store", None)
+            if store is None:
+                return out
+            out["engine"] = store.spill_stats()
+            spill_dir = store._spill_dir
+            if spill_dir and os.path.isdir(spill_dir):
+                files = bytes_on_disk = 0
+                with os.scandir(spill_dir) as it:
+                    for e in it:
+                        if e.name.startswith("."):
+                            continue
+                        try:
+                            bytes_on_disk += e.stat().st_size
+                            files += 1
+                        except OSError:
+                            continue
+                out["dir"] = {"path": spill_dir, "files": files,
+                              "bytes": bytes_on_disk}
+        except Exception:  # noqa: BLE001 — diagnostics are best-effort
+            pass
+        return out
+
     async def h_debug_state(self):
+        def _spill():
+            return self._spill_state()
+
+        spill = await asyncio.to_thread(_spill)
         return {
+            "spill": spill,
             "workers": {
                 w.worker_id.hex()[:8]: {"state": w.state, "addr": w.address}
                 for w in self._workers.values()

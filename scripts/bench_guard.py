@@ -3,13 +3,16 @@
 checked-in ones and fail loudly on a same-box regression of the guarded
 rows.
 
-Two guarded artifacts:
+Three guarded artifacts:
 
 - ``BENCH_core.json`` (``--fresh``): the round-8 target rows the
   native-dispatch + warm-pool + control-plane work is graded on.
 - ``BENCH_serve.json`` proxy section (``--fresh-serve``): the round-11
   Serve data-plane rows (proxy RPS, handle-only calls/s, SSE tokens/s)
   written by ``python bench_serve.py --proxy``.
+- ``BENCH_data.json`` (``--fresh-data``): the round-12 GB-scale groupby
+  shuffle row (streaming shuffle engine + async spill path) written by
+  ``python bench_data.py --out <dir>/BENCH_data.json``.
 
 The checked-in files are the committed performance record (their values
 were measured on the box named in their captions); a fresh run on the
@@ -30,7 +33,8 @@ Refreshing the committed record after a LEGITIMATE perf change (win or
 accepted trade-off) is ``--capture``: it validates the fresh file has
 every guarded row, prints the per-row deltas it is about to commit, and
 replaces the checked-in file — preserving captions and per-row history
-fields (before_round8/before_round11) that PERF_PLAN.md references.
+fields (before_round8/before_round11/before_round12) that PERF_PLAN.md
+references.
 
 Exit codes: 0 = within tolerance (or captured), 1 = regression,
 2 = bad/missing input.
@@ -60,6 +64,13 @@ GUARDED_SERVE_ROWS = (
     "sse_tokens_per_second",
 )
 
+# The round-12 Data-plane row (ISSUE 10 acceptance): GB-scale groupby
+# shuffle throughput of the streaming shuffle engine + async spill path
+# (``python bench_data.py --out <dir>/BENCH_data.json``).
+GUARDED_DATA_ROWS = (
+    "groupby_shuffle_gb_per_min",
+)
+
 
 def _core_rows(path: str) -> dict:
     with open(path) as f:
@@ -72,6 +83,16 @@ def _serve_rows(path: str) -> dict:
         doc = json.load(f)
     return {r["metric"]: r
             for r in doc.get("proxy", {}).get("results", [])}
+
+
+# BENCH_data.json shares BENCH_core.json's shape (top-level results list)
+_data_rows = _core_rows
+
+
+def _capture_data(fresh_path: str, checked_in: str, ref: dict) -> None:
+    # same merge discipline as core: per-row history fields the fresh
+    # run never emits (before_round12) survive the capture
+    _capture_core(fresh_path, checked_in, ref)
 
 
 def _diff(fresh: dict, ref: dict, guarded, threshold: float,
@@ -185,6 +206,13 @@ def main(argv=None) -> int:
                    default=os.path.join(repo_root, "BENCH_serve.json"),
                    help="committed serve reference (default: repo "
                         "BENCH_serve.json)")
+    p.add_argument("--fresh-data",
+                   help="BENCH_data.json from the run under test "
+                        "(groupby shuffle row)")
+    p.add_argument("--checked-in-data",
+                   default=os.path.join(repo_root, "BENCH_data.json"),
+                   help="committed data reference (default: repo "
+                        "BENCH_data.json)")
     p.add_argument("--threshold", type=float, default=0.15,
                    help="max tolerated fractional regression (default 0.15)")
     p.add_argument("--capture", action="store_true",
@@ -193,9 +221,9 @@ def main(argv=None) -> int:
                         "refuses a fresh file missing guarded rows)")
     args = p.parse_args(argv)
 
-    if not args.fresh and not args.fresh_serve:
-        print("bench_guard: pass --fresh and/or --fresh-serve",
-              file=sys.stderr)
+    if not args.fresh and not args.fresh_serve and not args.fresh_data:
+        print("bench_guard: pass --fresh, --fresh-serve and/or "
+              "--fresh-data", file=sys.stderr)
         return 2
     legs = []  # (label, fresh_rows, ref_rows, guarded, capture_fn)
     if args.fresh:
@@ -226,6 +254,21 @@ def main(argv=None) -> int:
                      GUARDED_SERVE_ROWS,
                      lambda r: _capture_serve(args.fresh_serve,
                                               args.checked_in_serve, r)))
+    if args.fresh_data:
+        if not os.path.exists(args.fresh_data):
+            print(f"bench_guard: missing {args.fresh_data}",
+                  file=sys.stderr)
+            return 2
+        ref = _data_rows(args.checked_in_data) \
+            if os.path.exists(args.checked_in_data) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in_data}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("data", _data_rows(args.fresh_data), ref,
+                     GUARDED_DATA_ROWS,
+                     lambda r: _capture_data(args.fresh_data,
+                                             args.checked_in_data, r)))
 
     if args.capture:
         for label, fresh, _ref, guarded, _cap in legs:

@@ -69,11 +69,12 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
     bash scripts/flake_triage.sh -n "$TRIAGE_RUNS" "${failed_files[@]}" \
     | tee -a "$RUN_LOG"
 fi
-# Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench
-# and the Serve data-plane bench fresh and diff the guarded rows (round-8
-# core targets + round-11 proxy rows) against the committed
-# BENCH_core.json / BENCH_serve.json (>15% same-box regression fails the
-# run). Off by default — the benches need minutes and quiet CPUs.
+# Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench,
+# the Serve data-plane bench, and the GB-scale data shuffle bench fresh
+# and diff the guarded rows (round-8 core targets + round-11 proxy rows
+# + round-12 groupby shuffle row) against the committed BENCH_core.json
+# / BENCH_serve.json / BENCH_data.json (>15% same-box regression fails
+# the run). Off by default — the benches need minutes and quiet CPUs.
 if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
   echo "bench guard: running bench_core.py (this takes minutes)..." \
     | tee -a "$RUN_LOG"
@@ -88,13 +89,27 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
            "(log: $BG_DIR/bench_serve.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
+    echo "bench guard: running bench_data.py (GB-scale shuffle)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          python "$OLDPWD/bench_data.py" \
+          --out "$BG_DIR/BENCH_data.json" > bench_data.log 2>&1)
+    then
+      echo "bench guard: data bench run failed" \
+           "(log: $BG_DIR/bench_data.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
     # subshell pipefail: the verdict must be bench_guard's exit status,
     # not tee's
     SERVE_ARGS=()
     [[ -f "$BG_DIR/BENCH_serve.json" ]] && \
       SERVE_ARGS=(--fresh-serve "$BG_DIR/BENCH_serve.json")
+    DATA_ARGS=()
+    [[ -f "$BG_DIR/BENCH_data.json" ]] && \
+      DATA_ARGS=(--fresh-data "$BG_DIR/BENCH_data.json")
     if (set -o pipefail; python scripts/bench_guard.py \
         --fresh "$BG_DIR/BENCH_core.json" "${SERVE_ARGS[@]}" \
+        "${DATA_ARGS[@]}" \
         | tee -a "$RUN_LOG"); then
       echo "bench guard: ok" | tee -a "$RUN_LOG"
     else

@@ -25,12 +25,16 @@ def capped_cluster(tmp_path):
     arena (zero heap charge — memory_store routing + arena-direct task
     returns), so heap-cap pressure alone no longer forces any spilling;
     the arena cap is what drives the spill-before-evict path this test
-    exists to exercise."""
+    exists to exercise.  The async spill writer's queue is ALSO pinned
+    tiny: its pending map otherwise absorbs (and on free, cancels)
+    transient demotions entirely in memory, and this fixture exists to
+    drive bytes across the DISK path."""
     spill_root = str(tmp_path / "spill")
     os.makedirs(spill_root, exist_ok=True)
     os.environ["RT_object_spilling_dir"] = spill_root
     os.environ["RT_memory_store_max_bytes"] = str(24 << 20)
     os.environ["RT_shm_store_bytes"] = str(32 << 20)
+    os.environ["RT_spill_queue_mb"] = "2"
     GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", spill_root)
     GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes", 24 << 20)
     GLOBAL_CONFIG.set_system_config_value("shm_store_bytes", 32 << 20)
@@ -41,6 +45,7 @@ def capped_cluster(tmp_path):
     os.environ.pop("RT_object_spilling_dir", None)
     os.environ.pop("RT_memory_store_max_bytes", None)
     os.environ.pop("RT_shm_store_bytes", None)
+    os.environ.pop("RT_spill_queue_mb", None)
     GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", "")
     GLOBAL_CONFIG.set_system_config_value("memory_store_max_bytes",
                                           512 * 1024 * 1024)
@@ -53,11 +58,41 @@ def _spilled_bytes(root: str) -> int:
     total = 0
     for pat in ("rt_spill_*", "rtshm_spill_*"):
         for p in glob.glob(os.path.join(root, pat, "*")):
+            if os.path.basename(p).startswith("."):
+                continue
             try:
                 total += os.path.getsize(p)
             except OSError:
                 pass  # freed objects drop their spill files concurrently
     return total
+
+
+class _PeakSpill:
+    """Sample the spill dir while the pipeline runs: the streaming
+    engine frees objects as its window advances and their spill files
+    are unlinked DURING the run, so an end-state scan alone can read 0
+    even when the disk path carried the dataset."""
+
+    def __init__(self, root: str):
+        import threading
+
+        self._root = root
+        self.peak = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(0.02):
+            self.peak = max(self.peak, _spilled_bytes(self._root))
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=2)
+        self.peak = max(self.peak, _spilled_bytes(self._root))
 
 
 def test_groupby_shuffle_with_spilling(capped_cluster):
@@ -85,7 +120,8 @@ def test_groupby_shuffle_with_spilling(capped_cluster):
                 "val_sum": sum(r["val"] for r in rows),
                 "probe": int(rows[0]["payload"][0])}
 
-    out = ds.groupby("key").map_groups(summarize).take_all()
+    with _PeakSpill(spill_root) as spill:
+        out = ds.groupby("key").map_groups(summarize).take_all()
     assert len(out) == groups
     assert sum(r["n"] for r in out) == n_rows
     total = sum(r["val_sum"] for r in out)
@@ -94,8 +130,8 @@ def test_groupby_shuffle_with_spilling(capped_cluster):
     # each key landed wholly in one group task
     per_key = n_rows // groups
     assert all(r["n"] == per_key for r in out)
-    assert _spilled_bytes(spill_root) > 0, \
-        "cap 24MB < 96MB working set but nothing spilled"
+    assert spill.peak > 0, \
+        "cap 24MB < 96MB working set but nothing crossed the spill path"
 
 
 def test_sort_shuffle_with_spilling(capped_cluster):
@@ -115,7 +151,200 @@ def test_sort_shuffle_with_spilling(capped_cluster):
         return batch
 
     ds = rtd.range(n_rows, num_blocks=16).map_batches(attach).sort("k")
-    ks = [r["k"] for r in ds.take_all()]
+    with _PeakSpill(spill_root) as spill:
+        ks = [r["k"] for r in ds.take_all()]
     assert len(ks) == n_rows
     assert all(ks[i] <= ks[i + 1] for i in range(len(ks) - 1))
-    assert _spilled_bytes(spill_root) > 0
+    assert spill.peak > 0
+
+
+# ------------------------------------------------- fused partition objects
+
+
+@pytest.fixture(scope="module")
+def shared_cluster():
+    """ONE plain cluster for the fused/parity tests below (they don't
+    need capped stores, and seven per-test init/shutdown cycles cost
+    more than the tests).  Lazily created AFTER the capped-cluster tests
+    above have torn theirs down (pytest runs this file in order), torn
+    down at module end — the process-global runtime is never
+    double-initialized."""
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestFusedPartitions:
+    def _batch(self, rows=64):
+        rng = np.random.default_rng(7)
+        return {
+            "k": (np.arange(rows) % 5).astype(np.int64),
+            "v": rng.normal(size=rows),
+            "name": np.array([f"r{i % 3}" for i in range(rows)]),
+            "feat": rng.integers(0, 255, size=(rows, 16), dtype=np.uint8),
+        }
+
+    def test_routing_and_offset_index(self):
+        from ray_tpu.data.shuffle import assign_partitions, make_fused
+
+        batch = self._batch()
+        assign = assign_partitions(batch, 64, mode="hash", n=4, key="k",
+                                   part_seed=None, block_offset=None,
+                                   boundaries=None, descending=False)
+        fp = make_fused(batch, assign, 4, block_index=3)
+        assert fp.num_partitions == 4
+        assert fp.block_index == 3
+        assert sum(fp.rows_in(p) for p in range(4)) == 64
+        for p in range(4):
+            chunk = fp.decode(p)
+            assert set(np.asarray(chunk["k"]).astype(np.int64) % 4) \
+                <= {p}
+
+    def test_slice_aliasing_and_mutate_isolation(self):
+        """Deserialized fused objects expose partition slices as
+        READ-ONLY views aliasing the serialized payload (the zero-copy
+        pinned-view property); decode_copy yields independent memory —
+        mutating it must not leak into other readers of the object."""
+        from ray_tpu.core_worker import serialization as ser
+        from ray_tpu.data.shuffle import assign_partitions, make_fused
+
+        batch = self._batch()
+        assign = assign_partitions(batch, 64, mode="hash", n=4, key="k",
+                                   part_seed=None, block_offset=None,
+                                   boundaries=None, descending=False)
+        blob = ser.dumps(make_fused(batch, assign, 4, 0))
+        fp = ser.loads(memoryview(blob))
+        view = fp.decode(1)
+        arr = np.asarray(view["v"])
+        assert not arr.flags.writeable  # aliases the blob: read-only
+        copy = fp.decode_copy(1)
+        assert copy["v"].flags.writeable
+        before = float(np.asarray(fp.decode(1)["v"])[0])
+        copy["v"][0] = 1e9  # mutate the copy...
+        fp2 = ser.loads(memoryview(blob))  # ...other readers unaffected
+        assert float(np.asarray(fp2.decode(1)["v"])[0]) == before
+        assert float(np.asarray(fp.decode(1)["v"])[0]) == before
+
+    def test_one_object_per_block(self, shared_cluster):
+        """The map stage of a streaming shuffle returns ONE object per
+        input block (the M×N partition-object explosion is gone)."""
+        ray = shared_cluster
+        from ray_tpu.data.shuffle import FusedPartitions, streaming_shuffle
+        from ray_tpu.data import block as B
+
+        refs = [ray.put(B.block_from_rows(
+            [{"k": i % 3, "v": i + 10 * b} for i in range(12)]))
+            for b in range(3)]
+        out = streaming_shuffle(list(refs), 6, mode="hash", key="k")
+        assert len(out) == 6
+        rows = []
+        for blk in ray.get(out):
+            rows.extend(B.block_to_rows(blk))
+        assert sorted((r["k"], r["v"]) for r in rows) == sorted(
+            (i % 3, i + 10 * b) for b in range(3) for i in range(12))
+        assert isinstance(FusedPartitions.__reduce__, object)
+
+
+# ------------------------------------- streaming vs barrier engine parity
+
+
+class TestStreamingBarrierParity:
+    """The streaming engine must be BIT-IDENTICAL to the legacy
+    two-barrier engine for every mode: repartition keeps global order,
+    sort ties keep input order, a seeded random shuffle permutes the
+    same row sequence, hash routes identically."""
+
+    def _input_refs(self, ray):
+        from ray_tpu.data import block as B
+
+        rng = np.random.default_rng(11)
+        refs = []
+        row_id = 0
+        for b in range(5):
+            rows = []
+            for _ in range(40):
+                rows.append({
+                    "k": int(rng.integers(0, 7)),
+                    "s": f"key{int(rng.integers(0, 4))}",
+                    "v": float(rng.normal()),
+                    "i": row_id,
+                    "feat": rng.integers(0, 9, size=(4,)).astype(np.int64),
+                })
+                row_id += 1
+            refs.append(ray.put(B.block_from_rows(rows)))
+        return refs
+
+    def _rows(self, ray, refs):
+        from ray_tpu.data import block as B
+
+        out = []
+        for p, blk in enumerate(ray.get(refs)):
+            for r in B.block_to_rows(blk):
+                out.append((p, r["k"], r["s"], r["v"], r["i"],
+                            tuple(np.asarray(r["feat"]).tolist())))
+        return out
+
+    @pytest.mark.parametrize("mode,kwargs", [
+        ("repartition", {}),
+        ("random", {"seed": 42}),
+        ("hash", {"key": "k"}),
+        ("hash", {"key": "s"}),
+        ("sort", {"key": "v"}),
+        ("sort", {"key": "v", "descending": True}),
+    ])
+    def test_mode_parity(self, shared_cluster, mode, kwargs):
+        ray = shared_cluster
+        from ray_tpu.data.execution import shuffle_blocks_barrier
+        from ray_tpu.data.shuffle import streaming_shuffle
+
+        refs = self._input_refs(ray)
+        n = 4
+        stream_out = streaming_shuffle(list(refs), n, mode=mode, **kwargs)
+        barrier_out = shuffle_blocks_barrier(list(refs), n, mode=mode,
+                                             **kwargs)
+        assert self._rows(ray, stream_out) == self._rows(ray, barrier_out)
+
+    def test_groupby_parity(self, shared_cluster):
+        """GroupedDataset results agree between engines (the streaming
+        path folds aggregations per arrival and runs map_groups inside
+        the reducers — outputs must not change)."""
+        ray = shared_cluster
+        from ray_tpu import data as rtd
+        from ray_tpu.data.context import DataContext
+
+        def build():
+            rows = [{"k": i % 7, "v": float(i)} for i in range(200)]
+            return rtd.from_items(rows, num_blocks=6)
+
+        def summarize(rows):
+            return {"k": rows[0]["k"], "n": len(rows),
+                    "lo": min(r["v"] for r in rows)}
+
+        ctx = DataContext.get_current()
+        prev_min = ctx.streaming_shuffle_min_blocks
+        prev_streaming = ctx.use_streaming_shuffle
+        results = {}
+        for streaming in (True, False):
+            ctx.use_streaming_shuffle = streaming
+            # force the streaming engine even at this small block count
+            # (the size cutoff would otherwise route BOTH runs to the
+            # legacy task path and the comparison would be vacuous)
+            ctx.streaming_shuffle_min_blocks = 1
+            try:
+                agg = build().groupby("k").aggregate(
+                    total=("v", "sum"), n=(None, "count"),
+                    sd=("v", "std")).take_all()
+                mg = sorted(build().groupby("k").map_groups(
+                    summarize).take_all(), key=lambda r: r["k"])
+                results[streaming] = (agg, mg)
+            finally:
+                ctx.use_streaming_shuffle = prev_streaming
+                ctx.streaming_shuffle_min_blocks = prev_min
+        agg_s, mg_s = results[True]
+        agg_b, mg_b = results[False]
+        assert mg_s == mg_b
+        assert len(agg_s) == len(agg_b)
+        for rs, rb in zip(agg_s, agg_b):
+            assert rs["k"] == rb["k"] and rs["n"] == rb["n"]
+            assert abs(rs["total"] - rb["total"]) < 1e-9
+            assert abs(rs["sd"] - rb["sd"]) < 1e-9

@@ -1,0 +1,307 @@
+"""Async spill engine (object_store/shm.py): writer-thread demotion,
+compressed round trips, pending-queue reads, announced-order prefetch
+with hit accounting, typed failure surfacing, batched drops, and the
+session-shutdown spill-dir GC."""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.common.status import SpillFailedError
+from ray_tpu.object_store.shm import (ShmObjectStore, _decompress_spill,
+                                      _SPILL_MAGIC, gc_spill_dirs)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShmObjectStore(f"/rt_spilleng_{os.getpid()}",
+                       capacity=1 * 1024 * 1024,
+                       spill_dir=str(tmp_path / "spill"))
+    yield s
+    s.close()
+    s.unlink()
+
+
+def _oid(i: int) -> bytes:
+    return bytes([i]) * 28
+
+
+class TestAsyncSpill:
+    def test_put_or_spill_roundtrip_under_pressure(self, store):
+        """8 x 300 KB through a 1 MB arena: most values demote through
+        the writer thread; every byte must read back, from the arena,
+        the pending queue, or disk."""
+        blobs = {_oid(i): os.urandom(300_000) for i in range(8)}
+        for o, b in blobs.items():
+            assert store.put_or_spill(o, b)
+        assert store.flush_spills(10.0)
+        spilled = [o for o in blobs if store.contains_spilled(o)]
+        assert spilled, "1MB arena over 2.4MB of puts must demote"
+        for o, b in blobs.items():
+            if store.contains(o):
+                v = store.get(o)
+                assert bytes(v) == b
+                del v
+                store.release(o)
+            else:
+                assert store.read_spilled(o) == b
+        assert store.spill_stats()["bytes_spilled"] > 0
+
+    def test_read_served_from_pending_before_write_lands(self, store):
+        """A demoted value is readable the instant it is queued — before
+        the writer thread lands the file (the arena span is already
+        gone, so the pending map IS the primary copy)."""
+        import threading
+
+        gate = threading.Event()
+        real = store._engine._write_one
+
+        def slow(oid, data):
+            gate.wait(5.0)
+            real(oid, data)
+
+        store._engine._write_one = slow
+        data = os.urandom(200_000)
+        store._engine.submit(_oid(1), data)
+        assert not os.path.exists(store._spill_path(_oid(1)))
+        assert store.read_spilled(_oid(1)) == data  # pending-map hit
+        assert store.spill_stats()["pending_hits"] >= 1
+        gate.set()
+        assert store.flush_spills(5.0)
+        assert store.read_spilled(_oid(1)) == data  # now from disk
+
+    def test_drop_cancels_pending_write(self, store):
+        import threading
+
+        gate = threading.Event()
+        real = store._engine._write_one
+
+        def slow(oid, data):
+            gate.wait(5.0)
+            real(oid, data)
+
+        store._engine._write_one = slow
+        store._engine.submit(_oid(2), b"x" * 1000)
+        store.drop_spilled(_oid(2))  # cancels: no file may ever appear
+        gate.set()
+        assert store.flush_spills(5.0)
+        assert not os.path.exists(store._spill_path(_oid(2)))
+        assert not store.contains_spilled(_oid(2))
+
+    def test_drop_spilled_batches_unlinks(self, store):
+        oids = [_oid(i) for i in range(6)]
+        for o in oids:
+            store._engine.submit(o, os.urandom(50_000))
+        assert store.flush_spills(5.0)
+        assert all(os.path.exists(store._spill_path(o)) for o in oids)
+        for o in oids:
+            store.drop_spilled(o)
+        assert store.flush_spills(5.0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+                os.path.exists(store._spill_path(o)) for o in oids):
+            time.sleep(0.05)
+        assert not any(os.path.exists(store._spill_path(o)) for o in oids)
+        assert store.spill_stats()["files_dropped"] >= len(oids)
+
+
+class TestCompression:
+    def test_compressed_roundtrip_and_ratio(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RT_spill_compression", "zlib")
+        s = ShmObjectStore(f"/rt_spillz_{os.getpid()}",
+                           capacity=1 * 1024 * 1024,
+                           spill_dir=str(tmp_path / "zspill"))
+        try:
+            data = b"A" * 500_000  # highly compressible
+            s._engine.submit(_oid(3), data)
+            assert s.flush_spills(5.0)
+            path = s._spill_path(_oid(3))
+            on_disk = os.path.getsize(path)
+            assert on_disk < len(data) // 10
+            with open(path, "rb") as f:
+                assert f.read(6) == _SPILL_MAGIC
+            assert s.read_spilled(_oid(3)) == data
+            st = s.spill_stats()
+            assert st["compression"] == "zlib"
+            assert 0 < st["compression_ratio"] < 0.2
+            assert st["bytes_restored"] == len(data)
+        finally:
+            s.close()
+            s.unlink()
+
+    def test_incompressible_payload_stays_raw(self, tmp_path, monkeypatch):
+        """Compression only keeps wins: random bytes write RAW (no
+        magic), and the legacy raw format always reads back."""
+        monkeypatch.setenv("RT_spill_compression", "zlib")
+        s = ShmObjectStore(f"/rt_spillr_{os.getpid()}",
+                           capacity=1 * 1024 * 1024,
+                           spill_dir=str(tmp_path / "rspill"))
+        try:
+            data = os.urandom(100_000)
+            s._engine.submit(_oid(4), data)
+            assert s.flush_spills(5.0)
+            with open(s._spill_path(_oid(4)), "rb") as f:
+                raw = f.read()
+            assert raw == data  # no frame header
+            assert s.read_spilled(_oid(4)) == data
+        finally:
+            s.close()
+            s.unlink()
+
+    def test_decompress_passthrough_for_legacy_files(self):
+        assert _decompress_spill(b"plain old bytes") == b"plain old bytes"
+
+    def test_unknown_codec_rejected(self, monkeypatch):
+        monkeypatch.setenv("RT_spill_compression", "snappy")
+        from ray_tpu.object_store.shm import _resolve_codec
+
+        with pytest.raises(ValueError):
+            _resolve_codec("snappy")
+
+
+class TestFailureSurfacing:
+    def test_spill_failure_is_typed_and_loses_nothing(self, store):
+        """Writer-thread failures surface as SpillFailedError on the
+        next spill operation; every value the store ACCEPTED stays
+        readable (the failed bytes are retained in the pending map)."""
+
+        def boom(oid, data):
+            raise OSError(28, "No space left on device")
+
+        store._engine._write_one = boom
+        accepted = {}
+        with pytest.raises(SpillFailedError):
+            for i in range(20):
+                o, b = _oid(i), os.urandom(300_000)
+                store.put_or_spill(o, b)
+                accepted[o] = b
+        assert accepted, "some puts must land before the failure"
+        for o, b in accepted.items():
+            assert store.contains(o) or store.read_spilled(o) == b, \
+                "an accepted value was lost on spill failure"
+        assert store.spill_stats()["write_failures"] >= 1
+
+    def test_spill_failed_error_is_not_oserror(self):
+        """The historical `except OSError` guards on the spill paths
+        must NOT swallow the typed error (that was the silent-loss
+        bug)."""
+        assert not issubclass(SpillFailedError, OSError)
+
+
+class TestPrefetch:
+    def test_announced_order_prefetch_hits(self, store):
+        blobs = {_oid(i): os.urandom(120_000) for i in range(4)}
+        for o, b in blobs.items():
+            store._engine.submit(o, b)
+        assert store.flush_spills(5.0)
+        store.prefetch_spilled(list(blobs))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                store.spill_stats()["prefetch_cache_bytes"] < \
+                sum(len(b) for b in blobs.values()):
+            time.sleep(0.05)
+        for o, b in blobs.items():
+            assert store.read_spilled(o) == b
+        st = store.spill_stats()
+        assert st["prefetch_hits"] == len(blobs)
+        # an un-announced read counts as a miss
+        store._engine.submit(_oid(9), b"y" * 1000)
+        assert store.flush_spills(5.0)
+        assert store.read_spilled(_oid(9)) == b"y" * 1000
+        assert store.spill_stats()["prefetch_misses"] >= 1
+
+    def test_prefetch_of_resident_object_is_noop(self, store):
+        oid = _oid(5)
+        assert store.put(oid, b"z" * 1000)
+        store.prefetch_spilled([oid])  # no spill file: nothing breaks
+        time.sleep(0.1)
+        assert store.spill_stats()["prefetch_hits"] == 0
+
+
+class TestSpillDirGC:
+    def test_gc_removes_orphans_keeps_live(self, tmp_path):
+        base = tmp_path / "gcbase"
+        base.mkdir()
+        # dead-owner rt_spill dir -> removed
+        dead = base / "rt_spill_dead"
+        dead.mkdir()
+        (dead / ".owner").write_text("999999999")
+        (dead / "payload").write_bytes(b"x")
+        # live-owner rt_spill dir -> kept (but its stale tmp swept)
+        live = base / "rt_spill_live"
+        live.mkdir()
+        (live / ".owner").write_text(str(os.getpid()))
+        (live / "payload").write_bytes(b"x")
+        (live / "frag.tmp.999999999").write_bytes(b"partial")
+        (live / f"frag.tmp.{os.getpid()}").write_bytes(b"in-flight")
+        # rtshm_spill dir whose arena segment no longer exists -> removed
+        ghost = base / "rtshm_spill_rt_gc_ghost_seg"
+        ghost.mkdir()
+        (ghost / "payload").write_bytes(b"x")
+        removed = gc_spill_dirs(str(base))
+        assert not dead.exists()
+        assert live.exists() and (live / "payload").exists()
+        assert not (live / "frag.tmp.999999999").exists()
+        assert (live / f"frag.tmp.{os.getpid()}").exists()
+        if os.path.isdir("/dev/shm"):
+            assert not ghost.exists()
+            assert removed["dirs"] == 2
+        assert removed["tmp_fragments"] >= 1
+
+    def test_gc_keeps_dir_of_live_segment(self, tmp_path):
+        if not os.path.isdir("/dev/shm"):
+            pytest.skip("no /dev/shm")
+        name = f"/rt_gcseg_{os.getpid()}"
+        s = ShmObjectStore(name, capacity=1 << 20,
+                           spill_dir=None)
+        try:
+            base = tmp_path / "gcb2"
+            base.mkdir()
+            d = base / ("rtshm_spill_" + name.lstrip("/"))
+            d.mkdir()
+            (d / "payload").write_bytes(b"x")
+            gc_spill_dirs(str(base))
+            assert d.exists()  # segment alive -> dir kept
+        finally:
+            s.close()
+            s.unlink()
+
+    def test_memory_store_spill_dir_records_owner(self, tmp_path):
+        from ray_tpu.common.config import GLOBAL_CONFIG
+        from ray_tpu.core_worker.memory_store import MemoryStore
+
+        GLOBAL_CONFIG.set_system_config_value("object_spilling_dir",
+                                              str(tmp_path))
+        GLOBAL_CONFIG.reset_cache()
+        try:
+            ms = MemoryStore()
+            d = ms._ensure_spill_dir()
+            assert (open(os.path.join(d, ".owner")).read().strip()
+                    == str(os.getpid()))
+        finally:
+            GLOBAL_CONFIG.set_system_config_value("object_spilling_dir", "")
+            GLOBAL_CONFIG.reset_cache()
+
+
+class TestBatchedDemotion:
+    def test_native_batched_candidates(self, store):
+        """rts_lru_candidates hands the demotion loop a BATCH of LRU
+        victims (oldest first) in one native call."""
+        import ctypes
+
+        for i in range(5):
+            assert store.put(_oid(i), bytes([i]) * 10_000)
+        n = 4
+        out_ids = ctypes.create_string_buffer(32 * n)
+        out_lens = (ctypes.c_uint32 * n)()
+        got = store._lib.rts_lru_candidates(store._h, out_ids, out_lens,
+                                            n, 0)
+        assert got == n
+        victims = [out_ids.raw[i * 32:i * 32 + out_lens[i]]
+                   for i in range(got)]
+        assert victims == [_oid(i) for i in range(n)]  # LRU order
+        # byte-target stops the batch early
+        got = store._lib.rts_lru_candidates(store._h, out_ids, out_lens,
+                                            n, 5_000)
+        assert got == 1
