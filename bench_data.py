@@ -13,6 +13,11 @@ the store capped far below the working set, so most bytes cross the
 spill path.
 
 Usage: python bench_data.py [--gb 2.2] [--cap-mb 256]
+
+``--tcp`` runs the same pipeline on a 2-node in-process cluster (two
+raylets, two shm arenas, real worker subprocesses) so shuffle partitions
+cross node boundaries and ride the zero-copy transfer service over real
+loopback TCP sockets; the row is named ``groupby_shuffle_tcp_gb_per_min``.
 """
 
 import argparse
@@ -103,6 +108,10 @@ def main() -> int:
                          "to this script; the bench-guard stage points "
                          "it at a scratch dir so the committed record "
                          "is only replaced via bench_guard --capture)")
+    ap.add_argument("--tcp", action="store_true",
+                    help="run on a 2-node cluster so shuffle partitions "
+                         "cross the wire (transfer service over loopback "
+                         "TCP); emits groupby_shuffle_tcp_gb_per_min")
     args = ap.parse_args()
 
     # every process (driver + workers) spills under one measurable root
@@ -118,7 +127,17 @@ def main() -> int:
     from ray_tpu import data as rtd
     from ray_tpu.data.context import DataContext
 
-    ray_tpu.init(num_cpus=4, num_tpus=0)
+    cluster = None
+    if args.tcp:
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2)
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+    else:
+        ray_tpu.init(num_cpus=4, num_tpus=0)
     DataContext.get_current().max_inflight_blocks = args.inflight
 
     payload = 2048
@@ -176,7 +195,8 @@ def main() -> int:
     spilled = max(watcher.cumulative, residual)
     moved_gb = n_rows * payload / (1 << 30)
     result = {
-        "metric": "groupby_shuffle_gb_per_min",
+        "metric": ("groupby_shuffle_tcp_gb_per_min" if args.tcp
+                   else "groupby_shuffle_gb_per_min"),
         "value": round(moved_gb / (dt / 60.0), 2),
         "unit": "GB/min",
         "vs_baseline": None,  # reference publishes no absolute number
@@ -200,6 +220,7 @@ def main() -> int:
             "num_blocks": num_blocks,
             "groups": groups,
             "rows": n_rows,
+            "nodes": 2 if args.tcp else 1,
         },
     }
     print(json.dumps(result))
@@ -209,6 +230,8 @@ def main() -> int:
         json.dump({"results": [result], "source": "bench_data.py"}, f,
                   indent=2)
     ray_tpu.shutdown()
+    if cluster is not None:
+        cluster.shutdown()
     import shutil
 
     shutil.rmtree(spill_root, ignore_errors=True)  # don't leak GBs in /tmp

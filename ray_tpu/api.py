@@ -296,6 +296,15 @@ def shutdown() -> None:
                 gc_spill_dirs()
             except Exception:  # noqa: BLE001 — shutdown is best-effort
                 pass
+            # same sweep for transfer-service scratch: half-landed arena
+            # allocations (.pull.<pid> markers) whose puller process died
+            # mid-download are aborted so their spans don't pin the arena
+            try:
+                from ray_tpu.object_store.transfer import gc_transfer_scratch
+
+                gc_transfer_scratch()
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
             _head = None
 
 

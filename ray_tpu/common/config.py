@@ -219,6 +219,19 @@ _D("streaming_generator_backpressure", int, 16,
    "max unconsumed streamed items before the owner delays report replies"
    " (0 = unlimited)")
 _D("memory_store_max_bytes", int, 512 * 1024 * 1024, "in-process store cap")
+_D("transfer_service", bool, True,
+   "per-node object transfer service: sealed/spilled objects stream"
+   " node-to-node over a dedicated socket server (zero-copy arena views,"
+   " no pickle). 0 keeps the legacy per-chunk owner-RPC path as the only"
+   " wire path — the parity oracle every multi-node test must also pass")
+_D("transfer_chunk_bytes", int, 4 * 1024 * 1024,
+   "transfer-service wire granularity: sendall/recv_into window per"
+   " slice of the pinned view (tests shrink it to exercise chunking)")
+_D("locality_scheduling", bool, True,
+   "pick_node prefers the feasible node already holding the largest"
+   " total argument bytes (owner-reported location hints), tie-broken"
+   " by the configured pack/spread policy — large-arg tasks skip the"
+   " wire instead of pulling their args cross-node")
 
 # --- memory / isolation ------------------------------------------------------
 _D("memory_monitor_enabled", bool, True, "kill workers before kernel OOM")

@@ -467,6 +467,25 @@ class NormalTaskSubmitter:
         return (self._lease_counter.to_bytes(8, "little")
                 + self._cw.worker_id.binary())
 
+    def _locality_hint(self, spec: TaskSpec) -> Optional[dict]:
+        """``{node_id_hex: total argument bytes resident there}`` from
+        the owner's location cache: the raylet's pick_node sends the
+        task to the node already holding the most arg bytes — shipping
+        the task is cheaper than shipping its args
+        (scheduling/policies.py)."""
+        cache = getattr(self._cw, "_object_locality", None)
+        if not cache or not GLOBAL_CONFIG.get("locality_scheduling"):
+            return None
+        hint: dict = {}
+        for arg in spec.args:
+            if arg.is_inline or arg.object_id is None:
+                continue
+            ent = cache.get(arg.object_id.binary())
+            if ent and ent.get("size"):
+                nid = ent["node_id"]
+                hint[nid] = hint.get(nid, 0) + int(ent["size"])
+        return hint or None
+
     async def _request_lease(self, spec: TaskSpec, key: Optional[tuple] = None):
         """Lease protocol with spillback: follow redirects up to a few hops.
 
@@ -480,7 +499,8 @@ class NormalTaskSubmitter:
         if isinstance(spec.scheduling_strategy, PlacementGroupStrategy):
             pg = (spec.scheduling_strategy.placement_group_id.binary(),
                   spec.scheduling_strategy.bundle_index)
-        if key is not None:
+        locality = self._locality_hint(spec)
+        if key is not None and locality is None:
             cached = self._grant_cache.get(key)
             if cached:
                 return cached.pop(0)
@@ -491,7 +511,10 @@ class NormalTaskSubmitter:
             # Default-strategy shapes only: the coalesced RPC grants
             # strictly locally, so placement-bearing strategies (PG,
             # node affinity, spread) keep the single-lease protocol that
-            # ships the strategy to the raylet
+            # ships the strategy to the raylet.  Locality-hinted shapes
+            # (large by-ref args resident elsewhere) skip it for the
+            # same reason: a strictly-local grant would make the args
+            # pay the wire when the hint could have moved the task.
             if want > 1 and isinstance(spec.scheduling_strategy,
                                        DefaultStrategy) \
                     and GLOBAL_CONFIG.get("lease_grant_coalescing"):
@@ -510,13 +533,22 @@ class NormalTaskSubmitter:
                 # No client-side timeout: a queued lease legitimately blocks
                 # until resources free up; truly impossible demands come back
                 # as an explicit "infeasible" status from the raylet.
+                # Final hop pins the lease to whichever raylet it reached:
+                # two raylets redirecting on mutually-stale views (e.g. a
+                # locality hint pointing at a node that just filled) would
+                # otherwise ping-pong the lease until the hop budget runs
+                # out — which is a queue-here situation, not an infeasible
+                # demand (truly impossible shapes are rejected by the
+                # FIRST raylet's feasibility check, never reaching hop 8).
                 reply = await client.call_async(
                     "request_worker_lease",
                     lease_id=lease_id,
                     resources=spec.required_resources.to_dict(),
                     strategy=strategy,
                     pg=pg,
+                    grant_only_local=(_hop == 7),
                     runtime_env=spec.runtime_env,
+                    locality=locality,
                     # the raylet reclaims this job's leases when the job
                     # finishes (driver exit/death must free its workers)
                     job_id=self._cw.job_id.binary(),

@@ -245,6 +245,16 @@ class CoreWorker:
         self._actor_has_async = False
         self._async_call_sem: Optional[asyncio.Semaphore] = None
         self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
+        # multi-node object plane (object_store/transfer.py): coalesced
+        # owner→GCS location reporting plus an in-process locality cache
+        # ({oid bytes: {"node_id", "size"}}) that feeds the submitter's
+        # argument-locality lease hint and cold-fetch source resolution
+        self._transfer_enabled = bool(GLOBAL_CONFIG.get("transfer_service"))
+        self._pending_loc_updates: list = []
+        self._loc_lock = threading.Lock()
+        self._loc_flush_scheduled = False
+        self._object_locality: Dict[bytes, dict] = {}
+        self._node_transfer_addrs: Dict[str, tuple] = {}
 
         _bt("fastloop")
         # Multi-process shape: the supervisor stores the typed death error
@@ -321,6 +331,11 @@ class CoreWorker:
                     # large byte values land in the shared arena instead
                     # of this process's heap (memory_store.put routing)
                     self.memory_store.set_shm_router(self._shm_route)
+                    # arena demotions move the copy to the spill file —
+                    # the location directory must follow so remote pulls
+                    # stream the file instead of missing (transfer.py)
+                    probed.set_demote_callback(
+                        lambda oid: self._report_location("spill", oid))
         return self._shm
 
     def _shm_route(self, oid_bytes: bytes, value) -> Optional[memoryview]:
@@ -334,7 +349,10 @@ class CoreWorker:
             store.put(oid_bytes, value)
         except OSError:
             return None
-        return store.get_pinned(oid_bytes)
+        view = store.get_pinned(oid_bytes)
+        if view is not None:
+            self._report_location("add", oid_bytes, size=len(view))
+        return view
 
     def _shm_read(self, oid: ObjectID) -> Optional[memoryview]:
         """Zero-copy read: the returned view aliases the store's shared
@@ -440,6 +458,7 @@ class CoreWorker:
         finally:
             if not sealed:
                 shm.abort(oid.binary())
+        self._report_location("add", oid.binary(), size=total)
         return shm.get_pinned(oid.binary())
 
     def _put_serialized(self, oid: ObjectID, value: Any) -> None:
@@ -637,6 +656,13 @@ class CoreWorker:
                                               ref.object_id)
             if blob is not None:
                 return blob
+        # cross-node transfer service: stream straight from a holder
+        # node's arena/spill file; the owner-RPC chunk path below stays
+        # the fallback (and the RT_transfer_service=0 oracle)
+        blob = await loop.run_in_executor(
+            None, self._transfer_pull_blocking, ref.object_id)
+        if blob is not None:
+            return blob
         try:
             # pin the holder client's whole lifetime (connect, read loop,
             # close) to the IO loop: call_async works from a foreign loop,
@@ -715,6 +741,13 @@ class CoreWorker:
                 None, self._shm_read, ref.object_id)
             if blob is not None:
                 return blob
+        # cross-node transfer service: resolve live copies from the GCS
+        # location directory and stream from a holder node before asking
+        # the owner — large borrowed values skip the chunk-RPC path
+        blob = await asyncio.get_running_loop().run_in_executor(
+            None, self._transfer_pull_blocking, ref.object_id)
+        if blob is not None:
+            return blob
         owner = RetryableRpcClient(ref.owner_address, deadline_s=30.0)
         try:
             reply = await owner.call_async(
@@ -726,6 +759,17 @@ class CoreWorker:
             location = reply.get("location")
             if location is None:
                 raise ObjectLostError(ref.object_id, "owner has no value or location")
+            nid = reply.get("node_id")
+            if nid and self._transfer_enabled:
+                # owner named the holder NODE: retry the wire path with
+                # the hint — covers the directory-flush race where the
+                # copy sealed after our directory lookup above
+                self._object_locality[ref.object_id.binary()] = {
+                    "node_id": nid, "size": int(reply.get("size") or 0)}
+                blob = await asyncio.get_running_loop().run_in_executor(
+                    None, self._transfer_pull_blocking, ref.object_id)
+                if blob is not None:
+                    return blob
             holder = RpcClient(tuple(location))
             try:
                 r2 = await holder.call_async(
@@ -752,6 +796,9 @@ class CoreWorker:
         blob = self._shm_read(ref.object_id)
         if blob is not None:
             return blob
+        blob = self._transfer_pull_blocking(ref.object_id)
+        if blob is not None:
+            return blob
         return self._fetch_from_location_rpc(ref, location, timeout)
 
     def _fetch_from_location_rpc(self, ref: ObjectRef, location,
@@ -772,6 +819,136 @@ class CoreWorker:
                 if entry.location is not None:
                     return self._fetch_from_location(ref, entry.location, timeout)
             raise ObjectLostError(ref.object_id, f"fetch failed: {e}") from e
+
+    # ------------------------------------------- multi-node object plane
+    def _report_location(self, op: str, oid_bytes: bytes,
+                         size: Optional[int] = None) -> None:
+        """Queue one location transition (``add`` on arena seal,
+        ``remove`` on owner free, ``spill`` on demotion) for the
+        coalesced GCS flush — the :meth:`_flush_actor_regs` batching
+        shape: a storm of seals costs one directory RPC per loop tick,
+        not one per object."""
+        if not self._transfer_enabled:
+            return
+        if op == "add":
+            self._object_locality[oid_bytes] = {
+                "node_id": self.node_id.hex(), "size": int(size or 0)}
+            if len(self._object_locality) > 50_000:
+                for k in list(self._object_locality)[:10_000]:
+                    self._object_locality.pop(k, None)
+        elif op == "remove":
+            self._object_locality.pop(oid_bytes, None)
+        u: dict = {"op": op, "object_id": oid_bytes}
+        if op != "remove":
+            # an owner-side remove carries NO node_id: the GCS drops the
+            # whole entry — every copy dies with the owner's free
+            u["node_id"] = self.node_id.binary()
+        if size is not None:
+            u["size"] = int(size)
+        with self._loc_lock:
+            self._pending_loc_updates.append(u)
+            if self._loc_flush_scheduled:
+                return
+            self._loc_flush_scheduled = True
+        try:
+            self._io.loop.call_soon_threadsafe(self._flush_loc_updates)
+        except RuntimeError:  # loop closed: shutting down
+            pass
+
+    def _flush_loc_updates(self):
+        with self._loc_lock:
+            batch, self._pending_loc_updates = self._pending_loc_updates, []
+            self._loc_flush_scheduled = False
+        if not batch:
+            return
+
+        async def send():
+            from ray_tpu.rpc.rpc import RpcMethodNotFound
+
+            try:
+                await self.gcs.call_async("object_locations_update",
+                                          updates=batch)
+            except (RpcMethodNotFound, RemoteMethodError):
+                # older GCS (rolling upgrade): the directory is an
+                # optimization — the owner value/location protocol is
+                # still complete without it
+                pass
+            except Exception:  # noqa: BLE001 — next seal re-reports
+                logger.debug("location update flush failed", exc_info=True)
+
+        self._io.spawn(send())
+
+    def _transfer_addr_for(self, node_hex: Optional[str]):
+        """node-id hex → ``(host, port)`` of that node's transfer
+        service, None when unknown/remote-less. Blocking on a cache miss
+        (one GCS node-table refresh) — executor threads only, never the
+        IO loop."""
+        if not node_hex or node_hex == self.node_id.hex():
+            return None
+        addr = self._node_transfer_addrs.get(node_hex)
+        if addr is not None:
+            return addr or None  # () = negative-cached: no service there
+        try:
+            for n in self.gcs.get_all_nodes():
+                ta = n.get("transfer_address")
+                self._node_transfer_addrs[n["node_id"].hex()] = (
+                    tuple(ta) if ta and n.get("alive", True) else ())
+        except Exception:  # noqa: BLE001 — resolver is best-effort
+            return None
+        return self._node_transfer_addrs.get(node_hex) or None
+
+    def _transfer_pull_blocking(self, oid: ObjectID):
+        """Pull one object over the node transfer service (the zero-copy
+        wire path, object_store/transfer.py): owner's locality hint
+        first, then every live copy in the GCS directory.  A holder node
+        that died mid-pull just advances to the next source.  Returns
+        the landed view/bytes or None — the caller then falls back to
+        the legacy owner-RPC chunk path (the ``RT_transfer_service=0``
+        oracle path).  Blocking: executor threads only."""
+        if not self._transfer_enabled:
+            return None
+        from ray_tpu.object_store import transfer as _transfer
+
+        oid_bytes = oid.binary()
+        my_hex = self.node_id.hex()
+        sources: list = []
+        seen = set()
+        hint = self._object_locality.get(oid_bytes)
+        if hint and hint.get("node_id") != my_hex:
+            addr = self._transfer_addr_for(hint.get("node_id"))
+            if addr is not None:
+                sources.append(tuple(addr))
+                seen.add(hint["node_id"])
+        try:
+            rows = self.gcs.get_object_locations(
+                [oid_bytes]).get(oid.hex()) or []
+        except Exception:  # noqa: BLE001 — directory may be older/absent
+            rows = []
+        for r in rows:
+            nid = r.get("node_id")
+            if nid in seen or nid == my_hex:
+                continue
+            seen.add(nid)
+            addr = r.get("address") or self._transfer_addr_for(nid)
+            if addr is not None:
+                sources.append(tuple(addr))
+        shm = self.shm
+        for addr in sources:
+            try:
+                view = _transfer.pull_object(addr, oid_bytes, shm=shm)
+            except _transfer.TransferNotFound:
+                continue  # that copy is already gone — next source
+            except Exception:  # noqa: BLE001 — holder node unreachable
+                continue
+            if view is None:
+                continue
+            if shm is not None and shm.contains(oid_bytes):
+                # landed as a sealed arena copy: this node is now a
+                # source too — the fallback location holder-death
+                # recovery depends on
+                self._report_location("add", oid_bytes, size=len(view))
+            return view
+        return None
 
     # ------------------------------------------------------- task submission
     def fail_control_plane(self, exc: Exception) -> None:
@@ -1256,6 +1433,14 @@ class CoreWorker:
                 self.memory_store.put(oid, error=payload["error"])
             elif "location" in payload:
                 self.memory_store.put(oid, location=tuple(payload["location"]))
+                nid = payload.get("node_id")
+                if nid and self._transfer_enabled:
+                    # the executee named its node: the owner's locality
+                    # cache now routes cold gets (and the next lease's
+                    # locality hint) at that node's transfer service
+                    self._object_locality[oid_bytes] = {
+                        "node_id": nid,
+                        "size": int(payload.get("size") or 0)}
 
     # ----------------------------------------------------------- lineage/GC
     def _try_reconstruct(self, object_id: ObjectID) -> bool:
@@ -1522,6 +1707,9 @@ class CoreWorker:
         if self._shm not in (False, None):
             self._shm.delete(oid.binary())
             self._shm.drop_spilled(oid.binary())
+        # owner free kills EVERY copy: one directory remove (no node_id)
+        # drops the whole entry so pullers stop routing anywhere
+        self._report_location("remove", oid.binary())
         if location is not None and tuple(location) != self.server.address:
             # the value lives in the executor's store: tell it to drop
             async def drop():
@@ -1659,7 +1847,8 @@ class CoreWorker:
             # are served straight from the spill file by read_range.
             if size > GLOBAL_CONFIG.get("object_store_chunk_size_bytes"):
                 if advertise_self:
-                    return {"location": self.server.address, "size": size}
+                    return {"location": self.server.address, "size": size,
+                            "node_id": self.node_id.hex()}
                 return {"size": size}
             value = self.memory_store.read_range(oid, 0, size)
             if value is not None:
@@ -2623,7 +2812,8 @@ class CoreWorker:
                 view = self._shm_write_framed(oid, meta, views, segs, total)
                 if view is not None:
                     self.memory_store.put(oid, value=view)
-                    return {"location": self.server.address}
+                    return {"location": self.server.address, "size": total,
+                            "node_id": self.node_id.hex()}
             if buffers:
                 out = bytearray(total)
                 _ser.pack_into(out, meta, views, segs)
@@ -2635,6 +2825,7 @@ class CoreWorker:
         if len(blob) <= threshold:
             return {"value": blob}
         self.memory_store.put(oid, value=blob)
+        durable = False
         if self.shm is not None:
             # SpillFailedError deliberately NOT caught here: a refused
             # spill write means node durability failed — it surfaces as
@@ -2642,8 +2833,13 @@ class CoreWorker:
             # loss of the survive-this-process guarantee
             try:
                 self.shm.put_or_spill(oid.binary(), blob)
+                durable = True
             except OSError:  # pure-LRU store (no spill dir configured)
                 pass
+        if durable:
+            self._report_location("add", oid.binary(), size=len(blob))
+            return {"location": self.server.address, "size": len(blob),
+                    "node_id": self.node_id.hex()}
         return {"location": self.server.address}
 
     def _error_reply(self, task: TaskSpec, exc: Exception) -> dict:

@@ -187,6 +187,18 @@ class GcsClient:
     def get_all_nodes(self) -> List[dict]:
         return self.call("get_all_nodes")
 
+    # -- object location directory --
+    def object_locations_update(self, updates: List[dict]) -> dict:
+        """Push one owner-coalesced batch of location transitions
+        (``{"op": "add"|"remove"|"spill", "object_id", "node_id",
+        "address"?, "size"?}``)."""
+        return self.call("object_locations_update", updates=updates)
+
+    def get_object_locations(self, object_ids: List[bytes]) -> dict:
+        """oid-hex -> [{node_id, address, spilled, size}] for every live
+        copy the directory knows about."""
+        return self.call("get_object_locations", object_ids=list(object_ids))
+
     def cluster_resources(self) -> dict:
         return self.call("get_cluster_resources")
 

@@ -4,11 +4,15 @@ Equivalent of the reference's GCS server (src/ray/gcs/gcs_server/gcs_server.cc
 and its managers): node registry + health checking, aggregated resource view,
 job table, actor lifecycle management with restart-on-failure, placement
 groups with two-phase commit across raylets, internal KV, and a task-event
-store.  Data-plane state (objects) is deliberately NOT here — ownership lives
-with workers, as in the reference.
+store.  Data-plane state (object VALUES) is deliberately NOT here — ownership
+lives with workers, as in the reference.  The GCS does keep the object
+LOCATION directory (which nodes hold a copy, arena or spilled — reference:
+the owner-reported object directory): owners push coalesced add/remove/spill
+batches and cold ``get`` paths resolve holders here before riding the
+node-to-node transfer service.
 
 State changes are published on pubsub channels: "node", "actor", "pg", "job",
-"resources".
+"resources", "object_loc".
 """
 
 from __future__ import annotations
@@ -257,6 +261,12 @@ class GcsServer:
         self._pending_pg_queue: List[PlacementGroupID] = []
         self._node_demands: Dict[NodeID, List[dict]] = {}  # autoscaler feed
         self._node_stats: Dict[NodeID, dict] = {}  # per-node system stats
+        # object location directory (reference: GcsObjectManager / the
+        # owner-reported object directory): oid -> node_id_hex ->
+        # {"address": transfer endpoint, "spilled": bool, "size": int}.
+        # Owners report coalesced batches (object_locations_update), cold
+        # fetches resolve here, node death drops the node's column.
+        self._object_locations: Dict[bytes, Dict[str, dict]] = {}
         # export API (util/export_events.py): attached post-boot by the
         # session owner when enable_export_api is set
         self._export_logger = None
@@ -345,6 +355,7 @@ class GcsServer:
             "get_system_config", "health_check", "debug_state",
             "publish_worker_log", "fetch_table_log",
             "get_leader_info", "step_down",
+            "object_locations_update", "get_object_locations",
         ):
             s.register(name, self._fenced(name, getattr(self, f"h_{name}")))
 
@@ -416,6 +427,7 @@ class GcsServer:
     # ------------------------------------------------------------- node mgmt
     async def h_register_node(self, node_id: bytes, address, resources: dict, labels: dict,
                               object_store_address: Optional[str] = None,
+                              transfer_address=None,
                               live_actors: Optional[List[dict]] = None,
                               held_bundles: Optional[List[dict]] = None):
         nid = NodeID(node_id)
@@ -424,6 +436,8 @@ class GcsServer:
             address=tuple(address),
             resources=NodeResources(resources, labels),
             object_store_address=object_store_address,
+            transfer_address=tuple(transfer_address) if transfer_address
+            else None,
         )
         self.view.upsert(entry)
         self._raylets[nid] = RayletHandle(tuple(address))
@@ -592,6 +606,7 @@ class GcsServer:
                 "alive": e.alive,
                 "resources": e.resources.snapshot(),
                 "object_store_address": e.object_store_address,
+                "transfer_address": e.transfer_address,
                 "stats": self._node_stats.get(e.node_id, {}),
             }
             for e in self.view.all_nodes()
@@ -609,6 +624,76 @@ class GcsServer:
             e = self.view.get(NodeID(raw))
             out.append(bool(e and e.alive))
         return out
+
+    # -------------------------------------------------- object locations
+    async def h_object_locations_update(self, updates: List[dict]):
+        """Owner-coalesced location churn (one RPC per flush window, not
+        per object — the PR-7 coalesced-pubsub discipline).  Each update:
+        ``{"op": "add"|"remove"|"spill", "object_id", "node_id",
+        "address"?, "size"?}``; node_id/address describe the COPY, not
+        the owner."""
+        events = []
+        for u in updates:
+            oid = u["object_id"]
+            op = u.get("op", "add")
+            if op == "remove" and "node_id" not in u:
+                # owner freed the object: every copy's entry dies with it
+                if self._object_locations.pop(oid, None) is not None:
+                    events.append({"op": "remove", "object_id": oid})
+                continue
+            nid_hex = u["node_id"].hex() if isinstance(u["node_id"], bytes) \
+                else str(u["node_id"])
+            locs = self._object_locations.setdefault(oid, {})
+            if op == "remove":
+                locs.pop(nid_hex, None)
+                if not locs:
+                    self._object_locations.pop(oid, None)
+            else:
+                loc = locs.setdefault(nid_hex, {})
+                if u.get("address") is not None:
+                    loc["address"] = tuple(u["address"])
+                if u.get("size") is not None:
+                    loc["size"] = int(u["size"])
+                loc["spilled"] = bool(op == "spill" or loc.get("spilled"))
+                if op == "add":
+                    loc["spilled"] = False  # re-sealed after a demotion
+            events.append({"op": op, "object_id": oid, "node_id": nid_hex})
+        # one batched publication per flush (Publisher coalesces wakeups)
+        for ev in events:
+            self.publisher.publish("object_loc", ev["object_id"].hex()
+                                   if isinstance(ev["object_id"], bytes)
+                                   else str(ev["object_id"]), ev)
+        return {"ok": True, "applied": len(events)}
+
+    async def h_get_object_locations(self, object_ids: List[bytes]):
+        """Bulk cold-path resolution: oid-hex -> [{node_id, address,
+        spilled, size}] for every known copy, live nodes only."""
+        out = {}
+        for oid in object_ids:
+            locs = self._object_locations.get(oid)
+            if not locs:
+                continue
+            rows = []
+            for nid_hex, loc in locs.items():
+                e = self.view.get(NodeID.from_hex(nid_hex))
+                if e is None or not e.alive:
+                    continue
+                rows.append({"node_id": nid_hex,
+                             "address": loc.get("address")
+                             or (e.transfer_address and
+                                 tuple(e.transfer_address)),
+                             "spilled": bool(loc.get("spilled")),
+                             "size": loc.get("size")})
+            if rows:
+                out[oid.hex()] = rows
+        return out
+
+    def _drop_node_locations(self, nid: NodeID) -> None:
+        nid_hex = nid.hex()
+        for oid in list(self._object_locations):
+            locs = self._object_locations[oid]
+            if locs.pop(nid_hex, None) is not None and not locs:
+                del self._object_locations[oid]
 
     async def _health_loop(self):
         period = GLOBAL_CONFIG.get("health_check_period_ms") / 1000.0
@@ -647,6 +732,8 @@ class GcsServer:
         self.publisher.publish("node", nid.hex(), {"state": "DEAD", "reason": reason})
         self._export("EXPORT_NODE", node_id=nid.hex(), state="DEAD",
                      reason=reason)
+        # its object copies died with it: pullers must not be routed there
+        self._drop_node_locations(nid)
         # fail over actors that lived there
         for rec in list(self._actors.values()):
             if rec.node_id == nid and rec.state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):

@@ -704,6 +704,9 @@ class ShmObjectStore:
         self._engine: Optional[_SpillEngine] = None
         self._spill_batch = max(1, int(os.environ.get("RT_spill_batch",
                                                       "8")))
+        # demotion observer (object location directory: an arena copy
+        # just became a spill-file copy) — must never fail a demotion
+        self._demote_cb = None
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
             self._lib.rts_set_autoevict(self._h, 0)
@@ -760,6 +763,22 @@ class ShmObjectStore:
         self._spill_seen = os.path.exists(self._sentinel_path())
         return self._spill_seen
 
+    def set_demote_callback(self, cb) -> None:
+        """``cb(object_id: bytes)`` fires after a value this handle
+        demoted becomes spill-backed (LRU demotion or direct
+        put_or_spill overflow).  Used by the hosting worker to move the
+        object's directory entry from arena-location to spill-location
+        so remote pullers take the spill-streaming path."""
+        self._demote_cb = cb
+
+    def _notify_demoted(self, object_id: bytes) -> None:
+        cb = self._demote_cb
+        if cb is not None:
+            try:
+                cb(bytes(object_id))
+            except Exception:  # noqa: BLE001 — observer must not fail spill
+                pass
+
     def _spill_some(self, need_bytes: int = 0) -> bool:
         """Demote a BATCH of LRU victims to the async spill engine.
         ``need_bytes`` bounds the batch (0 = one batch of up to
@@ -793,6 +812,7 @@ class ShmObjectStore:
             # bytes in the pending map the moment the span is gone
             self._engine.submit(oid, data)
             self._lib.rts_delete(self._h, oid, len(oid))
+            self._notify_demoted(oid)
             demoted_any = True
         return demoted_any
 
@@ -819,6 +839,7 @@ class ShmObjectStore:
         if not isinstance(data, (bytes, bytearray, memoryview)):
             data = bytes(data)
         self._engine.submit(object_id, bytes(data))
+        self._notify_demoted(object_id)
         return True
 
     def read_spilled(self, object_id: bytes) -> Optional[bytes]:

@@ -205,6 +205,9 @@ class Raylet:
             "rt_worker_pool_adoptions",
             "default-env pool workers reassigned to an env_vars/cwd-only "
             "runtime env via the configure_worker handshake")
+        # node object transfer service (object_store/transfer.py): started
+        # in start() so its port can ride the registration payload
+        self._transfer = None
         self.cgroups = None
         if GLOBAL_CONFIG.get("cgroup_isolation_enabled"):
             from ray_tpu.raylet.cgroups import CgroupManager
@@ -241,7 +244,7 @@ class Raylet:
              "indices": [i for i, b in bundles.items() if b.committed]}
             for pgid, bundles in self._bundles.items()
         ]
-        return dict(
+        payload = dict(
             node_id=self.node_id.binary(),
             address=self.server.address,
             resources=self.resources.total.to_dict(),
@@ -249,9 +252,19 @@ class Raylet:
             live_actors=live_actors,
             held_bundles=held_bundles,
         )
+        if self._transfer is not None:
+            payload["transfer_address"] = list(self._transfer.address)
+        return payload
 
     def start(self):
         self.server.start()
+        if GLOBAL_CONFIG.get("transfer_service") and \
+                GLOBAL_CONFIG.get("shm_store_enabled"):
+            from ray_tpu.object_store.transfer import TransferServer
+
+            self._transfer = TransferServer(self.node_id,
+                                            host=self.server.address[0])
+            self._transfer.start()
         reply = self.gcs.call("register_node", **self._registration_payload())
         GLOBAL_CONFIG.initialize(reply.get("system_config") or "{}")
         GLOBAL_CONFIG.reset_cache()
@@ -410,6 +423,9 @@ class Raylet:
 
     def stop(self):
         self._stopped = True
+        if self._transfer is not None:
+            self._transfer.stop()
+            self._transfer = None
         store = getattr(self, "_shm_stats_store", None)
         if store is not None:
             self._shm_stats_store = None
@@ -952,29 +968,50 @@ class Raylet:
                                      strategy=None, pg: Optional[tuple] = None,
                                      grant_only_local: bool = False,
                                      runtime_env: Optional[dict] = None,
-                                     job_id: Optional[bytes] = None):
+                                     job_id: Optional[bytes] = None,
+                                     locality: Optional[dict] = None):
         """Two-level scheduling (reference: node_manager.proto:413 +
         cluster_task_manager.h): grant locally, spill, or queue."""
         request = ResourceRequest.from_dict(resources) if isinstance(resources, dict) and "resources" in resources else ResourceRequest(resources)
         pg_key = (PlacementGroupID(pg[0]), pg[1]) if pg else None
         logger.debug("lease request %s res=%s", lease_id[:4].hex(), request.resources.to_dict())
 
+        # Argument-locality: when the hinted best node is NOT this one and
+        # could run the task, route there before burning a local grant —
+        # a local grant means the args pay the wire (submitter.py sends
+        # the owner-built {node_hex: arg_bytes} hint).
+        if locality and GLOBAL_CONFIG.get("locality_scheduling") \
+                and pg_key is None and not grant_only_local:
+            strategy_obj = (pickle.loads(strategy)
+                            if isinstance(strategy, bytes) else None)
+            node = policies.pick_node(self.view, request, strategy_obj,
+                                      local_node=self.node_id,
+                                      arg_bytes_by_node=locality)
+            if node is not None and node.node_id != self.node_id:
+                return {"status": "spill", "node_id": node.node_id.binary(),
+                        "address": node.address}
         if self._local_available(request, pg_key):
             granted = await self._grant_lease(lease_id, request, pg_key,
                                               runtime_env, job_id=job_id)
             if granted is not None:
                 return granted
         if pg_key is not None or grant_only_local:
-            # PG leases are node-pinned; queue locally until bundle frees up
+            # PG leases are node-pinned; queue locally until bundle frees
+            # up.  "pin" marks explicitly local-only requests (e.g. the
+            # submitter's final spill hop) so the drain never re-spills
+            # them — bouncing a hop-budget-exhausted lease defeats the pin.
             fut = asyncio.get_running_loop().create_future()
             self._pending_leases.append(
                 {"lease_id": lease_id, "request": request, "pg": pg_key,
-                 "runtime_env": runtime_env, "future": fut, "job_id": job_id}
+                 "runtime_env": runtime_env, "future": fut, "job_id": job_id,
+                 "pin": grant_only_local}
             )
             return await fut
         # consider spilling to another node
         strategy_obj = pickle.loads(strategy) if isinstance(strategy, bytes) else None
-        node = policies.pick_node(self.view, request, strategy_obj, local_node=self.node_id)
+        node = policies.pick_node(self.view, request, strategy_obj,
+                                  local_node=self.node_id,
+                                  arg_bytes_by_node=locality)
         if node is not None and node.node_id != self.node_id:
             return {"status": "spill", "node_id": node.node_id.binary(),
                     "address": node.address}
@@ -989,7 +1026,8 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._pending_leases.append(
             {"lease_id": lease_id, "request": request, "pg": None,
-             "runtime_env": runtime_env, "future": fut, "job_id": job_id}
+             "runtime_env": runtime_env, "future": fut, "job_id": job_id,
+             "locality": locality}
         )
         return await fut
 
@@ -1203,11 +1241,12 @@ class Raylet:
                         # (and its worker) leaks forever
                         await self.h_return_worker(item["lease_id"])
                     continue
-            if item["pg"] is None:
+            if item["pg"] is None and not item.get("pin"):
                 # re-evaluate spilling: a REMOTE node may have freed up
                 # while we were queued (its gossip triggers this drain)
                 node = policies.pick_node(
-                    self.view, item["request"], None, local_node=self.node_id)
+                    self.view, item["request"], None, local_node=self.node_id,
+                    arg_bytes_by_node=item.get("locality"))
                 if node is not None and node.node_id != self.node_id \
                         and not item["future"].done():
                     item["future"].set_result(

@@ -141,7 +141,10 @@ RPC_SCHEMAS: Dict[str, Message] = {
         "request_worker_lease", req("lease_id", bytes),
         req("resources", dict), opt("strategy", bytes),
         opt("pg", (tuple, list)), opt("runtime_env", dict),
-        opt("grant_only_local", bool), opt("job_id", bytes)),
+        opt("grant_only_local", bool), opt("job_id", bytes),
+        # argument-locality hint: {node_id_hex: total_arg_bytes} from the
+        # submitter's owner-side location cache (scheduling/policies.py)
+        opt("locality", dict)),
     # coalesced grants: up to N same-shape leases in one round trip
     "request_worker_leases": _m(
         "request_worker_leases", req("lease_ids", list),
@@ -161,6 +164,9 @@ RPC_SCHEMAS: Dict[str, Message] = {
                         req("address", (tuple, list)),
                         req("resources", dict), req("labels", dict),
                         opt("object_store_address", str),
+                        # node transfer-service endpoint [host, port]
+                        # (object_store/transfer.py)
+                        opt("transfer_address", (tuple, list)),
                         opt("live_actors", list), opt("held_bundles", list)),
     "register_actor": _m("register_actor", req("creation_spec", bytes),
                          req("actor_id", bytes), req("job_id", bytes),
@@ -180,6 +186,13 @@ RPC_SCHEMAS: Dict[str, Message] = {
                              opt("address", (tuple, list)),
                              opt("node_id", bytes), opt("death_cause", str),
                              opt("fast_port", int)),
+    # object location directory (reference gcs_service.proto
+    # ObjectLocationInfo): owner-coalesced batches of add/remove/spill
+    # transitions, and bulk resolution for cold fetches
+    "object_locations_update": _m("object_locations_update",
+                                  req("updates", list)),
+    "get_object_locations": _m("get_object_locations",
+                               req("object_ids", list)),
     "kv_put": _m("kv_put", req("namespace", str), req("key", (bytes, str)),
                  req("value", bytes), opt("overwrite", bool)),
     "kv_get": _m("kv_get", req("namespace", str), req("key", (bytes, str))),

@@ -28,6 +28,9 @@ class NodeEntry:
     alive: bool = True
     last_seen: float = field(default_factory=time.monotonic)
     object_store_address: Optional[str] = None  # shm store socket path (same-host)
+    # node transfer-service endpoint (object_store/transfer.py): where
+    # other nodes pull this node's sealed/spilled objects from
+    transfer_address: Optional[Tuple[str, int]] = None
 
 
 class ClusterView:

@@ -45,9 +45,18 @@ def pick_node(
     local_node: Optional[NodeID] = None,
     rng: Optional[random.Random] = None,
     require_available: bool = True,
+    arg_bytes_by_node: Optional[Dict[str, int]] = None,
 ) -> Optional[NodeEntry]:
     """Select a node for one request.  Returns None if nothing is feasible
-    (caller decides to queue or fail)."""
+    (caller decides to queue or fail).
+
+    ``arg_bytes_by_node`` ({node_id_hex: total argument bytes resident
+    there}) is the data-locality hint (reference: the locality-aware lease
+    policy, ``locality_aware_scheduling``): among usable candidates the
+    node holding the most argument bytes wins outright — shipping the task
+    is cheaper than shipping its args — with the hybrid pack/spread score
+    only breaking ties.  Explicit placement strategies (affinity, labels,
+    spread) are never overridden by the hint."""
     rng = rng or random
     strategy = strategy or DefaultStrategy()
     nodes = list(view.alive_nodes())
@@ -87,6 +96,13 @@ def pick_node(
     if isinstance(strategy, SpreadStrategy):
         # round-robin-ish: least utilized first, random tiebreak
         return min(candidates, key=lambda n: (n.resources.utilization(), rng.random()))
+
+    if arg_bytes_by_node and GLOBAL_CONFIG.get("locality_scheduling"):
+        best = max(candidates,
+                   key=lambda n: (arg_bytes_by_node.get(n.node_id.hex(), 0),
+                                  -_score(n, local_node)))
+        if arg_bytes_by_node.get(best.node_id.hex(), 0) > 0:
+            return best
 
     # hybrid: score, then top-k random choice to avoid herding
     scored = sorted(candidates, key=lambda n: _score(n, local_node))

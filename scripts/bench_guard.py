@@ -3,7 +3,7 @@
 checked-in ones and fail loudly on a same-box regression of the guarded
 rows.
 
-Three guarded artifacts:
+Guarded artifacts:
 
 - ``BENCH_core.json`` (``--fresh``): the round-8 target rows the
   native-dispatch + warm-pool + control-plane work is graded on.
@@ -13,6 +13,12 @@ Three guarded artifacts:
 - ``BENCH_data.json`` (``--fresh-data``): the round-12 GB-scale groupby
   shuffle row (streaming shuffle engine + async spill path) written by
   ``python bench_data.py --out <dir>/BENCH_data.json``.
+- ``BENCH_core.json`` multi-node rows (``--fresh-multinode``): the
+  round-13 cross-node transfer bandwidth + locality-scheduling rows
+  written by ``python bench_core.py --multinode --out <dir>/...``; they
+  diff against (and capture into) the committed BENCH_core.json.
+- ``BENCH_data.json`` TCP row (``--fresh-data-tcp``): the round-13
+  shuffle-over-TCP row written by ``python bench_data.py --tcp``.
 
 The checked-in files are the committed performance record (their values
 were measured on the box named in their captions); a fresh run on the
@@ -69,6 +75,24 @@ GUARDED_SERVE_ROWS = (
 # (``python bench_data.py --out <dir>/BENCH_data.json``).
 GUARDED_DATA_ROWS = (
     "groupby_shuffle_gb_per_min",
+)
+
+# The round-13 multi-node object-plane rows (ISSUE 13 acceptance):
+# cross-node pull bandwidth over the zero-copy transfer service and
+# large-arg task throughput under locality-aware lease scheduling
+# (``python bench_core.py --multinode --out <dir>/BENCH_multinode.json``).
+# The committed record of these rows lives in BENCH_core.json next to
+# the single-node rows — they are its first multi-node entries.
+GUARDED_MULTINODE_ROWS = (
+    "cross_node_transfer_gb_per_s",
+    "large_arg_locality_tasks_per_s",
+)
+
+# The round-13 shuffle-over-TCP row: the round-12 groupby shuffle on a
+# 2-node cluster so partitions cross the wire via the transfer service
+# (``python bench_data.py --tcp``); committed in BENCH_data.json.
+GUARDED_DATA_TCP_ROWS = (
+    "groupby_shuffle_tcp_gb_per_min",
 )
 
 
@@ -157,18 +181,30 @@ def _atomic_dump(doc: dict, path: str) -> None:
 def _capture_core(fresh_path: str, checked_in: str, ref: dict) -> None:
     # MERGE, don't wholesale-replace: the committed file carries
     # top-level keys the bench never emits (the captions dict) and
-    # per-row history fields that PERF_PLAN.md references.
+    # per-row history fields that PERF_PLAN.md references.  ``ref`` is
+    # recomputed from the checked-in file AT CAPTURE TIME (not the copy
+    # loaded when the legs were built) so stacked captures into one
+    # file — the core and multinode legs both land in BENCH_core.json —
+    # don't clobber each other; rows the fresh run never measures (e.g.
+    # the multi-node rows during a single-node capture) survive.
     with open(fresh_path) as f:
         fresh_doc = json.load(f)
     doc = {}
     if os.path.exists(checked_in):
         with open(checked_in) as f:
             doc = json.load(f)
-    doc.update({k: v for k, v in fresh_doc.items() if k != "results"})
-    doc["results"] = _merge_rows(fresh_doc.get("results", []), ref)
+    ref = {r["metric"]: r for r in doc.get("results", [])}
+    for k, v in fresh_doc.items():  # keep existing captions/source lines
+        if k != "results":
+            doc.setdefault(k, v)
+    fresh_rows = fresh_doc.get("results", [])
+    fresh_metrics = {r.get("metric") for r in fresh_rows}
+    merged = _merge_rows(fresh_rows, ref)
+    merged += [row for m, row in ref.items() if m not in fresh_metrics]
+    doc["results"] = merged
     _atomic_dump(doc, checked_in)
     print(f"bench_guard: captured {fresh_path} -> {checked_in} "
-          "(captions/history fields preserved)")
+          "(captions/history/unmeasured rows preserved)")
 
 
 def _capture_serve(fresh_path: str, checked_in: str, ref: dict) -> None:
@@ -213,6 +249,16 @@ def main(argv=None) -> int:
                    default=os.path.join(repo_root, "BENCH_data.json"),
                    help="committed data reference (default: repo "
                         "BENCH_data.json)")
+    p.add_argument("--fresh-multinode",
+                   help="BENCH_multinode.json from the run under test "
+                        "(python bench_core.py --multinode); rows diff "
+                        "against — and capture into — the committed "
+                        "BENCH_core.json")
+    p.add_argument("--fresh-data-tcp",
+                   help="shuffle-over-TCP BENCH_data.json from the run "
+                        "under test (python bench_data.py --tcp); row "
+                        "diffs against — and captures into — the "
+                        "committed BENCH_data.json")
     p.add_argument("--threshold", type=float, default=0.15,
                    help="max tolerated fractional regression (default 0.15)")
     p.add_argument("--capture", action="store_true",
@@ -221,9 +267,10 @@ def main(argv=None) -> int:
                         "refuses a fresh file missing guarded rows)")
     args = p.parse_args(argv)
 
-    if not args.fresh and not args.fresh_serve and not args.fresh_data:
-        print("bench_guard: pass --fresh, --fresh-serve and/or "
-              "--fresh-data", file=sys.stderr)
+    if not (args.fresh or args.fresh_serve or args.fresh_data
+            or args.fresh_multinode or args.fresh_data_tcp):
+        print("bench_guard: pass --fresh, --fresh-serve, --fresh-data, "
+              "--fresh-multinode and/or --fresh-data-tcp", file=sys.stderr)
         return 2
     legs = []  # (label, fresh_rows, ref_rows, guarded, capture_fn)
     if args.fresh:
@@ -268,6 +315,36 @@ def main(argv=None) -> int:
         legs.append(("data", _data_rows(args.fresh_data), ref,
                      GUARDED_DATA_ROWS,
                      lambda r: _capture_data(args.fresh_data,
+                                             args.checked_in_data, r)))
+    if args.fresh_multinode:
+        if not os.path.exists(args.fresh_multinode):
+            print(f"bench_guard: missing {args.fresh_multinode}",
+                  file=sys.stderr)
+            return 2
+        ref = _core_rows(args.checked_in) \
+            if os.path.exists(args.checked_in) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("multinode", _core_rows(args.fresh_multinode), ref,
+                     GUARDED_MULTINODE_ROWS,
+                     lambda r: _capture_core(args.fresh_multinode,
+                                             args.checked_in, r)))
+    if args.fresh_data_tcp:
+        if not os.path.exists(args.fresh_data_tcp):
+            print(f"bench_guard: missing {args.fresh_data_tcp}",
+                  file=sys.stderr)
+            return 2
+        ref = _data_rows(args.checked_in_data) \
+            if os.path.exists(args.checked_in_data) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in_data}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("data-tcp", _data_rows(args.fresh_data_tcp), ref,
+                     GUARDED_DATA_TCP_ROWS,
+                     lambda r: _capture_core(args.fresh_data_tcp,
                                              args.checked_in_data, r)))
 
     if args.capture:

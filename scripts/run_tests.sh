@@ -70,11 +70,13 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
     | tee -a "$RUN_LOG"
 fi
 # Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench,
-# the Serve data-plane bench, and the GB-scale data shuffle bench fresh
-# and diff the guarded rows (round-8 core targets + round-11 proxy rows
-# + round-12 groupby shuffle row) against the committed BENCH_core.json
-# / BENCH_serve.json / BENCH_data.json (>15% same-box regression fails
-# the run). Off by default — the benches need minutes and quiet CPUs.
+# the Serve data-plane bench, the GB-scale data shuffle bench, the
+# 2-node object-plane bench, and the shuffle-over-TCP bench fresh and
+# diff the guarded rows (round-8 core targets + round-11 proxy rows +
+# round-12 groupby shuffle row + round-13 multi-node rows) against the
+# committed BENCH_core.json / BENCH_serve.json / BENCH_data.json (>15%
+# same-box regression fails the run). Off by default — the benches need
+# minutes and quiet CPUs.
 if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
   echo "bench guard: running bench_core.py (this takes minutes)..." \
     | tee -a "$RUN_LOG"
@@ -99,6 +101,26 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
            "(log: $BG_DIR/bench_data.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
+    echo "bench guard: running bench_core.py --multinode (2-node rows)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          python "$OLDPWD/bench_core.py" --multinode \
+          --out "$BG_DIR/BENCH_multinode.json" > bench_multinode.log 2>&1)
+    then
+      echo "bench guard: multinode bench run failed" \
+           "(log: $BG_DIR/bench_multinode.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
+    echo "bench guard: running bench_data.py --tcp (shuffle over TCP)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          python "$OLDPWD/bench_data.py" --tcp --gb 0.75 \
+          --out "$BG_DIR/BENCH_data_tcp.json" > bench_data_tcp.log 2>&1)
+    then
+      echo "bench guard: data --tcp bench run failed" \
+           "(log: $BG_DIR/bench_data_tcp.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
     # subshell pipefail: the verdict must be bench_guard's exit status,
     # not tee's
     SERVE_ARGS=()
@@ -107,9 +129,15 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
     DATA_ARGS=()
     [[ -f "$BG_DIR/BENCH_data.json" ]] && \
       DATA_ARGS=(--fresh-data "$BG_DIR/BENCH_data.json")
+    MULTINODE_ARGS=()
+    [[ -f "$BG_DIR/BENCH_multinode.json" ]] && \
+      MULTINODE_ARGS=(--fresh-multinode "$BG_DIR/BENCH_multinode.json")
+    DATA_TCP_ARGS=()
+    [[ -f "$BG_DIR/BENCH_data_tcp.json" ]] && \
+      DATA_TCP_ARGS=(--fresh-data-tcp "$BG_DIR/BENCH_data_tcp.json")
     if (set -o pipefail; python scripts/bench_guard.py \
         --fresh "$BG_DIR/BENCH_core.json" "${SERVE_ARGS[@]}" \
-        "${DATA_ARGS[@]}" \
+        "${DATA_ARGS[@]}" "${MULTINODE_ARGS[@]}" "${DATA_TCP_ARGS[@]}" \
         | tee -a "$RUN_LOG"); then
       echo "bench guard: ok" | tee -a "$RUN_LOG"
     else

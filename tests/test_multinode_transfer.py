@@ -1,0 +1,384 @@
+"""Multi-node object plane: zero-copy node-to-node transfer
+(object_store/transfer.py), GCS object-location directory, and
+locality-aware lease scheduling.
+
+Covers the wire path end to end (byte-identical cross-node round trip,
+chunk-boundary framing, concurrent-pull dedup, spilled-object streaming
+without a local restore), the failure envelope (holder SIGKILLed
+mid-read falls back to another location or a typed error — never a
+hang), partial-download scratch GC, and the ``RT_transfer_service=0``
+parity oracle: every multi-node behavior must also hold on the legacy
+owner-RPC chunk path.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common.config import GLOBAL_CONFIG
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    try:
+        ray_tpu.shutdown()
+    finally:
+        c.shutdown()
+
+
+def _expected(seed, n):
+    return np.random.default_rng(seed).integers(
+        0, 255, size=n, dtype=np.uint8)
+
+
+def _make_remote(n):
+    @ray_tpu.remote(num_cpus=1, resources={"holder": 1})
+    def make(seed):
+        import numpy as np
+
+        return np.random.default_rng(seed).integers(
+            0, 255, size=n, dtype=np.uint8)
+
+    return make
+
+
+class TestCrossNodeTransfer:
+    def test_byte_identical_roundtrip(self, cluster):
+        """A result sealed into node B's arena reads back byte-identical
+        on the driver node, over the transfer service wire path."""
+        from ray_tpu.object_store import transfer
+
+        cluster.add_node(num_cpus=2, resources={"holder": 1})
+        assert cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        before = transfer.stats["downloads"]
+        ref = _make_remote(2_000_000).remote(7)
+        got = ray_tpu.get(ref, timeout=60)
+        assert got.dtype == np.uint8 and got.shape == (2_000_000,)
+        assert (got == _expected(7, 2_000_000)).all()
+        # the driver's fetch rode the wire path, not the owner-RPC chunks
+        assert transfer.stats["downloads"] > before
+
+    def test_chunk_boundary_framing(self):
+        """Sizes straddling the chunk size (64 KiB + 1, 2*chunk + 7)
+        land byte-identical — no off-by-one at chunk seams."""
+        os.environ["RT_transfer_chunk_bytes"] = "65536"
+        GLOBAL_CONFIG._cache.clear()
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        try:
+            c.add_node(num_cpus=2, resources={"holder": 1})
+            assert c.wait_for_nodes(2)
+            ray_tpu.init(address=c.address)
+            for seed, n in ((1, 64 * 1024 + 1), (2, 2 * 64 * 1024 + 7)):
+                got = ray_tpu.get(_make_remote(n).remote(seed), timeout=60)
+                assert (got == _expected(seed, n)).all(), n
+        finally:
+            try:
+                ray_tpu.shutdown()
+            finally:
+                c.shutdown()
+                os.environ.pop("RT_transfer_chunk_bytes", None)
+                GLOBAL_CONFIG._cache.clear()
+
+    def test_concurrent_pulls_dedup(self, cluster):
+        """N concurrent readers of one remote object share ONE in-flight
+        wire download (module-level in-process dedup)."""
+        from ray_tpu.object_store import transfer
+
+        cluster.add_node(num_cpus=2, resources={"holder": 1})
+        assert cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        n = 32_000_000  # big enough that followers arrive mid-download
+        ref = _make_remote(n).remote(3)
+        ray_tpu.wait([ref], num_returns=1, timeout=90)
+        before = transfer.stats["downloads"]
+        results, errors = [], []
+        barrier = threading.Barrier(4)
+
+        def reader():
+            try:
+                barrier.wait(timeout=10)
+                results.append(ray_tpu.get(ref, timeout=90))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+        assert len(results) == 4
+        exp = _expected(3, n)
+        for got in results:
+            assert (got == exp).all()
+        # one wire download served every overlapping reader (later
+        # readers may hit the landed arena copy: 0 extra downloads)
+        assert transfer.stats["downloads"] - before <= 2
+
+    def test_locality_scheduling_prefers_holder(self, cluster):
+        """A default-strategy task whose big arg lives on node B is
+        scheduled ON node B even though the head has free CPUs."""
+        cluster.add_node(num_cpus=2, resources={"holder": 1})
+        assert cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        ref = _make_remote(2_000_000).remote(9)
+        ray_tpu.wait([ref], num_returns=1, timeout=60)
+
+        @ray_tpu.remote(num_cpus=1)
+        def where(arr):
+            import ray_tpu as rt
+
+            assert arr.shape == (2_000_000,)
+            return rt.get_runtime_context().node_id.hex()
+
+        holder = [n for n in ray_tpu.nodes()
+                  if n["Resources"].get("holder")][0]
+        for _ in range(3):
+            assert ray_tpu.get(where.remote(ref), timeout=60) == \
+                holder["NodeID"]
+
+
+class TestTransferServiceUnit:
+    """Direct TransferServer/pull_object tests — no cluster."""
+
+    def _store(self, tmp_path, name, capacity=8 * 1024 * 1024):
+        from ray_tpu.object_store.shm import ShmObjectStore
+
+        seg = f"/{name}_{os.getpid()}"
+        spill = str(tmp_path / f"rtshm_spill_{seg.lstrip('/')}")
+        os.makedirs(spill, exist_ok=True)
+        store = ShmObjectStore(seg, capacity=capacity, spill_dir=spill)
+        return store, seg
+
+    def test_spilled_object_streams_without_restore(self, tmp_path):
+        """A demoted (spill-backed) object is served straight from its
+        spill file — the holder's arena stays empty afterwards."""
+        from ray_tpu.object_store.transfer import TransferServer, pull_object
+
+        store, _seg = self._store(tmp_path, "rttspill",
+                                  capacity=2 * 1024 * 1024)
+        try:
+            oid = os.urandom(16)
+            blob = os.urandom(4 * 1024 * 1024)  # 2x the arena: must spill
+            store.put_or_spill(oid, blob)
+            assert store.contains_spilled(oid)
+            assert not store.contains(oid)
+            # the spill engine writes asynchronously; wait for the FILE so
+            # the pull exercises the stream-from-disk path (a pull racing
+            # the writer is legitimately served from the pending queue,
+            # which is a different code path than this test pins down)
+            deadline = time.time() + 15
+            while (not os.path.exists(store._spill_path(oid))
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert os.path.exists(store._spill_path(oid))
+            srv = TransferServer(node_id=None, store=store)
+            addr = srv.start()
+            try:
+                got = pull_object(addr, oid, shm=None, timeout=30)
+                assert bytes(got) == blob
+                assert srv.stats["spill_streams"] == 1
+                # no re-admission on the holder
+                assert not store.contains(oid)
+            finally:
+                srv._stopped = True
+                srv._sock.close()
+        finally:
+            store.close()
+
+    def test_sealed_object_roundtrip_and_miss(self, tmp_path):
+        from ray_tpu.object_store.transfer import (TransferNotFound,
+                                                   TransferServer,
+                                                   pull_object)
+
+        store, _seg = self._store(tmp_path, "rttseal")
+        try:
+            oid = os.urandom(16)
+            blob = os.urandom(300_000)
+            assert store.put(oid, blob)
+            srv = TransferServer(node_id=None, store=store)
+            addr = srv.start()
+            try:
+                got = pull_object(addr, oid, shm=None, timeout=30)
+                assert bytes(got) == blob
+                with pytest.raises(TransferNotFound):
+                    pull_object(addr, os.urandom(16), shm=None, timeout=30)
+            finally:
+                srv._stopped = True
+                srv._sock.close()
+        finally:
+            store.close()
+
+    def test_holder_death_midstream_is_typed(self):
+        """A holder that dies mid-stream raises TransferError promptly —
+        never a hang, never a short read handed to the caller."""
+        from ray_tpu.object_store.transfer import (TransferError, _RESP,
+                                                   pull_object)
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def dying_holder():
+            conn, _ = srv.accept()
+            conn.recv(64)
+            conn.sendall(_RESP.pack(1, 1024 * 1024))
+            conn.sendall(b"x" * 1000)  # 1000 of 1 MiB, then vanish
+            conn.close()
+
+        threading.Thread(target=dying_holder, daemon=True).start()
+        result = {}
+
+        def puller():
+            try:
+                pull_object(srv.getsockname(), b"o" * 16, shm=None,
+                            timeout=10)
+                result["r"] = "returned"
+            except TransferError:
+                result["r"] = "typed"
+            except Exception as e:  # noqa: BLE001
+                result["r"] = e
+
+        t = threading.Thread(target=puller, daemon=True)
+        t.start()
+        t.join(30)
+        srv.close()
+        assert not t.is_alive(), "pull hung on a dead holder"
+        assert result["r"] == "typed", result
+
+    def test_gc_transfer_scratch_reclaims_dead_puller(self, tmp_path):
+        """A dead puller's half-landed arena span (live segment, dead
+        pid marker) is aborted and its marker removed; live-pid markers
+        are left alone."""
+        from ray_tpu.object_store.shm import ShmObjectStore
+        from ray_tpu.object_store.transfer import gc_transfer_scratch
+
+        seg = f"/rtgc_{os.getpid()}"
+        spill = tmp_path / f"rtshm_spill_{seg.lstrip('/')}"
+        spill.mkdir()
+        store = ShmObjectStore(seg, capacity=4 * 1024 * 1024,
+                               spill_dir=str(spill))
+        try:
+            oid = os.urandom(16)
+            buf = store.create(oid, 1024 * 1024)
+            assert buf is not None
+            del buf  # never sealed: a mid-download crash leaves this
+            p = subprocess.Popen([sys.executable, "-c", "pass"])
+            p.wait()
+            (spill / f"{oid.hex()}.pull.{p.pid}").touch()
+            live_marker = spill / f"{os.urandom(16).hex()}.pull.{os.getpid()}"
+            live_marker.touch()
+            removed = gc_transfer_scratch(str(tmp_path))
+            assert removed["markers"] == 1
+            assert removed["aborted"] == 1
+            assert not (spill / f"{oid.hex()}.pull.{p.pid}").exists()
+            assert live_marker.exists()  # live puller untouched
+            # the span was freed: the same id is creatable again
+            buf2 = store.create(oid, 1024 * 1024)
+            assert buf2 is not None
+            del buf2
+            store.abort(oid)
+        finally:
+            store.close()
+
+
+class TestHolderNodeDeath:
+    def test_sigkill_holder_falls_back_or_types(self):
+        """SIGKILL the holder node's raylet while a reader pulls: the
+        reader completes from another location (seeded on node C by an
+        earlier consumer) or raises a typed error — it never hangs."""
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2},
+                    control_plane_procs=True)
+        try:
+            b = c.add_node(num_cpus=2, resources={"b": 1})
+            c.add_node(num_cpus=2, resources={"c": 1})
+            assert c.wait_for_nodes(3)
+            ray_tpu.init(address=c.address)
+
+            @ray_tpu.remote(num_cpus=1, resources={"b": 1}, max_retries=0)
+            def make():
+                import numpy as np
+
+                return np.arange(1_500_000, dtype=np.int64)
+
+            ref = make.remote()
+            ray_tpu.wait([ref], num_returns=1, timeout=90)
+
+            # consume once on node C: the pull lands a sealed copy in
+            # C's arena and reports it — the fallback location
+            @ray_tpu.remote(num_cpus=1, resources={"c": 1})
+            def touch(a):
+                return int(a[5])
+
+            assert ray_tpu.get(touch.remote(ref), timeout=90) == 5
+
+            out = {}
+
+            def reader():
+                try:
+                    out["v"] = ray_tpu.get(ref, timeout=90)
+                except Exception as e:  # noqa: BLE001
+                    out["e"] = e
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            c.remove_node(b, graceful=False)  # SIGKILL mid-read
+            t.join(150)
+            assert not t.is_alive(), "get() hung after holder node death"
+            if "v" in out:
+                assert out["v"][5] == 5 and out["v"].shape == (1_500_000,)
+            else:
+                from ray_tpu.common.status import RtError
+
+                assert isinstance(out["e"], RtError), out["e"]
+        finally:
+            try:
+                ray_tpu.shutdown()
+            finally:
+                c.shutdown()
+
+
+class TestLegacyParityOracle:
+    def test_transfer_disabled_roundtrip_and_locality_args(self):
+        """RT_transfer_service=0: the same cross-node reads succeed over
+        the legacy owner-RPC chunk path, and zero wire downloads happen."""
+        from ray_tpu.object_store import transfer
+
+        os.environ["RT_transfer_service"] = "0"
+        GLOBAL_CONFIG._cache.clear()
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        try:
+            c.add_node(num_cpus=2, resources={"holder": 1})
+            assert c.wait_for_nodes(2)
+            ray_tpu.init(address=c.address)
+            before = transfer.stats["downloads"]
+            ref = _make_remote(2_000_000).remote(11)
+            got = ray_tpu.get(ref, timeout=90)
+            assert (got == _expected(11, 2_000_000)).all()
+
+            @ray_tpu.remote(num_cpus=1)
+            def total(arr):
+                return int(arr.sum())
+
+            assert ray_tpu.get(total.remote(ref), timeout=90) == \
+                int(_expected(11, 2_000_000).sum())
+            assert transfer.stats["downloads"] == before
+        finally:
+            try:
+                ray_tpu.shutdown()
+            finally:
+                c.shutdown()
+                os.environ.pop("RT_transfer_service", None)
+                GLOBAL_CONFIG._cache.clear()
