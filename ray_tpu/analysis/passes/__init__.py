@@ -1,4 +1,5 @@
 """Built-in rt-analyze passes; importing this package registers them."""
 
 from ray_tpu.analysis.passes import (jit_recompile, loop_blocker,  # noqa: F401
-                                     native_race, rpc_schema_drift)
+                                     native_race, retry_drift,
+                                     rpc_schema_drift)

@@ -1,0 +1,169 @@
+"""retry-drift — hand-rolled retry loops and swallowed transport errors.
+
+ISSUE 15 unified cross-process retry behavior on
+``common/retry.py::RetryPolicy`` (exponential backoff, full jitter,
+shared deadline budget).  This pass keeps new code from drifting back to
+the two shapes that policy replaced:
+
+- **retry-sleep**: a constant-argument ``time.sleep`` /
+  ``asyncio.sleep`` inside an ``except`` handler inside a loop — the
+  classic bare retry loop.  Fixed sleeps wake every retrier on the same
+  tick (thundering herd) and stack budgets instead of sharing one
+  deadline; compute the delay with ``RetryPolicy.next_delay`` /
+  ``sleep``/``asleep`` instead.
+- **swallowed-error**: a broad ``except ...: pass`` whose try body makes
+  a cross-process call (RPC ``call``/``call_async``, pubsub
+  ``publish``, socket send/connect, object pulls/pushes).  A dropped
+  transport failure silently leaks the remote side's state (a lost
+  ``return_worker`` leaks a leased worker); either retry it under a
+  bounded ``RetryPolicy`` or at least surface the failure.
+
+Both shapes have legitimate instances (fixed-cadence poll heartbeats,
+best-effort teardown) — those are ARGUED exemptions, in
+``analysis_baseline.txt`` with reasons or via inline
+``# rt-analyze: ok(retry-drift)`` waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding,
+                                   dotted_name as _dotted, register_pass)
+from ray_tpu.analysis.passes.loop_blocker import (DEFAULT_PATHS,
+                                                  EXCLUDE_PATHS,
+                                                  _ModuleIndex)
+
+_SLEEP_CALLS = {"time.sleep", "asyncio.sleep"}
+
+# broad exception types whose silent swallow hides transport failures
+_BROAD_TYPES = {"Exception", "BaseException", "OSError", "ConnectionError",
+                "RpcError", "RtConnectionError"}
+
+# call tails that cross a process boundary (the paths RetryPolicy owns)
+_XPROC_TAILS = {"call", "call_async", "publish", "sendall",
+                "create_connection", "pull_object", "push_task"}
+
+
+def _sleep_subject(node: ast.Call, dotted: str) -> Optional[str]:
+    """``time.sleep:0.3`` for constant-argument sleeps, else None
+    (a computed delay is presumed to come from a policy)."""
+    if len(node.args) != 1:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+        return f"{dotted}:{arg.value}"
+    return None
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        d = _dotted(n)
+        if d is not None and d.split(".")[-1] in _BROAD_TYPES:
+            return True
+    return False
+
+
+def _xproc_call(body: List[ast.stmt]) -> Optional[str]:
+    """First cross-process call target in a statement list, or None."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d.split(".")[-1] in _XPROC_TAILS:
+                    return d
+    return None
+
+
+@register_pass
+class RetryDriftPass(AnalysisPass):
+    id = "retry-drift"
+    description = ("bare sleep-in-retry-loop and broad except-pass "
+                   "swallows on cross-process paths that should ride "
+                   "common/retry.py RetryPolicy")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for relpath in ctx.glob(DEFAULT_PATHS, exclude=EXCLUDE_PATHS):
+            findings.extend(self._analyze_module(ctx, relpath))
+        return self._apply_waivers(ctx, findings)
+
+    def _analyze_module(self, ctx: AnalysisContext,
+                        relpath: str) -> List[Finding]:
+        tree = ctx.tree(relpath)
+        index = _ModuleIndex()
+        index.visit(tree)
+
+        # enclosing def qualname per node (context for fingerprints)
+        owner: Dict[int, str] = {}
+
+        def _annotate(node: ast.AST, qual: str):
+            for child in ast.iter_child_nodes(node):
+                q = qual
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    q = index.qualnames.get(id(child), child.name)
+                owner[id(child)] = q
+                _annotate(child, q)
+
+        _annotate(tree, "<module>")
+
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str, str]] = set()
+
+        def _emit(line: int, code: str, subject: str, context: str,
+                  message: str) -> None:
+            key = (line, code, subject)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(self.id, relpath, line, context, code,
+                                    subject, message))
+
+        # rule 1: constant sleep inside an except handler inside a loop
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for handler in ast.walk(loop):
+                if not isinstance(handler, ast.ExceptHandler):
+                    continue
+                for node in ast.walk(handler):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = _dotted(node.func)
+                    if d not in _SLEEP_CALLS:
+                        continue
+                    subject = _sleep_subject(node, d)
+                    if subject is None:
+                        continue
+                    _emit(node.lineno, "retry-sleep", subject,
+                          owner.get(id(loop), "<module>"),
+                          f"`{d}` with a fixed delay in a retry loop: "
+                          "compute the backoff with RetryPolicy "
+                          "(common/retry.py) so retries jitter and share "
+                          "a deadline budget")
+
+        # rule 2: broad `except ...: pass` swallowing a cross-process call
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            target = _xproc_call(node.body)
+            if target is None:
+                continue
+            for handler in node.handlers:
+                if not _is_broad(handler):
+                    continue
+                if not all(isinstance(s, ast.Pass) for s in handler.body):
+                    continue
+                _emit(handler.lineno, "swallowed-error", target,
+                      owner.get(id(node), "<module>"),
+                      f"broad except swallows a failed `{target}`: a "
+                      "dropped cross-process call leaks remote state — "
+                      "retry it under a bounded RetryPolicy or surface "
+                      "the failure")
+        return findings

@@ -273,6 +273,9 @@ _D("pipeline_overlap", bool, True,
 # --- chaos / testing ---------------------------------------------------------
 _D("testing_rpc_failure", str, "", "method=prob fault injection spec, comma-sep")
 _D("testing_rpc_failure_seed", int, 0, "deterministic chaos seed")
+_D("testing_faults", str, "",
+   "deterministic fault-point spec (common/faults.py), comma-separated"
+   " point=schedule pairs; same syntax as the RT_FAULTS env var")
 
 # --- TPU ---------------------------------------------------------------------
 _D("shm_store_enabled", bool, True, "node-local shared-memory object store")

@@ -1,0 +1,280 @@
+"""Deterministic fault injection for cross-process boundaries.
+
+Every place a message leaves (or enters) a process on the get/put/lease
+path declares a *named fault point*::
+
+    from ray_tpu.common import faults
+    ...
+    faults.fault_point("transfer.pull.recv")
+
+When no fault is armed — the production state — ``fault_point`` is a
+single module-level flag check and an immediate return: no dict lookup,
+no lock, no allocation.  When a schedule is armed for the name, the call
+raises :class:`FaultInjected` (a ``ConnectionError``) according to the
+schedule, so the failure flows through exactly the code path a real
+transport failure would take.
+
+Schedules are deterministic so a chaos test can aim at one specific
+edge ("the SECOND recv of this pull dies") and assert the typed
+recovery contract, instead of soaking random SIGKILLs and hoping:
+
+* ``once``        — fire on the first hit only
+* ``nth:K``       — fire on the K-th hit only (1-based)
+* ``every:K``     — fire on every K-th hit
+* ``always``      — fire on every hit (alias for ``every:1``)
+* ``prob:P[:S]``  — fire with probability P from a seeded RNG
+  (seed S, default 0) — reproducible "random" faults
+
+Configuration, in precedence order:
+
+1. Runtime test API: :func:`inject` / :func:`clear` (same process only).
+2. ``RT_FAULTS`` env var — comma-separated ``point=schedule`` pairs,
+   inherited by spawned worker/raylet processes, e.g.
+   ``RT_FAULTS=transfer.pull.recv=once,gcs.rpc.send=nth:3``.
+3. The ``testing_faults`` config flag (same syntax), so a test cluster
+   can arm children via ``system_config`` without touching os.environ.
+
+:data:`FAULT_POINTS` is the committed manifest of every point threaded
+through the codebase; ``tests/test_fault_injection.py`` cross-checks it
+against the actual ``fault_point("...")`` call sites so the two cannot
+drift.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "FAULT_POINTS",
+    "FaultInjected",
+    "fault_point",
+    "inject",
+    "clear",
+    "configure",
+    "hits",
+    "fired",
+    "active_points",
+]
+
+
+class FaultInjected(ConnectionError):
+    """Raised at an armed fault point.
+
+    Subclasses ``ConnectionError`` (→ ``OSError``) so every transport
+    retry path that already catches ``OSError``/``ConnectionError``
+    treats an injected fault exactly like a torn connection.
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point}")
+        self.point = point
+
+    def __reduce__(self):  # survive pickling across process boundaries
+        return (FaultInjected, (self.point,))
+
+
+# The committed manifest: name -> where it fires (one line each).  Tests
+# walk this dict; adding a fault_point() call site without an entry here
+# (or vice versa) fails tests/test_fault_injection.py.
+FAULT_POINTS: Dict[str, str] = {
+    "transfer.server.send": (
+        "TransferServer response path, before any bytes of the payload "
+        "are written back — the puller sees a dead/early-EOF holder"),
+    "transfer.pull.connect": (
+        "pull_object leader, before connecting to the holder — "
+        "connection refused / holder unreachable"),
+    "transfer.pull.recv": (
+        "pull_object leader, after the request is sent and before the "
+        "response header is read — mid-pull holder death"),
+    "transfer.pull.dedup_wait": (
+        "pull_object follower, before waiting on the leader's event — "
+        "exercises the follower deadline/error propagation path"),
+    "gcs.rpc.send": (
+        "GcsClient, before dispatching any RPC to the control plane — "
+        "GCS unreachable / failover window"),
+    "raylet.lease.request": (
+        "NormalTaskSubmitter, before sending request_worker_lease(s) "
+        "to a raylet — raylet died before granting"),
+    "raylet.lease.return": (
+        "NormalTaskSubmitter, before sending return_worker to a raylet "
+        "— raylet died holding our lease"),
+    "worker.task.push": (
+        "NormalTaskSubmitter, before pushing a task to a leased worker "
+        "— worker crashed between lease grant and task delivery"),
+    "spill.write": (
+        "ShmObjectStore spill engine, before writing a spill file — "
+        "disk full / IO error on the spill path"),
+    "pubsub.publish": (
+        "Publisher.publish — the message is silently DROPPED (not "
+        "raised) to model a lost control-plane event"),
+}
+
+# --------------------------------------------------------------------------
+# State.  _ACTIVE is the hot-path gate: fault_point() reads it and returns
+# before touching anything else.  All mutation happens under _lock.
+# --------------------------------------------------------------------------
+
+_ACTIVE = False
+_lock = threading.Lock()
+_schedules: Dict[str, "_Schedule"] = {}
+_hit_counts: Dict[str, int] = {}
+_fired_counts: Dict[str, int] = {}
+
+
+class _Schedule:
+    """One armed fault point's firing rule.  Mutated under _lock only."""
+
+    __slots__ = ("spec", "kind", "k", "prob", "rng", "hits", "done")
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.hits = 0
+        self.done = False
+        kind, _, rest = spec.partition(":")
+        kind = kind.strip().lower()
+        if kind == "once":
+            self.kind, self.k = "nth", 1
+        elif kind == "always":
+            self.kind, self.k = "every", 1
+        elif kind == "nth":
+            self.kind, self.k = "nth", int(rest)
+            if self.k < 1:
+                raise ValueError(f"nth:K needs K >= 1, got {spec!r}")
+        elif kind == "every":
+            self.kind, self.k = "every", int(rest)
+            if self.k < 1:
+                raise ValueError(f"every:K needs K >= 1, got {spec!r}")
+        elif kind == "prob":
+            p, _, seed = rest.partition(":")
+            self.kind = "prob"
+            self.prob = float(p)
+            if not 0.0 <= self.prob <= 1.0:
+                raise ValueError(f"prob:P needs 0 <= P <= 1, got {spec!r}")
+            self.rng = random.Random(int(seed) if seed else 0)
+            return
+        else:
+            raise ValueError(
+                f"unknown fault schedule {spec!r} "
+                "(want once | nth:K | every:K | always | prob:P[:seed])")
+
+    def should_fire(self) -> bool:
+        self.hits += 1
+        if self.kind == "nth":
+            if self.done:
+                return False
+            if self.hits == self.k:
+                self.done = True
+                return True
+            return False
+        if self.kind == "every":
+            return self.hits % self.k == 0
+        return self.rng.random() < self.prob  # prob
+
+
+def _parse_spec_string(spec: str) -> Dict[str, "_Schedule"]:
+    """``"a=once,b=nth:3"`` -> {point: schedule}.  Unknown point names are
+    rejected loudly — a typo'd RT_FAULTS that silently arms nothing is a
+    chaos test that silently tests nothing."""
+    out: Dict[str, _Schedule] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, sched = part.partition("=")
+        name = name.strip()
+        if not sep:
+            raise ValueError(f"bad RT_FAULTS entry {part!r} (want point=schedule)")
+        if name not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {name!r}; known: {sorted(FAULT_POINTS)}")
+        out[name] = _Schedule(sched.strip())
+    return out
+
+
+def configure(spec: str) -> None:
+    """Replace the armed set from a spec string (RT_FAULTS syntax)."""
+    global _ACTIVE
+    parsed = _parse_spec_string(spec)
+    with _lock:
+        _schedules.clear()
+        _schedules.update(parsed)
+        _ACTIVE = bool(_schedules)
+
+
+def inject(point: str, schedule: str = "once") -> None:
+    """Runtime test API: arm one fault point in this process."""
+    global _ACTIVE
+    if point not in FAULT_POINTS:
+        raise ValueError(
+            f"unknown fault point {point!r}; known: {sorted(FAULT_POINTS)}")
+    sched = _Schedule(schedule)
+    with _lock:
+        _schedules[point] = sched
+        _ACTIVE = True
+
+
+def clear() -> None:
+    """Disarm everything and reset counters (test teardown)."""
+    global _ACTIVE
+    with _lock:
+        _schedules.clear()
+        _hit_counts.clear()
+        _fired_counts.clear()
+        _ACTIVE = False
+
+
+def hits(point: str) -> int:
+    """How many times an armed ``fault_point(point)`` was reached."""
+    with _lock:
+        return _hit_counts.get(point, 0)
+
+
+def fired(point: str) -> int:
+    """How many times ``fault_point(point)`` actually raised."""
+    with _lock:
+        return _fired_counts.get(point, 0)
+
+
+def active_points() -> Dict[str, str]:
+    """Currently armed {point: spec} (for diagnostics)."""
+    with _lock:
+        return {name: s.spec for name, s in _schedules.items()}
+
+
+def fault_point(name: str) -> None:
+    """Declare a named cross-process boundary; raise if a fault is armed.
+
+    Production fast path: one global read, one truth test, return.
+    """
+    if not _ACTIVE:
+        return
+    with _lock:
+        sched = _schedules.get(name)
+        if sched is None:
+            return
+        _hit_counts[name] = _hit_counts.get(name, 0) + 1
+        if not sched.should_fire():
+            return
+        _fired_counts[name] = _fired_counts.get(name, 0) + 1
+    raise FaultInjected(name)
+
+
+def _load_from_env() -> None:
+    """Arm from RT_FAULTS / testing_faults at import (each process)."""
+    spec = os.environ.get("RT_FAULTS", "")
+    if not spec:
+        # Config flag path (system_config propagation).  Import lazily and
+        # defensively: faults must be importable before/without config.
+        try:
+            from ray_tpu.common.config import GLOBAL_CONFIG
+            spec = GLOBAL_CONFIG.get("testing_faults") or ""
+        except Exception:  # noqa: BLE001 - config unavailable = faults off
+            spec = ""
+    if spec:
+        configure(spec)
+
+
+_load_from_env()

@@ -18,8 +18,10 @@ import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.common import faults
 from ray_tpu.common.config import GLOBAL_CONFIG
 from ray_tpu.common.ids import ActorID, ObjectID
+from ray_tpu.common.retry import Deadline, RetryPolicy
 from ray_tpu.common.status import (
     ActorDiedError,
     TaskCancelledError,
@@ -438,18 +440,34 @@ class NormalTaskSubmitter:
                     await self._run_on_lease(key, lease_id, worker_addr,
                                              fast_port)
                 finally:
-                    try:
-                        await self._raylet_client(raylet_addr).call_async(
-                            "return_worker", lease_id=lease_id,
-                            timeout=10.0)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    await self._return_worker(raylet_addr, lease_id)
         finally:
             self._leases_in_flight[key] = max(0, self._leases_in_flight.get(key, 1) - 1)
             if self._leases_in_flight[key] == 0:
                 # last lease coroutine of this shape: any still-cached
                 # coalesced grants have no consumer left — give them back
                 self._drain_grant_cache(key)
+
+    async def _return_worker(self, raylet_addr, lease_id: bytes) -> bool:
+        """Give a lease back, with bounded retries: a swallowed failure
+        here leaks a LEASED worker until the raylet's liveness sweep
+        reaps the caller, so a transient transport blip must not drop
+        the return. False = the raylet is really gone (its own death
+        handling reclaims the lease)."""
+        policy = RetryPolicy(max_attempts=3, deadline=Deadline(5.0))
+        attempt = 0
+        while True:
+            try:
+                faults.fault_point("raylet.lease.return")
+                await self._raylet_client(raylet_addr).call_async(
+                    "return_worker", lease_id=lease_id, timeout=10.0)
+                return True
+            except Exception as e:  # noqa: BLE001 — typed below
+                attempt += 1
+                if not await policy.asleep(attempt):
+                    logger.warning("return_worker to %s failed: %s",
+                                   raylet_addr, e)
+                    return False
 
     def _raylet_client(self, addr) -> RetryableRpcClient:
         """Cached per-address raylet client (loop-only). The cache is
@@ -527,9 +545,16 @@ class NormalTaskSubmitter:
         lease_id = self._next_lease_id()
         raylet_addr = self._cw.raylet_address
         strategy = pickle.dumps(spec.scheduling_strategy)
+        # Transport failures retry against the same raylet under one
+        # bounded policy before the lease gives up (a retry consumes a
+        # hop — acceptable: 8 hops, <= 3 retries).  Without this, one
+        # connection blip failed the whole queued shape as infeasible.
+        lease_policy = RetryPolicy(max_attempts=4, deadline=Deadline(30.0))
+        attempt = 0
         for _hop in range(8):
             client = self._raylet_client(raylet_addr)
             try:
+                faults.fault_point("raylet.lease.request")
                 # No client-side timeout: a queued lease legitimately blocks
                 # until resources free up; truly impossible demands come back
                 # as an explicit "infeasible" status from the raylet.
@@ -561,6 +586,9 @@ class NormalTaskSubmitter:
                 stale = self._raylet_clients.pop(tuple(raylet_addr), None)
                 if stale is not None:
                     stale.close()
+                attempt += 1
+                if await lease_policy.asleep(attempt):
+                    continue
                 return None
             status = reply.get("status")
             if status == "granted":
@@ -615,14 +643,7 @@ class NormalTaskSubmitter:
         worker and its resources forever."""
         for raylet_addr, lease_id, _wa, _fp in self._grant_cache.pop(
                 key, []):
-            async def give_back(addr=raylet_addr, lid=lease_id):
-                try:
-                    await self._raylet_client(addr).call_async(
-                        "return_worker", lease_id=lid, timeout=10.0)
-                except Exception:  # noqa: BLE001
-                    pass
-
-            self._io.spawn(give_back())
+            self._io.spawn(self._return_worker(raylet_addr, lease_id))
 
     async def _run_on_lease(self, key: tuple, lease_id: bytes, worker_addr,
                             fast_port=None):
@@ -724,6 +745,7 @@ class NormalTaskSubmitter:
                 if payload is not None:
                     self._pushed[tid] = tuple(worker_addr)
                     try:
+                        faults.fault_point("worker.task.push")
                         # the reply is stored by the channel's reader
                         # thread; the future only sequences the window
                         pending[fast.push(spec, payload)] = spec
@@ -742,6 +764,7 @@ class NormalTaskSubmitter:
                 self._pushed[tid] = tuple(worker_addr)
                 self._m_slow.inc()
                 try:
+                    faults.fault_point("worker.task.push")
                     reply = await client.call_async(
                         "push_task", spec=pickle.dumps(spec), timeout=None,
                     )
@@ -859,7 +882,17 @@ class NormalTaskSubmitter:
                     f"worker died executing task "
                     f"{spec.name or spec.function.qualname}: {exc}"))
         if retry:
-            await asyncio.sleep(0.3)
+            # Full-jitter backoff growing with the retries this batch has
+            # already burned (replaces a flat 0.3 s that woke every
+            # retrier of a died-together window on the same tick); the
+            # re-enqueued specs then ride the lease path's own budget.
+            consumed = max(1, min(
+                GLOBAL_CONFIG.get("max_task_retries") - s.max_retries
+                for s in retry))
+            delay = RetryPolicy(base_s=0.3, cap_s=2.0).next_delay(consumed)
+            # 0.1 s floor: the raylet must get a liveness tick to reap the
+            # dead worker or the retry is granted the same dying process
+            await asyncio.sleep(0.1 + (delay or 0.0))
             for spec in retry:
                 self._enqueue(spec)
 
@@ -1110,6 +1143,10 @@ class ActorTaskSubmitter:
         # handles doesn't stampede the GCS with 50 polls/s each.
         unknown_deadline = loop.time() + 5.0
         unknown_wait = 0.02
+        # get_actor failures (GCS restarting / failing over) back off with
+        # jitter so a herd of resolvers doesn't hammer the recovering GCS
+        gcs_backoff = RetryPolicy(base_s=0.2, cap_s=1.0)
+        gcs_failures = 0
         while loop.time() < deadline:
             # pubsub-pushed view first: the ALIVE event carries the full
             # public view, so the common churn path resolves without any
@@ -1121,8 +1158,10 @@ class ActorTaskSubmitter:
                 try:
                     info = await self._cw.gcs.call_async(
                         "get_actor", actor_id=self.actor_id.binary())
+                    gcs_failures = 0
                 except Exception:  # noqa: BLE001
-                    await asyncio.sleep(0.5)
+                    gcs_failures += 1
+                    await gcs_backoff.asleep(gcs_failures)
                     continue
             if info is None:
                 if loop.time() < unknown_deadline:

@@ -897,17 +897,25 @@ class CoreWorker:
             return None
         return self._node_transfer_addrs.get(node_hex) or None
 
-    def _transfer_pull_blocking(self, oid: ObjectID):
+    def _transfer_pull_blocking(self, oid: ObjectID, deadline=None):
         """Pull one object over the node transfer service (the zero-copy
         wire path, object_store/transfer.py): owner's locality hint
         first, then every live copy in the GCS directory.  A holder node
         that died mid-pull just advances to the next source.  Returns
         the landed view/bytes or None — the caller then falls back to
         the legacy owner-RPC chunk path (the ``RT_transfer_service=0``
-        oracle path).  Blocking: executor threads only."""
+        oracle path).  Blocking: executor threads only.
+
+        ONE deadline spans every source (default 30 s for the whole
+        sweep): without it, N stale directory rows stacked N full
+        per-pull timeouts before the fallback path ever ran."""
         if not self._transfer_enabled:
             return None
+        from ray_tpu.common.retry import Deadline
         from ray_tpu.object_store import transfer as _transfer
+
+        if deadline is None:
+            deadline = Deadline(30.0)
 
         oid_bytes = oid.binary()
         my_hex = self.node_id.hex()
@@ -934,8 +942,11 @@ class CoreWorker:
                 sources.append(tuple(addr))
         shm = self.shm
         for addr in sources:
+            if deadline.expired():
+                return None  # budget spent: let the fallback path run
             try:
-                view = _transfer.pull_object(addr, oid_bytes, shm=shm)
+                view = _transfer.pull_object(addr, oid_bytes, shm=shm,
+                                             deadline=deadline)
             except _transfer.TransferNotFound:
                 continue  # that copy is already gone — next source
             except Exception:  # noqa: BLE001 — holder node unreachable

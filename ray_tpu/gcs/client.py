@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.common import faults
 from ray_tpu.common.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.common.status import GcsDeposedError
 from ray_tpu.rpc.pubsub import Subscriber
@@ -140,7 +141,16 @@ class GcsClient:
         last: Optional[Exception] = None
         for _ in range(len(self.addresses)):
             try:
+                faults.fault_point("gcs.rpc.send")
                 return await self._rpc.call_async(method, **kwargs)
+            except faults.FaultInjected as e:
+                # injected control-plane unreachability takes the exact
+                # exit a burned reconnect window does: rotate to a standby
+                # when there is one, else the typed transport-dead error
+                last = RpcRetriesExhausted(f"gcs rpc {method} failed: {e}")
+                if len(self.addresses) == 1:
+                    raise last from e
+                await self._rotate_async()
             except self._ROTATE_ON as e:
                 last = e
                 if len(self.addresses) == 1:
@@ -157,7 +167,13 @@ class GcsClient:
         last: Optional[Exception] = None
         for _ in range(len(self.addresses)):
             try:
+                faults.fault_point("gcs.rpc.send")
                 return self._rpc.call(method, **kwargs)
+            except faults.FaultInjected as e:
+                last = RpcRetriesExhausted(f"gcs rpc {method} failed: {e}")
+                if len(self.addresses) == 1:
+                    raise last from e
+                self._rotate()
             except self._ROTATE_ON as e:
                 last = e
                 if len(self.addresses) == 1:

@@ -307,6 +307,10 @@ class _SpillEngine:
 
     # ------------------------------------------------------------- write
     def _write_one(self, oid: bytes, data: bytes) -> None:
+        # injected OSError rides the write loop's failure handling: the
+        # engine goes sticky-failed, the bytes stay readable in pending
+        from ray_tpu.common import faults
+        faults.fault_point("spill.write")
         payload = data
         if self._codec is not None:
             import struct as _struct

@@ -40,7 +40,9 @@ import struct
 import threading
 from typing import Dict, Optional, Tuple
 
+from ray_tpu.common import faults
 from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.retry import Deadline
 from ray_tpu.object_store.shm import (
     _SPILL_MAGIC,
     ShmObjectStore,
@@ -186,6 +188,10 @@ class TransferServer:
                 pass
 
     def _serve_one(self, conn: socket.socket, oid: bytes) -> None:
+        # Injected OSError propagates to _serve_conn's handler, which
+        # drops the connection — the puller sees a dead holder (EOF
+        # before reply), the exact signature of a mid-request crash.
+        faults.fault_point("transfer.server.send")
         chunk = GLOBAL_CONFIG.get("transfer_chunk_bytes")
         store = self._get_store()
         view = store.get_pinned(oid) if store is not None else None
@@ -268,7 +274,8 @@ def _marker_path(shm: Optional[ShmObjectStore],
 
 def pull_object(address, object_id: bytes,
                 shm: Optional[ShmObjectStore] = None,
-                timeout: float = 30.0):
+                timeout: float = 30.0,
+                deadline: Optional[Deadline] = None):
     """Fetch one object from a holder's transfer server.
 
     Returns a pinned read-only arena view when the bytes landed in the
@@ -277,10 +284,18 @@ def pull_object(address, object_id: bytes,
     Concurrent pulls of the same id in this process dedupe into ONE
     wire download; followers share the leader's landed view.
 
+    ``deadline`` is the caller's REMAINING budget (common/retry.py);
+    every wait in here — follower wait on the leader, connect, socket
+    reads — is clipped to it, with ``timeout`` as the per-step cap.
+    Without one, ``timeout`` alone bounds each step (the old contract).
+
     Raises :class:`TransferNotFound` (holder no longer has it) or
-    :class:`TransferError` (holder died mid-stream / unreachable) — the
-    caller decides whether another location or the owner path is next.
+    :class:`TransferError` (holder died mid-stream / unreachable /
+    budget exhausted) — the caller decides whether another location or
+    the owner path is next.
     """
+    if deadline is None:
+        deadline = Deadline(timeout)
     with _inflight_lock:
         ent = _inflight.get(object_id)
         leader = ent is None
@@ -288,14 +303,27 @@ def pull_object(address, object_id: bytes,
             ent = _inflight[object_id] = _Pull()
     if not leader:
         stats["dedup_waits"] += 1
-        if not ent.done.wait(timeout):
-            raise TransferError(f"deduped pull of {object_id.hex()} "
-                                f"timed out after {timeout}s")
+        try:
+            faults.fault_point("transfer.pull.dedup_wait")
+        except faults.FaultInjected as e:
+            raise TransferError(
+                f"deduped pull of {object_id.hex()} from "
+                f"{tuple(address)} failed: {e}") from e
+        # Follower budget = the FOLLOWER's remaining deadline, not a
+        # fixed window: a caller with 2 s left must not block 30 s on a
+        # leader working someone else's clock.
+        wait_s = deadline.remaining(cap=timeout)
+        if not ent.done.wait(wait_s):
+            raise TransferError(
+                f"deduped pull of {object_id.hex()} from "
+                f"{tuple(address)} timed out after {wait_s:.1f}s "
+                f"(caller's remaining budget)")
         if ent.exc is not None:
             raise ent.exc
         return ent.result
     try:
-        ent.result = _pull_once(tuple(address), object_id, shm, timeout)
+        ent.result = _pull_once(tuple(address), object_id, shm, timeout,
+                                deadline)
         return ent.result
     except BaseException as e:
         ent.exc = e
@@ -307,17 +335,22 @@ def pull_object(address, object_id: bytes,
 
 
 def _pull_once(address, object_id: bytes, shm: Optional[ShmObjectStore],
-               timeout: float):
+               timeout: float, deadline: Deadline):
     stats["downloads"] += 1
     chunk = GLOBAL_CONFIG.get("transfer_chunk_bytes")
+    # floor: an almost-spent budget must surface as a (typed) timeout,
+    # never as timeout=0 ("blocking forever" to the socket module)
+    budget = deadline.remaining(cap=timeout, floor=0.001)
     try:
-        sock = socket.create_connection(address, timeout=timeout)
+        faults.fault_point("transfer.pull.connect")
+        sock = socket.create_connection(address, timeout=budget)
     except OSError as e:
         raise TransferError(
             f"transfer server {address} unreachable: {e}") from e
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.sendall(_MAGIC + bytes([len(object_id)]) + object_id)
+        faults.fault_point("transfer.pull.recv")
         hdr = _recv_exact(sock, _RESP.size)
         if hdr is None or len(hdr) < _RESP.size:
             raise TransferError(f"holder {address} closed before reply")
@@ -327,7 +360,15 @@ def _pull_once(address, object_id: bytes, shm: Optional[ShmObjectStore],
         return _land(sock, object_id, size, shm, chunk)
     except socket.timeout as e:
         raise TransferError(
-            f"pull of {object_id.hex()} from {address} timed out") from e
+            f"pull of {object_id.hex()} from {address} timed out "
+            f"after {budget:.1f}s") from e
+    except TransferError:
+        raise
+    except OSError as e:
+        # torn connection / injected fault mid-pull: type it so callers
+        # keep one contract (TransferError = try the next location)
+        raise TransferError(
+            f"pull of {object_id.hex()} from {address} failed: {e}") from e
     finally:
         sock.close()
 

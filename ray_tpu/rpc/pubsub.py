@@ -14,6 +14,8 @@ import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.common import faults
+
 from .rpc import IoContext, RetryableRpcClient, RpcError, RpcServer
 
 _MAILBOX_CAP = 10_000
@@ -83,6 +85,14 @@ class Publisher:
 
     def publish(self, channel: str, key: str, message: Any):
         """Thread-safe; deliver to all subscribers matching (channel, key)."""
+        try:
+            faults.fault_point("pubsub.publish")
+        except faults.FaultInjected:
+            # a lost control-plane event, not a raised one: publishers
+            # are fire-and-forget, so the fault manifests as listeners
+            # simply never hearing this message (they must converge via
+            # polling / later events, never hang on one publish)
+            return
         with self._lock:
             targets = []
             for sub_id, channels in self._subs.items():
@@ -156,15 +166,21 @@ class Subscriber:
             self._io.spawn_threadsafe(self._poll_loop())
 
     async def _poll_loop(self):
+        from ray_tpu.common.retry import RetryPolicy
+
+        backoff = RetryPolicy(base_s=0.2, cap_s=1.0)  # unbounded attempts:
+        failures = 0  # a subscriber must outlive any publisher outage
         while not self._stopped.is_set():
             try:
                 batch = await self._client.call_async(
                     self._prefix + "poll", subscriber_id=self.subscriber_id, timeout=35.0
                 )
+                failures = 0
             except Exception:  # noqa: BLE001 - keep polling through transient failures
                 if self._stopped.is_set():
                     return
-                await asyncio.sleep(0.2)
+                failures += 1
+                await backoff.asleep(failures)
                 continue
             if batch == "__resubscribe__":
                 # publisher restarted: replay every subscription, then poll

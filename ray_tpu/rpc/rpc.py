@@ -29,6 +29,7 @@ import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu.common.config import GLOBAL_CONFIG
+from ray_tpu.common.retry import Deadline, RetryPolicy
 from ray_tpu.common.status import RtConnectionError, RtTimeoutError
 from . import chaos
 
@@ -536,9 +537,10 @@ class RetryableRpcClient:
         self._deadline_s = deadline_s
 
     async def call_async(self, method: str, timeout: Optional[float] = None, **kwargs):
-        base = GLOBAL_CONFIG.get("rpc_retry_base_ms") / 1000.0
-        cap = GLOBAL_CONFIG.get("rpc_retry_max_ms") / 1000.0
-        deadline = None if self._deadline_s is None else time.monotonic() + self._deadline_s
+        policy = RetryPolicy(
+            base_s=GLOBAL_CONFIG.get("rpc_retry_base_ms") / 1000.0,
+            cap_s=GLOBAL_CONFIG.get("rpc_retry_max_ms") / 1000.0,
+            deadline=Deadline(self._deadline_s))
         attempt = 0
         while True:
             try:
@@ -549,10 +551,11 @@ class RetryableRpcClient:
                 attempt += 1
                 if attempt >= self._max_attempts:
                     raise
-                if deadline is not None and time.monotonic() >= deadline:
+                if not await policy.asleep(attempt):
+                    # per-address reconnect budget spent: typed so failover
+                    # clients rotate and plain callers see "peer is dead"
                     raise RpcRetriesExhausted(
                         f"rpc {method} retries exhausted: {e}") from e
-                await asyncio.sleep(min(cap, base * (2 ** (attempt - 1))))
                 self._client.close()
                 self._client = RpcClient(self.address)
 

@@ -20,27 +20,59 @@ def rt():
     ray_tpu.shutdown()
 
 
+def _proc_status(pid):
+    """(ppid, state) from /proc, or None if the pid is gone (exited
+    between the pgrep snapshot and this read — a normal race here)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            fields = dict(ln.split(":", 1) for ln in f if ":" in ln)
+        return (int(fields["PPid"].strip()),
+                fields.get("State", "?").strip()[:1])
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def _cmdline(pid):
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(errors="replace")
+    except OSError:
+        return ""
+
+
 def _worker_pids():
     """Pids of live worker processes: exec'd workers by cmdline, plus
     factory-forked workers (fork keeps the factory's cmdline, so they are
-    identified as CHILDREN of a factory process)."""
+    identified as CHILDREN of a factory process).
+
+    pgrep's snapshot races process exit: a listed pid may already be
+    gone — or worse, REUSED by an unrelated process — by the time we
+    kill it.  Every candidate is therefore re-verified against a fresh
+    /proc read (cmdline still matches, not a zombie) and the test
+    process itself and its ancestors are excluded, so a stale snapshot
+    can never aim the SIGKILL at the pytest run or an innocent pid."""
     import subprocess
 
     def pgrep(pat):
         out = subprocess.run(["pgrep", "-f", pat],
                              capture_output=True, text=True).stdout.split()
-        return [int(p) for p in out]
+        return [int(p) for p in out if p.isdigit()]
 
-    pids = pgrep("ray_tpu.core_worker.worker_main")
+    protected = {os.getpid(), os.getppid()}
+    pids = []
+    for cand in pgrep("ray_tpu.core_worker.worker_main"):
+        st = _proc_status(cand)
+        if (cand not in protected and st is not None and st[1] != "Z"
+                and "ray_tpu.core_worker.worker_main" in _cmdline(cand)):
+            pids.append(cand)
     factories = set(pgrep("ray_tpu.raylet.worker_factory"))
     for cand in factories:
-        try:
-            with open(f"/proc/{cand}/status") as f:
-                ppid = int(next(ln for ln in f if ln.startswith("PPid"))
-                           .split()[1])
-        except (OSError, StopIteration):
+        st = _proc_status(cand)
+        if st is None or st[1] == "Z" or cand in protected:
             continue
-        if ppid in factories:  # a forked worker, not the factory itself
+        if "ray_tpu.raylet.worker_factory" not in _cmdline(cand):
+            continue  # pid reused since the pgrep snapshot
+        if st[0] in factories:  # a forked worker, not the factory itself
             pids.append(cand)
     return pids
 

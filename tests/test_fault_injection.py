@@ -1,0 +1,720 @@
+"""Deterministic chaos: every registered fault point on the cross-node
+get/put/lease path (common/faults.py FAULT_POINTS) has a test here that
+arms it with a deterministic schedule and asserts the TYPED recovery
+contract — retry-next-location, reconstruct, or a typed
+TransferError/RpcRetriesExhausted/SpillFailedError — never a hang (every
+wait in this file is deadline-bounded).
+
+Also pins the unified retry/deadline policy (common/retry.py): full
+jitter bounds, attempt caps, deadline clipping, and the
+propagated-budget contract on the transfer pull chain (a follower with
+2 s left must not block 30 s on a leader working someone else's clock).
+"""
+
+import asyncio
+import os
+import pickle
+import random
+import re
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ray_tpu.common import faults
+from ray_tpu.common.faults import FAULT_POINTS, FaultInjected
+from ray_tpu.common.retry import Deadline, RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with nothing armed."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _wait(cond, timeout=20.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------- schedules
+
+
+class TestScheduleSemantics:
+    def _hits(self, point, n):
+        """Call the point n times; return the list of 0/1 fire flags."""
+        out = []
+        for _ in range(n):
+            try:
+                faults.fault_point(point)
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    def test_once_fires_exactly_once(self):
+        faults.inject("gcs.rpc.send", "once")
+        assert self._hits("gcs.rpc.send", 5) == [1, 0, 0, 0, 0]
+        assert faults.hits("gcs.rpc.send") == 5
+        assert faults.fired("gcs.rpc.send") == 1
+
+    def test_nth_fires_on_kth_hit_only(self):
+        faults.inject("transfer.pull.recv", "nth:3")
+        assert self._hits("transfer.pull.recv", 6) == [0, 0, 1, 0, 0, 0]
+
+    def test_every_k(self):
+        faults.inject("spill.write", "every:2")
+        assert self._hits("spill.write", 6) == [0, 1, 0, 1, 0, 1]
+
+    def test_always(self):
+        faults.inject("worker.task.push", "always")
+        assert self._hits("worker.task.push", 4) == [1, 1, 1, 1]
+
+    def test_prob_is_seed_deterministic(self):
+        faults.inject("pubsub.publish", "prob:0.5:42")
+        first = self._hits("pubsub.publish", 64)
+        faults.clear()
+        faults.inject("pubsub.publish", "prob:0.5:42")
+        second = self._hits("pubsub.publish", 64)
+        assert first == second
+        assert 0 < sum(first) < 64  # actually probabilistic, not 0%/100%
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.inject("transfer.pull.typo")
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.configure("no.such.point=once")
+
+    def test_bad_schedule_rejected(self):
+        with pytest.raises(ValueError):
+            faults.inject("gcs.rpc.send", "sometimes")
+        with pytest.raises(ValueError):
+            faults.inject("gcs.rpc.send", "nth:0")
+        with pytest.raises(ValueError):
+            faults.inject("gcs.rpc.send", "prob:1.5")
+
+    def test_configure_spec_string(self):
+        faults.configure("gcs.rpc.send=once, transfer.pull.recv=nth:2")
+        assert faults.active_points() == {
+            "gcs.rpc.send": "once", "transfer.pull.recv": "nth:2"}
+        # configure REPLACES the armed set
+        faults.configure("spill.write=always")
+        assert faults.active_points() == {"spill.write": "always"}
+
+    def test_clear_resets_everything(self):
+        faults.inject("gcs.rpc.send", "always")
+        self._hits("gcs.rpc.send", 3)
+        faults.clear()
+        assert faults.active_points() == {}
+        assert faults.hits("gcs.rpc.send") == 0
+        assert faults.fired("gcs.rpc.send") == 0
+        # disarmed: the armed-then-cleared point is a no-op again
+        assert self._hits("gcs.rpc.send", 3) == [0, 0, 0]
+        assert faults.hits("gcs.rpc.send") == 0  # not even counted
+
+    def test_fault_injected_is_a_connection_error_and_pickles(self):
+        e = FaultInjected("transfer.pull.recv")
+        assert isinstance(e, ConnectionError) and isinstance(e, OSError)
+        back = pickle.loads(pickle.dumps(e))
+        assert isinstance(back, FaultInjected)
+        assert back.point == "transfer.pull.recv"
+        assert "transfer.pull.recv" in str(back)
+
+
+class TestManifestSync:
+    """FAULT_POINTS is the committed manifest; the call sites are the
+    truth.  Either drifting from the other fails here."""
+
+    def _call_sites(self):
+        root = os.path.join(os.path.dirname(faults.__file__), "..")
+        root = os.path.abspath(root)  # ray_tpu/
+        pat = re.compile(r"""fault_point\(\s*["']([^"']+)["']\s*\)""")
+        found = set()
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, f)
+                if path == os.path.abspath(faults.__file__):
+                    continue  # the module's own docstring example
+                with open(path, encoding="utf-8") as fh:
+                    found.update(pat.findall(fh.read()))
+        return found
+
+    def test_every_manifest_point_has_a_call_site(self):
+        sites = self._call_sites()
+        missing = set(FAULT_POINTS) - sites
+        assert not missing, (
+            f"manifest entries with no fault_point() call site: {missing}")
+
+    def test_every_call_site_is_in_the_manifest(self):
+        sites = self._call_sites()
+        unknown = sites - set(FAULT_POINTS)
+        assert not unknown, (
+            f"fault_point() call sites missing a FAULT_POINTS entry: "
+            f"{unknown}")
+
+
+class TestEnvConfig:
+    """RT_FAULTS / testing_faults arm child processes at import."""
+
+    _PROBE = ("from ray_tpu.common import faults; "
+              "print(','.join(sorted(faults.active_points())))")
+
+    def _run(self, env_extra):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("RT_FAULTS", None)
+        env.update(env_extra)
+        return subprocess.run([sys.executable, "-c", self._PROBE],
+                              capture_output=True, text=True, env=env,
+                              timeout=120)
+
+    def test_rt_faults_env_arms_at_import(self):
+        r = self._run({"RT_FAULTS":
+                       "transfer.pull.recv=once,gcs.rpc.send=nth:3"})
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() == "gcs.rpc.send,transfer.pull.recv"
+
+    def test_testing_faults_config_flag_arms_at_import(self):
+        r = self._run({"RT_testing_faults": "spill.write=always"})
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() == "spill.write"
+
+    def test_typoed_spec_fails_loudly(self):
+        """A typo'd RT_FAULTS that silently armed nothing would be a
+        chaos test that silently tests nothing."""
+        r = self._run({"RT_FAULTS": "transfer.pull.rcv=once"})
+        assert r.returncode != 0
+        assert "unknown fault point" in r.stderr
+
+
+# ------------------------------------------------------------ retry policy
+
+
+class TestDeadline:
+    def test_remaining_cap_and_floor(self):
+        d = Deadline(10.0)
+        assert 9.0 < d.remaining() <= 10.0
+        assert d.remaining(cap=2.0) == 2.0
+        d2 = Deadline(0.0)
+        assert d2.expired()
+        assert d2.remaining(floor=0.001) == 0.001
+        assert d2.remaining() == 0.0
+
+    def test_unbounded(self):
+        d = Deadline(None)
+        assert d.unbounded and not d.expired()
+        assert d.remaining() is None
+        assert d.remaining(cap=5.0) == 5.0
+
+    def test_at_constructor(self):
+        d = Deadline.at(time.monotonic() + 3.0)
+        assert 2.0 < d.remaining() <= 3.0
+        assert not d.expired()
+
+    def test_one_budget_spans_nested_steps(self):
+        """The anti-stacking contract: two nested 'up to 30 s' steps
+        under one Deadline(0.5) share the 0.5 s, not 60 s."""
+        d = Deadline(0.5)
+        first = d.remaining(cap=30.0)
+        time.sleep(first)
+        assert d.remaining(cap=30.0, floor=0.001) == 0.001
+        assert d.expired()
+
+
+class TestRetryPolicy:
+    def test_full_jitter_bounds(self):
+        p = RetryPolicy(base_s=0.1, cap_s=2.0, rng=random.Random(7))
+        for attempt in range(1, 12):
+            d = p.next_delay(attempt)
+            assert 0.0 <= d <= min(2.0, 0.1 * 2 ** (attempt - 1))
+
+    def test_attempt_cap_exhausts(self):
+        p = RetryPolicy(max_attempts=3, base_s=0.0)
+        assert p.next_delay(1) is not None
+        assert p.next_delay(2) is not None
+        assert p.next_delay(3) is None
+
+    def test_deadline_clips_and_exhausts(self):
+        p = RetryPolicy(base_s=100.0, cap_s=100.0,
+                        deadline=Deadline(0.05), rng=random.Random(1))
+        d = p.next_delay(1)
+        assert d is not None and d <= 0.05
+        time.sleep(0.06)
+        assert p.next_delay(2) is None  # budget spent: give up, don't sleep
+
+    def test_iter_yields_attempts(self):
+        assert list(RetryPolicy(max_attempts=4)) == [1, 2, 3, 4]
+
+    def test_sleep_returns_false_when_exhausted(self):
+        p = RetryPolicy(max_attempts=1)
+        assert p.sleep(1) is False
+
+    def test_call_retries_then_succeeds(self):
+        p = RetryPolicy(max_attempts=5, base_s=0.001, cap_s=0.001)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        assert p.call(flaky) == "ok"
+        assert state["n"] == 3
+
+    def test_call_reraises_after_exhaustion(self):
+        p = RetryPolicy(max_attempts=2, base_s=0.001, cap_s=0.001)
+        with pytest.raises(ConnectionError):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("nope")))
+
+    def test_call_async(self):
+        async def run():
+            p = RetryPolicy(max_attempts=4, base_s=0.001, cap_s=0.001)
+            state = {"n": 0}
+
+            async def flaky():
+                state["n"] += 1
+                if state["n"] < 2:
+                    raise TimeoutError("slow")
+                return state["n"]
+
+            return await p.call_async(flaky)
+
+        assert asyncio.run(run()) == 2
+
+
+# -------------------------------------------------------- transfer plane
+
+
+def _store(tmp_path, name, capacity=8 * 1024 * 1024):
+    from ray_tpu.object_store.shm import ShmObjectStore
+
+    seg = f"/{name}_{os.getpid()}"
+    spill = str(tmp_path / f"rtshm_spill_{seg.lstrip('/')}")
+    os.makedirs(spill, exist_ok=True)
+    return ShmObjectStore(seg, capacity=capacity, spill_dir=spill), seg
+
+
+class _StallServer:
+    """Accepts transfer connections, reads the request, never replies —
+    a holder that hangs instead of dying."""
+
+    def __init__(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.address = self._sock.getsockname()
+        self._conns = []
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(conn)  # hold open, never respond
+
+    def close(self):
+        self._stop = True
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._sock.close()
+
+
+class TestTransferFaults:
+    """Each transfer-plane fault point, against a REAL server socket."""
+
+    @pytest.fixture
+    def served(self, tmp_path):
+        from ray_tpu.object_store.transfer import TransferServer
+
+        store, _seg = _store(tmp_path, "rtflt")
+        oid, blob = os.urandom(16), os.urandom(256 * 1024)
+        assert store.put(oid, blob)
+        srv = TransferServer(node_id=None, store=store)
+        addr = srv.start()
+        yield srv, addr, oid, blob
+        srv.stop()  # stop() closes the store
+
+    def test_server_send_drops_connection(self, served):
+        """Holder dies before replying → typed TransferError on the
+        puller; the NEXT pull (retry-next-location) succeeds."""
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        srv, addr, oid, blob = served
+        faults.inject("transfer.server.send", "once")
+        with pytest.raises(TransferError, match="closed before reply"):
+            pull_object(addr, oid, shm=None, timeout=10)
+        assert faults.fired("transfer.server.send") == 1
+        got = pull_object(addr, oid, shm=None, timeout=10)
+        assert bytes(got) == blob
+
+    def test_pull_connect_unreachable(self, served):
+        """Connect-time failure is typed 'unreachable' and never touches
+        the holder; the retry lands."""
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        srv, addr, oid, blob = served
+        faults.inject("transfer.pull.connect", "once")
+        with pytest.raises(TransferError, match="unreachable"):
+            pull_object(addr, oid, shm=None, timeout=10)
+        assert srv.stats["requests"] == 0  # fault fired before the wire
+        assert bytes(pull_object(addr, oid, shm=None, timeout=10)) == blob
+
+    def test_pull_recv_mid_pull(self, served):
+        """Holder death after the request left is typed, with the
+        attempted address in the message (the caller logs WHICH location
+        failed before moving on)."""
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        srv, addr, oid, blob = served
+        faults.inject("transfer.pull.recv", "once")
+        with pytest.raises(TransferError, match=re.escape(str(addr[1]))):
+            pull_object(addr, oid, shm=None, timeout=10)
+        assert bytes(pull_object(addr, oid, shm=None, timeout=10)) == blob
+
+    def test_socket_timeout_is_typed_with_budget(self):
+        """A stalling (not dead) holder surfaces as TransferError naming
+        the address and the spent budget — never a bare socket.timeout,
+        never a hang."""
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        stall = _StallServer()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(TransferError, match="timed out after"):
+                pull_object(stall.address, os.urandom(16), shm=None,
+                            timeout=0.5)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            stall.close()
+
+    def test_dedup_follower_fault_is_typed(self):
+        """An injected fault on the follower path surfaces as
+        TransferError, and the leader's own pull is unaffected."""
+        from ray_tpu.object_store import transfer
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        stall = _StallServer()
+        oid = os.urandom(16)
+        leader_err = []
+
+        def leader():
+            try:
+                pull_object(stall.address, oid, shm=None, timeout=2)
+            except BaseException as e:  # noqa: BLE001
+                leader_err.append(e)
+
+        t = threading.Thread(target=leader, daemon=True)
+        t.start()
+        try:
+            _wait(lambda: oid in transfer._inflight, timeout=5,
+                  msg="leader in flight")
+            faults.inject("transfer.pull.dedup_wait", "once")
+            with pytest.raises(TransferError, match="deduped pull"):
+                pull_object(stall.address, oid, shm=None, timeout=8)
+            assert faults.fired("transfer.pull.dedup_wait") == 1
+        finally:
+            stall.close()
+            t.join(15)
+        assert not t.is_alive(), "leader pull hung past its timeout"
+        # the leader saw its own (typed) timeout, not the follower's fault
+        assert leader_err and isinstance(leader_err[0], TransferError)
+
+    def test_dedup_follower_respects_own_deadline(self):
+        """The propagated-budget contract: a follower with 0.5 s left
+        waits 0.5 s, NOT the leader's 30 s window."""
+        from ray_tpu.object_store import transfer
+        from ray_tpu.object_store.transfer import TransferError, pull_object
+
+        stall = _StallServer()
+        oid = os.urandom(16)
+        t = threading.Thread(
+            target=lambda: _swallow(pull_object, stall.address, oid,
+                                    shm=None, timeout=8),
+            daemon=True)
+        t.start()
+        try:
+            _wait(lambda: oid in transfer._inflight, timeout=5,
+                  msg="leader in flight")
+            t0 = time.monotonic()
+            with pytest.raises(TransferError,
+                               match="remaining budget"):
+                pull_object(stall.address, oid, shm=None, timeout=30,
+                            deadline=Deadline(0.5))
+            assert time.monotonic() - t0 < 3.0, \
+                "follower blocked past its own deadline"
+        finally:
+            stall.close()
+            t.join(15)
+        assert not t.is_alive()
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except BaseException:  # noqa: BLE001 — side thread, outcome unchecked
+        pass
+
+
+# ------------------------------------------------------------ control plane
+
+
+class TestGcsFaults:
+    def test_single_address_typed_error(self, tmp_path):
+        """GCS unreachable with nowhere to fail over to → typed
+        RpcRetriesExhausted immediately, not a burned 30 s window."""
+        from ray_tpu.gcs.client import GcsClient
+        from ray_tpu.gcs.server import GcsServer
+        from ray_tpu.rpc.rpc import RpcRetriesExhausted
+
+        srv = GcsServer(persist_dir=str(tmp_path / "gcs"))
+        srv.start()
+        c = GcsClient(srv.address)
+        try:
+            faults.inject("gcs.rpc.send", "always")
+            t0 = time.monotonic()
+            with pytest.raises(RpcRetriesExhausted, match="kv_put"):
+                c.kv_put("ns", b"k", b"v")
+            assert time.monotonic() - t0 < 2.0
+            assert faults.fired("gcs.rpc.send") >= 1
+            faults.clear()
+            assert c.kv_put("ns", b"k", b"v")  # healthy again
+        finally:
+            c.close()
+            srv.stop()
+
+    def test_multi_address_rotates_to_standby(self, tmp_path):
+        """With a standby configured, an injected control-plane outage
+        rotates the client instead of failing the call."""
+        from ray_tpu.gcs.client import GcsClient
+        from ray_tpu.gcs.server import GcsServer
+
+        a = GcsServer(persist_dir=str(tmp_path / "a"))
+        a.start()
+        b = GcsServer(persist_dir=str(tmp_path / "b"))
+        b.start()
+        c = GcsClient(a.address, standby_addresses=[b.address])
+        try:
+            faults.inject("gcs.rpc.send", "once")
+            assert c.kv_put("ns", b"k", b"v")  # attempt 1 faults, 2 lands
+            assert faults.fired("gcs.rpc.send") == 1
+            assert c.address == tuple(b.address)  # actually rotated
+            assert c.kv_get("ns", b"k") == b"v"
+        finally:
+            c.close()
+            a.stop()
+            b.stop()
+
+
+class TestLocationPurgeOnNodeDeath:
+    def test_dead_node_purged_from_location_directory(self, tmp_path):
+        """A dead node's object-location entries are PURGED (not merely
+        filtered at read time): pullers are never routed to a dead
+        holder, and the directory does not leak dead rows."""
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.gcs.client import GcsClient
+        from ray_tpu.gcs.server import GcsServer
+
+        srv = GcsServer(persist_dir=str(tmp_path / "gcs"))
+        srv.start()
+        c = GcsClient(srv.address)
+        na, nb = NodeID.from_random(), NodeID.from_random()
+        oid, oid_only_b = os.urandom(16), os.urandom(16)
+        try:
+            c.register_node(na, ("127.0.0.1", 7001), {"CPU": 1}, {})
+            c.register_node(nb, ("127.0.0.1", 7002), {"CPU": 1}, {})
+            c.call("object_locations_update", updates=[
+                {"op": "add", "object_id": oid, "node_id": na.binary(),
+                 "address": ("127.0.0.1", 7101), "size": 10},
+                {"op": "add", "object_id": oid, "node_id": nb.binary(),
+                 "address": ("127.0.0.1", 7102), "size": 10},
+                {"op": "add", "object_id": oid_only_b,
+                 "node_id": nb.binary(),
+                 "address": ("127.0.0.1", 7102), "size": 4},
+            ])
+            locs = c.call("get_object_locations", object_ids=[oid])
+            assert len(locs[oid.hex()]) == 2
+            c.call("unregister_node", node_id=nb.binary())
+            locs = c.call("get_object_locations",
+                          object_ids=[oid, oid_only_b])
+            assert [r["node_id"] for r in locs[oid.hex()]] == [na.hex()]
+            assert oid_only_b.hex() not in locs
+            # purged from the directory itself, not filtered per-read
+            assert nb.hex() not in srv._object_locations.get(oid, {})
+            assert oid_only_b not in srv._object_locations
+        finally:
+            c.close()
+            srv.stop()
+
+
+# -------------------------------------------------------------- spill path
+
+
+class TestSpillWriteFault:
+    def test_spill_write_failure_is_sticky_and_lossless(self, tmp_path):
+        """An IO error on the spill writer surfaces as a typed, STICKY
+        SpillFailedError on the next submit — and the bytes that failed
+        to land stay readable from the pending map (never a silent
+        loss)."""
+        from ray_tpu.common.status import SpillFailedError
+
+        store, _seg = _store(tmp_path, "rtfsp", capacity=2 * 1024 * 1024)
+        try:
+            faults.inject("spill.write", "always")
+            oid = os.urandom(16)
+            blob = os.urandom(4 * 1024 * 1024)  # 2x the arena: must spill
+            assert store.put_or_spill(oid, blob)  # queued, not yet failed
+            _wait(lambda: store.spill_stats().get("failed"),
+                  msg="writer hit the injected fault")
+            assert faults.fired("spill.write") >= 1
+            # lossless: the un-landed bytes serve from the pending map
+            assert store.read_spilled(oid) == blob
+            # sticky + typed: the NEXT demotion refuses loudly
+            with pytest.raises(SpillFailedError, match="spill write"):
+                store.put_or_spill(os.urandom(16),
+                                   os.urandom(4 * 1024 * 1024))
+        finally:
+            faults.clear()
+            try:
+                store.close()
+            except Exception:  # noqa: BLE001 — engine is sticky-failed
+                pass
+
+
+# ----------------------------------------------------------------- pubsub
+
+
+class TestPubsubDrop:
+    def test_dropped_publish_loses_one_message_only(self):
+        """pubsub.publish models a LOST control-plane event: the armed
+        publish is silently dropped (no raise, nothing mailed), and the
+        next publish flows normally."""
+        from ray_tpu.rpc.pubsub import Publisher
+
+        pub = Publisher()
+        asyncio.run(pub._handle_subscribe("s1", "node"))
+        faults.inject("pubsub.publish", "once")
+        pub.publish("node", "k1", {"state": "DEAD"})
+        assert faults.fired("pubsub.publish") == 1
+        assert not pub._mail.get("s1")  # the event is GONE
+        pub.publish("node", "k2", {"state": "ALIVE"})
+        assert [m[1] for m in pub._mail["s1"]] == ["k2"]
+
+
+# ------------------------------------------------- lease / push (cluster)
+
+
+class TestSubmitterFaultRecovery:
+    """The three submitter-side fault points, against a real single-node
+    cluster: an injected raylet/worker failure must be retried under the
+    unified policy and the task still complete."""
+
+    @pytest.fixture
+    def rt(self):
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        yield ray_tpu
+        faults.clear()
+        ray_tpu.shutdown()
+
+    def test_lease_request_push_and_return_recover(self, rt):
+        @rt.remote
+        def f(x):
+            return x * 3
+
+        # 1) raylet dies before granting the lease: retried under
+        #    RetryPolicy(max_attempts=4, Deadline(30)) — task completes.
+        faults.inject("raylet.lease.request", "once")
+        assert rt.get(f.remote(1), timeout=60) == 3
+        assert faults.fired("raylet.lease.request") == 1
+
+        # 2) worker crashes between lease grant and task delivery: the
+        #    push failure re-enqueues the task — it still completes.
+        faults.clear()
+        faults.inject("worker.task.push", "once")
+        assert rt.get(f.remote(2), timeout=60) == 6
+        assert faults.fired("worker.task.push") == 1
+
+        # 3) return_worker fails transiently: the bounded retry gets the
+        #    lease back (no leaked worker), later tasks still schedule.
+        faults.clear()
+        faults.inject("raylet.lease.return", "once")
+        assert rt.get(f.remote(3), timeout=60) == 9
+        _wait(lambda: faults.fired("raylet.lease.return") >= 1,
+              msg="return_worker retried through the injected fault")
+        faults.clear()
+        assert rt.get(f.remote(4), timeout=60) == 12
+
+
+@pytest.mark.slow
+class TestNodeDeathEndToEnd:
+    def test_sigkilled_node_leaves_the_location_directory(self):
+        """Cluster-level regression for the purge: SIGKILL a node
+        holding an object copy; once the GCS declares it dead, its rows
+        are gone from the directory."""
+        import ray_tpu
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.gcs.client import GcsClient
+
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        try:
+            b = c.add_node(num_cpus=2, resources={"holder": 1})
+            assert c.wait_for_nodes(2)
+            ray_tpu.init(address=c.address)
+
+            @ray_tpu.remote(num_cpus=1, resources={"holder": 1})
+            def make():
+                return os.urandom(2_000_000)
+
+            ref = make.remote()
+            ray_tpu.wait([ref], num_returns=1, timeout=60)
+            gcs = GcsClient(c.gcs_address)
+            oid = ref.binary()
+            _wait(lambda: gcs.call("get_object_locations",
+                                   object_ids=[oid]).get(oid.hex()),
+                  timeout=30, msg="location registered")
+            c.remove_node(b, graceful=False)
+            _wait(lambda: not gcs.call("get_object_locations",
+                                       object_ids=[oid]).get(oid.hex()),
+                  timeout=90, msg="dead node's location purged")
+            gcs.close()
+        finally:
+            try:
+                ray_tpu.shutdown()
+            finally:
+                c.shutdown()
+
+
+# ---------------------------------------------------------------- overhead
+
+
+class TestDisabledOverhead:
+    def test_disarmed_fault_point_is_a_flag_check(self):
+        """With nothing armed, fault_point is one global read — bound it
+        generously (5 µs/call would still be ~50x the observed cost, and
+        far below anything bench_guard could measure on the task path)."""
+        faults.clear()
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            faults.fault_point("transfer.pull.recv")
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 5e-6, f"{per_call * 1e6:.2f}us per disarmed call"
