@@ -51,6 +51,8 @@ BASELINES = {
     "actors_per_second": 657.0,
     "pgs_per_second": 13.2,
     "tasks_per_second_10k_pending": 364.0,
+    "dynamic_actor_calls_per_second": None,
+    "compiled_actor_calls_per_second": None,
 }
 
 RESULTS = []
@@ -296,6 +298,38 @@ def main():
            "vs_baseline": round(rate / 364.0, 2)}
     RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
+
+    # Compiled actor graph vs dynamic dispatch (PERF_PLAN round 16): the
+    # same 2-stage actor chain driven per-call through the driver vs one
+    # channel-compiled graph where each item costs two shm-channel hops
+    # and zero driver RPCs. Two actor calls per item in both rows.
+    from ray_tpu.graph import InputNode
+
+    s1, s2 = Actor.remote(), Actor.remote()
+    ray_tpu.get(s2.small_value_arg.remote(s1.small_value_arg.remote(0)))
+
+    def dynamic_chain():
+        ray_tpu.get([s2.small_value_arg.remote(s1.small_value_arg.remote(i))
+                     for i in range(100)])
+
+    timeit("dynamic_actor_calls_per_second", dynamic_chain, 200, trials=2)
+
+    c1, c2 = Actor.bind(), Actor.bind()
+    with InputNode() as inp:
+        out = c2.small_value_arg.bind(c1.small_value_arg.bind(inp))
+    compiled = out.experimental_compile(channels=True)
+    try:
+        compiled.execute(0).get()  # warm the channel path
+
+        def compiled_chain():
+            futs = [compiled.execute(i) for i in range(100)]
+            for f in futs:
+                f.get()
+
+        timeit("compiled_actor_calls_per_second", compiled_chain, 200,
+               trials=2)
+    finally:
+        compiled.teardown()
 
     ray_tpu.shutdown()
     with open("BENCH_core.json", "w") as f:

@@ -12,6 +12,15 @@ ops matched; mismatched call orders surface as timeouts, not corruption).
 
 Data-plane keys are garbage-collected every ``GC_EVERY`` ops behind a
 barrier, so long-running groups don't grow the KV unboundedly.
+
+Quantized wire mode (``RT_quantized_collectives=1``, or ``quantized=True``
+per group): float payloads of allreduce/reducescatter travel as block-wise
+int8 codes + per-block scale/offset (collective/quantization.py) — ~3.9x
+fewer bytes through the KV for f32 — and every member dequantizes before
+reducing.  broadcast/allgather/p2p stay exact (their value IS the payload;
+re-encoding them would silently lossy-copy).  ``wire_put_bytes`` /
+``wire_get_bytes`` count the actual serialized blob sizes either way, so
+benches report measured bytes on the wire, not a formula.
 """
 
 from __future__ import annotations
@@ -31,14 +40,25 @@ class KVGroup:
     backend_name = "kv"
 
     def __init__(self, kv, world_size: int, rank: int, group_name: str,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, quantized: Optional[bool] = None,
+                 quantized_block: Optional[int] = None):
         if not (0 <= rank < world_size):
             raise ValueError(f"rank {rank} out of range [0, {world_size})")
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
         self._kv = kv                       # GcsClient (kv_put/kv_get/…)
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
         self.timeout_s = timeout_s
+        self.quantized = (GLOBAL_CONFIG.get("quantized_collectives")
+                          if quantized is None else quantized)
+        self.quantized_block = (
+            GLOBAL_CONFIG.get("quantized_collectives_block")
+            if quantized_block is None else quantized_block)
+        # measured serialized bytes published/consumed by THIS member
+        self.wire_put_bytes = 0
+        self.wire_get_bytes = 0
         self._ns = f"collective:{group_name}"
         self._seq = 0
         self._p2p_send_seq = {}
@@ -66,12 +86,33 @@ class KVGroup:
             delay = min(delay * 2, 0.05)
 
     def _put(self, key: str, arr: np.ndarray):
-        self._kv.kv_put(self._ns, key,
-                        pickle.dumps(np.asarray(arr), protocol=5),
-                        overwrite=True)
+        blob = pickle.dumps(np.asarray(arr), protocol=5)
+        self.wire_put_bytes += len(blob)
+        self._kv.kv_put(self._ns, key, blob, overwrite=True)
+
+    def _put_reduce(self, key: str, arr: np.ndarray):
+        """Data-plane put for reduce-family ops: quantized encode when the
+        group runs in quantized wire mode and the payload is float."""
+        arr = np.asarray(arr)
+        if self.quantized and np.issubdtype(arr.dtype, np.floating):
+            from ray_tpu.collective import quantization as q
+
+            blob = pickle.dumps(
+                q.encode_payload(arr, self.quantized_block), protocol=5)
+            self.wire_put_bytes += len(blob)
+            self._kv.kv_put(self._ns, key, blob, overwrite=True)
+            return
+        self._put(key, arr)
 
     def _get(self, key: str) -> np.ndarray:
-        return pickle.loads(self._wait_key(key))
+        blob = self._wait_key(key)
+        self.wire_get_bytes += len(blob)
+        value = pickle.loads(blob)
+        from ray_tpu.collective import quantization as q
+
+        if q.is_quantized_payload(value):
+            return q.decode_payload(value)
+        return value
 
     def _next(self) -> int:
         self._seq += 1
@@ -108,7 +149,7 @@ class KVGroup:
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         seq = self._next()
-        self._put(f"op:{seq}:ar:{self.rank}", tensor)
+        self._put_reduce(f"op:{seq}:ar:{self.rank}", tensor)
         reducer = getattr(np, NUMPY_REDUCERS[op])
         out = None
         for r in range(self.world_size):
@@ -119,7 +160,7 @@ class KVGroup:
     def reduce(self, tensor, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
         seq = self._next()
-        self._put(f"op:{seq}:rd:{self.rank}", tensor)
+        self._put_reduce(f"op:{seq}:rd:{self.rank}", tensor)
         if self.rank != dst_rank:
             return np.asarray(tensor)
         reducer = getattr(np, NUMPY_REDUCERS[op])

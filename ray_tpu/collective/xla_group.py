@@ -89,22 +89,128 @@ class XlaGroup:
             out_spec = P(axis)
         else:
             raise AssertionError(kind)
+        fn = jax.jit(self._shard_map(body, out_spec))
+        self._fn_cache[(kind, lax_name)] = fn
+        return fn
+
+    def _shard_map(self, body, out_spec, check_rep=True):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
         shard_map = getattr(jax, "shard_map", None)
         if shard_map is None:  # jax < 0.5
             from jax.experimental.shard_map import shard_map
-        fn = jax.jit(shard_map(body, mesh=self.mesh,
-                               in_specs=P(axis), out_specs=out_spec))
-        self._fn_cache[(kind, lax_name)] = fn
+        try:
+            return shard_map(body, mesh=self.mesh, in_specs=P(self.axis),
+                             out_specs=out_spec, check_rep=check_rep)
+        except TypeError:  # newer jax renamed/dropped check_rep
+            return shard_map(body, mesh=self.mesh, in_specs=P(self.axis),
+                             out_specs=out_spec)
+
+    # ------------------------------------------------- quantized substrate
+    def _quantization_block(self) -> int:
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.get("quantized_collectives_block")
+
+    def _use_quantized(self, tensor, op: ReduceOp) -> bool:
+        """Quantized lowering applies to float SUM reductions only; every
+        other (op, dtype) combination stays on the exact path, which also
+        remains the default (RT_quantized_collectives=0) and is untouched
+        by this routing — bit-identical results with the flag off."""
+        import numpy as _np
+
+        from ray_tpu.common.config import GLOBAL_CONFIG
+
+        if not GLOBAL_CONFIG.get("quantized_collectives"):
+            return False
+        return (op is ReduceOp.SUM
+                and _np.issubdtype(_np.asarray(tensor).dtype
+                                   if not hasattr(tensor, "dtype")
+                                   else tensor.dtype, _np.floating))
+
+    def _quantized_fn(self, kind: str, block: int):
+        """Two-phase quantized collective as ONE jitted shard_map program
+        (EQuARX: quantize -> all_to_all codes -> dequant-sum -> requant ->
+        all_gather -> dequant), built once per (kind, block) and cached —
+        jit retraces per payload shape like every op here.
+        ``check_rep=False``: all_to_all/all_gather outputs are replicated
+        by construction but shard_map's rep tracking can't prove it.
+        """
+        cached = self._fn_cache.get((kind, block))
+        if cached is not None:
+            return cached
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.collective.quantization import (
+            dequantize_blocks_jnp,
+            quantize_blocks_jnp,
+        )
+
+        axis = self.axis
+        W = self.world_size
+
+        def _phase1(rows):
+            """rows: (W, chunk) — this member's per-destination chunks.
+            Returns this member's dequantized sum chunk (cpad,)."""
+            chunk = rows.shape[1]
+            cpad = -(-chunk // block) * block
+            rows = jnp.pad(rows, ((0, 0), (0, cpad - chunk)))
+            blocks = rows.reshape(W, cpad // block, block)
+            codes, scale, lo = quantize_blocks_jnp(blocks)
+            codes = jax.lax.all_to_all(codes, axis, 0, 0, tiled=True)
+            scale = jax.lax.all_to_all(scale, axis, 0, 0, tiled=True)
+            lo = jax.lax.all_to_all(lo, axis, 0, 0, tiled=True)
+            deq = dequantize_blocks_jnp(codes, scale, lo, rows.dtype)
+            return deq.sum(axis=0).reshape(-1)  # (cpad,)
+
+        if kind == "allreduce_q":
+            def body(x):                       # per-device (1, ...)
+                v = x[0].reshape(-1)
+                n = v.shape[0]
+                chunk = -(-n // W)
+                v = jnp.pad(v, (0, W * chunk - n))
+                red = _phase1(v.reshape(W, chunk))       # my sum chunk
+                cpad = red.shape[0]
+                codes2, s2, l2 = quantize_blocks_jnp(
+                    red.reshape(cpad // block, block))
+                codes2 = jax.lax.all_gather(codes2, axis)  # (W, nb, block)
+                s2 = jax.lax.all_gather(s2, axis)
+                l2 = jax.lax.all_gather(l2, axis)
+                full = dequantize_blocks_jnp(codes2, s2, l2, v.dtype)
+                full = full.reshape(W, cpad)[:, :chunk].reshape(-1)[:n]
+                return full.reshape(x.shape[1:])
+            out_spec = P()
+        elif kind == "reducescatter_q":
+            def body(x):                       # per-device (1, W*c, ...)
+                v = x[0]
+                c = v.shape[0] // W
+                rest = v.shape[1:]
+                rows = v.reshape(W, -1)                   # (W, c*E)
+                chunk = rows.shape[1]
+                red = _phase1(rows)[:chunk]               # my sum chunk
+                return red.reshape((c,) + rest)
+            out_spec = P(axis)
+        else:
+            raise AssertionError(kind)
+        fn = jax.jit(self._shard_map(body, out_spec, check_rep=False))
+        self._fn_cache[(kind, block)] = fn
         return fn
 
     # ---------------------------------------------------------- collectives
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         """(W, ...) stacked → (...) reduced, replicated over the group."""
         self._check(tensor)
-        tensor = self._placed(tensor)
         lax_name = _REDUCE_LAX.get(op)
         if lax_name is None:
             raise ValueError(f"{op} unsupported by the xla backend")
+        if self._use_quantized(tensor, op):
+            return self._quantized_fn(
+                "allreduce_q", self._quantization_block())(
+                    self._placed(tensor))
+        tensor = self._placed(tensor)
         return self._fn("allreduce", lax_name)(tensor)
 
     def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
@@ -142,7 +248,11 @@ class XlaGroup:
                 f"axis-1 length {tensor.shape[1]} not divisible by "
                 f"world size {self.world_size}")
         tensor = self._placed(tensor)
-        flat = self._fn("reducescatter", "psum")(tensor)   # (W*c, ...)
+        if self._use_quantized(tensor, op):
+            flat = self._quantized_fn(
+                "reducescatter_q", self._quantization_block())(tensor)
+        else:
+            flat = self._fn("reducescatter", "psum")(tensor)  # (W*c, ...)
         return flat.reshape((self.world_size, -1) + tensor.shape[2:])
 
     def barrier(self):

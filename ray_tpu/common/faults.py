@@ -104,6 +104,13 @@ FAULT_POINTS: Dict[str, str] = {
     "worker.task.push": (
         "NormalTaskSubmitter, before pushing a task to a leased worker "
         "— worker crashed between lease grant and task delivery"),
+    "graph.channel.write": (
+        "ShmChannel.write, before serializing the payload into the "
+        "mutable shm segment — a compiled-pipeline hop dies mid-stream "
+        "(both stage exec loops and the driver's execute() cross it)"),
+    "graph.channel.read": (
+        "ShmChannel.read, before waiting on the segment's version bump — "
+        "the reading end of a pipeline hop dies / loses the segment"),
     "spill.write": (
         "ShmObjectStore spill engine, before writing a spill file — "
         "disk full / IO error on the spill path"),

@@ -26,6 +26,8 @@ import subprocess
 import threading
 from typing import Any, Optional
 
+from ray_tpu.common import faults
+
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "object_store", "native")
 _SO_PATH = os.path.join(_SRC_DIR, "libshm_channel.so")
@@ -98,6 +100,7 @@ class ShmChannel:
         return self._h
 
     def write(self, value: Any, timeout_s: float = 60.0) -> None:
+        faults.fault_point("graph.channel.write")
         blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
         rc = _load().rtc_write(self._handle(), blob, len(blob),
                                int(timeout_s * 1000))
@@ -109,6 +112,7 @@ class ShmChannel:
             raise OSError(-rc, os.strerror(-rc))
 
     def read(self, timeout_s: float = 60.0) -> Any:
+        faults.fault_point("graph.channel.read")
         out_len = ctypes.c_uint64()
         v = _load().rtc_read(self._handle(), self._last_version, self._buf,
                              self.capacity, ctypes.byref(out_len),

@@ -127,6 +127,7 @@ class _PipelineStage:
         def read_inputs():
             return {id(ch): ch.read(timeout_s=3600.0) for ch in distinct}
 
+        reader_exc: List[BaseException] = []
         if overlap:
             prefetch_q: "_q.Queue" = _q.Queue(maxsize=1)  # one item ahead
 
@@ -135,6 +136,13 @@ class _PipelineStage:
                     try:
                         item = read_inputs()
                     except (ChannelClosed, TimeoutError):
+                        prefetch_q.put(_END)
+                        return
+                    except BaseException as e:  # noqa: BLE001 — e.g. an
+                        # injected transport fault: end the loop AND carry
+                        # the error out (a silently dead prefetch thread
+                        # would wedge the compute loop on get() forever)
+                        reader_exc.append(e)
                         prefetch_q.put(_END)
                         return
                     prefetch_q.put(item)
@@ -197,38 +205,55 @@ class _PipelineStage:
                     return False
                 return True
 
-        while True:
-            by_ch = next_inputs()
-            if by_ch is _END:
-                break
-            args = materialize(by_ch)
-            err = next((a for a in args if isinstance(a, _StageError)), None)
-            if err is not None:
-                # propagate an upstream failure to the driver
-                if out_ch is not None and not emit(err):
+        loop_exc: List[BaseException] = []
+        try:
+            while True:
+                by_ch = next_inputs()
+                if by_ch is _END:
                     break
-                continue
+                args = materialize(by_ch)
+                err = next((a for a in args if isinstance(a, _StageError)),
+                           None)
+                if err is not None:
+                    # propagate an upstream failure to the driver
+                    if out_ch is not None and not emit(err):
+                        break
+                    continue
+                try:
+                    result = fn(*args)
+                    if collective_spec is not None:
+                        import numpy as _np
+
+                        reduced = _coll.allreduce(
+                            _np.asarray(result), group_name=group_name)
+                        if coll_op == "mean":
+                            reduced = reduced / world
+                        result = reduced
+                except Exception as e:  # noqa: BLE001 — user stage error
+                    import traceback as _tb
+
+                    result = _StageError(repr(e), _tb.format_exc())
+                # out_ch is None for a collective rank whose reduced output
+                # has no consumer: it still computes + allreduces every item
+                # (the group needs all ranks), then discards the result.
+                if out_ch is None:
+                    continue
+                if not emit(result):
+                    break
+        except BaseException as e:  # noqa: BLE001 — transport failure
+            # (e.g. an injected channel fault escaping next_inputs/emit):
+            # the loop must still CLOSE its output so downstream stages see
+            # ChannelClosed and cascade-exit instead of blocking a full
+            # read timeout against a writer that will never come back
+            loop_exc.append(e)
+        if loop_exc and out_ch is not None:
+            # close FIRST on the failure path: a writer thread stuck in a
+            # long write against live-but-slow downstream must be woken
+            # (close raises ChannelClosed in it) before we join it
             try:
-                result = fn(*args)
-                if collective_spec is not None:
-                    import numpy as _np
-
-                    reduced = _coll.allreduce(
-                        _np.asarray(result), group_name=group_name)
-                    if coll_op == "mean":
-                        reduced = reduced / world
-                    result = reduced
-            except Exception as e:  # noqa: BLE001 — user stage error
-                import traceback as _tb
-
-                result = _StageError(repr(e), _tb.format_exc())
-            # out_ch is None for a collective rank whose reduced output has
-            # no consumer: it still computes + allreduces every item (the
-            # group needs all ranks), then discards the result.
-            if out_ch is None:
-                continue
-            if not emit(result):
-                break
+                out_ch.close()
+            except Exception:  # noqa: BLE001
+                pass
         if writer is not None:
             write_q.put(_END)
             # unbounded join: the writer is itself bounded by its 3600s
@@ -240,6 +265,10 @@ class _PipelineStage:
                 out_ch.close()
         except Exception:  # noqa: BLE001
             pass
+        if loop_exc:
+            raise loop_exc[0]
+        if reader_exc:
+            raise reader_exc[0]
         if writer_exc:
             raise writer_exc[0]
         return True
@@ -493,6 +522,42 @@ class CompiledDAG:
             self._result_buf[got] = value
         raise RuntimeError(f"result {seq} already consumed")
 
+    def _check_stage_loops(self):
+        """Surface a failed stage exec loop as a typed error.
+
+        A SIGKILLed stage actor can never close its channels, so a blocked
+        driver read would otherwise ride out its full timeout; the loop
+        refs DO fail promptly (worker-death plumbing), so the sliced reads
+        poll them between slices and convert the failure into
+        :class:`PipelineStageError` within the caller's deadline."""
+        if not self._loop_refs:
+            return
+        import ray_tpu
+
+        done, _ = ray_tpu.wait(self._loop_refs,
+                               num_returns=len(self._loop_refs), timeout=0)
+        for ref in done:
+            try:
+                ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001 — actor death/loop error
+                raise PipelineStageError(
+                    f"pipeline stage exec loop failed: "
+                    f"{type(e).__name__}: {e}") from e
+
+    def _watched_read(self, ch, timeout_s: float):
+        """Channel read in short slices, checking the stage loops between
+        slices — a dead stage surfaces typed instead of hanging the read."""
+        from ray_tpu.common.retry import Deadline
+
+        deadline = Deadline(timeout_s)
+        while True:
+            try:
+                return ch.read(timeout_s=deadline.remaining(cap=0.2) or 0.0)
+            except TimeoutError:
+                if deadline.expired():
+                    raise
+                self._check_stage_loops()
+
     def _read_one_output(self, timeout_s: float):
         """One aligned read across every output channel; a single-output
         DAG returns the bare value, MultiOutputNode returns the list.
@@ -501,8 +566,8 @@ class CompiledDAG:
         every sibling channel will produce item k too (aligned FIFO), so
         the remaining reads use a generous timeout — a 0-timeout probe on
         the first channel can then never strand a partial read."""
-        values = [self._out_channels[0].read(timeout_s=timeout_s)]
-        values += [ch.read(timeout_s=max(timeout_s, 60.0))
+        values = [self._watched_read(self._out_channels[0], timeout_s)]
+        values += [self._watched_read(ch, max(timeout_s, 60.0))
                    for ch in self._out_channels[1:]]
         err = next((v for v in values if isinstance(v, _StageError)), None)
         if err is not None:
@@ -566,6 +631,9 @@ class CompiledDAG:
                     self._in_channel.write(payload, timeout_s=0.02)
                     break
                 except TimeoutError:
+                    # a dead stage can never drain the pipe: surface it
+                    # typed instead of spinning out the full deadline
+                    self._check_stage_loops()
                     if time.monotonic() > deadline:
                         raise
             seq = self._write_seq

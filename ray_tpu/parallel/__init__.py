@@ -14,6 +14,7 @@ from ray_tpu.parallel.mesh import (
     get_abstract_mesh,
     make_mesh,
     mesh_shape_for,
+    stage_device_slices,
 )
 from ray_tpu.parallel.sharding import (
     LOGICAL_AXES,
@@ -34,6 +35,7 @@ __all__ = [
     "logical_spec",
     "make_mesh",
     "mesh_shape_for",
+    "stage_device_slices",
     "shard_pytree",
     "with_logical_constraint",
 ]

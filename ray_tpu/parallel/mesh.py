@@ -116,6 +116,26 @@ def best_effort_mesh(tp: int = 1, sp: int = 1, devices=None):
     return make_mesh(MeshConfig(fsdp=-1, sp=sp, tp=tp), devices=devices)
 
 
+def stage_device_slices(n_stages: int, devices: Optional[Sequence] = None):
+    """Partition ``devices`` into ``n_stages`` contiguous equal slices —
+    the per-stage device placement for MPMD pipeline parallelism
+    (train/pipeline.py).  Contiguity matters on real hardware: the pp
+    axis is outermost in :data:`AXIS_ORDER`, so a contiguous slice of the
+    device list is an ICI-local neighborhood and the only inter-slice
+    traffic is the stage boundary activation/grad hop."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if len(devices) % n_stages:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_stages} equal "
+            f"stage slices")
+    per = len(devices) // n_stages
+    return [devices[i * per:(i + 1) * per] for i in range(n_stages)]
+
+
 def get_abstract_mesh(n_devices: int, config: Optional[MeshConfig] = None,
                       axis_names: Sequence[str] = AXIS_ORDER):
     """An AbstractMesh for shape/sharding reasoning without real devices."""

@@ -14,6 +14,8 @@ from ray_tpu.train.checkpoint import (  # noqa: F401
     CheckpointManager,
 )
 from ray_tpu.train.collectives import (  # noqa: F401
+    FlatOptimizer,
+    ZeroShardedOptimizer,
     barrier,
     broadcast_from_rank_zero,
 )
@@ -23,6 +25,11 @@ from ray_tpu.train.context import (  # noqa: F401
     get_context,
     get_dataset_shard,
     report,
+)
+from ray_tpu.train.pipeline import (  # noqa: F401
+    PipelineRunner,
+    PipelineSpec,
+    StageSpec,
 )
 from ray_tpu.train.scaling_policy import (  # noqa: F401
     ElasticScalingPolicy,

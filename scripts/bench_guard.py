@@ -60,6 +60,9 @@ GUARDED_ROWS = (
     "multi_client_tasks_async",
     "actors_per_second",
     "tasks_per_second_10k_pending",
+    # round-16 (ISSUE 16): per-item cost of a channel-compiled actor
+    # chain vs dynamic dispatch — the substrate the pipeline rides on.
+    "compiled_actor_calls_per_second",
 )
 
 # The round-11 Serve data-plane rows (ISSUE 9 acceptance): proxy RPS and
@@ -93,6 +96,15 @@ GUARDED_MULTINODE_ROWS = (
 # (``python bench_data.py --tcp``); committed in BENCH_data.json.
 GUARDED_DATA_TCP_ROWS = (
     "groupby_shuffle_tcp_gb_per_min",
+)
+
+# The round-16 train-plane row (ISSUE 16 acceptance): MPMD pipeline
+# stepping throughput — 1F1B microbatch schedule over shm channels with
+# zero per-microbatch driver involvement (``python bench_train.py --out
+# <dir>/BENCH_train.json``); committed in BENCH_train.json, which shares
+# BENCH_core.json's shape.
+GUARDED_TRAIN_ROWS = (
+    "pipeline_steps_per_second",
 )
 
 
@@ -259,6 +271,15 @@ def main(argv=None) -> int:
                         "under test (python bench_data.py --tcp); row "
                         "diffs against — and captures into — the "
                         "committed BENCH_data.json")
+    p.add_argument("--fresh-train",
+                   help="BENCH_train.json from the run under test "
+                        "(python bench_train.py --out <dir>/...); the "
+                        "pipeline stepping row diffs against — and "
+                        "captures into — the committed BENCH_train.json")
+    p.add_argument("--checked-in-train",
+                   default=os.path.join(repo_root, "BENCH_train.json"),
+                   help="committed train reference (default: repo "
+                        "BENCH_train.json)")
     p.add_argument("--threshold", type=float, default=0.15,
                    help="max tolerated fractional regression (default 0.15)")
     p.add_argument("--capture", action="store_true",
@@ -268,9 +289,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if not (args.fresh or args.fresh_serve or args.fresh_data
-            or args.fresh_multinode or args.fresh_data_tcp):
+            or args.fresh_multinode or args.fresh_data_tcp
+            or args.fresh_train):
         print("bench_guard: pass --fresh, --fresh-serve, --fresh-data, "
-              "--fresh-multinode and/or --fresh-data-tcp", file=sys.stderr)
+              "--fresh-multinode, --fresh-data-tcp and/or --fresh-train",
+              file=sys.stderr)
         return 2
     legs = []  # (label, fresh_rows, ref_rows, guarded, capture_fn)
     if args.fresh:
@@ -346,6 +369,22 @@ def main(argv=None) -> int:
                      GUARDED_DATA_TCP_ROWS,
                      lambda r: _capture_core(args.fresh_data_tcp,
                                              args.checked_in_data, r)))
+
+    if args.fresh_train:
+        if not os.path.exists(args.fresh_train):
+            print(f"bench_guard: missing {args.fresh_train}",
+                  file=sys.stderr)
+            return 2
+        ref = _core_rows(args.checked_in_train) \
+            if os.path.exists(args.checked_in_train) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in_train}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("train", _core_rows(args.fresh_train), ref,
+                     GUARDED_TRAIN_ROWS,
+                     lambda r: _capture_core(args.fresh_train,
+                                             args.checked_in_train, r)))
 
     if args.capture:
         for label, fresh, _ref, guarded, _cap in legs:

@@ -79,6 +79,76 @@ PYEOF
     echo "chaos gate: FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
     fail=$((fail+1))
   fi
+  # Pipeline leg: one MPMD pipelined training step with the channel
+  # fault points armed — the injected ConnectionError fires on stage
+  # 0's first shm-channel READ (in the actor process, not the driver)
+  # and must surface to the driver as a TYPED PipelineStageError well
+  # inside the step deadline, never a hang (ISSUE 16 resilience bar).
+  echo "chaos gate: pipelined step under injected channel faults..." \
+    | tee -a "$RUN_LOG"
+  if timeout 300 env JAX_PLATFORMS=cpu \
+      RT_FAULTS="graph.channel.read=once" \
+      python - >> "$RUN_LOG" 2>&1 <<'PYEOF'
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.common import faults
+from ray_tpu.graph.compiled import PipelineStageError
+from ray_tpu.train import PipelineRunner, PipelineSpec, StageSpec
+
+assert "graph.channel.read" in faults.active_points(), \
+    "RT_FAULTS did not arm the channel fault point at import"
+ray_tpu.init(num_cpus=4, num_tpus=0)
+
+
+def make_stage():
+    import jax
+    import jax.numpy as jnp
+
+    def init(rng):
+        return {"w": jax.random.normal(rng, (4, 4)) * 0.1}
+
+    def apply(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    return StageSpec(init=init, apply=apply)
+
+
+def make_loss():
+    import jax.numpy as jnp
+
+    def loss(y_pred, y):
+        return jnp.mean((y_pred - y) ** 2)
+
+    return loss
+
+
+spec = PipelineSpec(stages=[make_stage(), make_stage()],
+                    loss=make_loss(), num_microbatches=4)
+runner = PipelineRunner(spec)
+xs = [np.zeros((2, 4), np.float32) for _ in range(4)]
+ys = [np.zeros((2, 4), np.float32) for _ in range(4)]
+t0 = time.monotonic()
+try:
+    runner.step(xs, ys, timeout_s=60)
+    raise SystemExit("pipelined step ignored the injected channel fault")
+except (PipelineStageError, ConnectionError) as e:
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60, f"typed error took {elapsed:.1f}s (deadline 60s)"
+    print(f"chaos gate(pipeline): typed {type(e).__name__} "
+          f"in {elapsed:.2f}s through graph.channel.read fault")
+finally:
+    runner.shutdown()
+ray_tpu.shutdown()
+PYEOF
+  then
+    echo "chaos gate(pipeline): ok" | tee -a "$RUN_LOG"
+  else
+    echo "chaos gate(pipeline): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
 fi
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
@@ -121,12 +191,13 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
 fi
 # Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench,
 # the Serve data-plane bench, the GB-scale data shuffle bench, the
-# 2-node object-plane bench, and the shuffle-over-TCP bench fresh and
-# diff the guarded rows (round-8 core targets + round-11 proxy rows +
-# round-12 groupby shuffle row + round-13 multi-node rows) against the
-# committed BENCH_core.json / BENCH_serve.json / BENCH_data.json (>15%
-# same-box regression fails the run). Off by default — the benches need
-# minutes and quiet CPUs.
+# 2-node object-plane bench, the shuffle-over-TCP bench, and the
+# train-plane bench fresh and diff the guarded rows (round-8 core
+# targets + round-11 proxy rows + round-12 groupby shuffle row +
+# round-13 multi-node rows + round-16 compiled-chain and pipeline rows)
+# against the committed BENCH_core.json / BENCH_serve.json /
+# BENCH_data.json / BENCH_train.json (>15% same-box regression fails
+# the run). Off by default — the benches need minutes and quiet CPUs.
 if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
   echo "bench guard: running bench_core.py (this takes minutes)..." \
     | tee -a "$RUN_LOG"
@@ -171,6 +242,16 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
            "(log: $BG_DIR/bench_data_tcp.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
+    echo "bench guard: running bench_train.py (pipeline + quantized wire)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          env JAX_PLATFORMS=cpu python "$OLDPWD/bench_train.py" \
+          --out "$BG_DIR/BENCH_train.json" > bench_train.log 2>&1)
+    then
+      echo "bench guard: train bench run failed" \
+           "(log: $BG_DIR/bench_train.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
     # subshell pipefail: the verdict must be bench_guard's exit status,
     # not tee's
     SERVE_ARGS=()
@@ -185,9 +266,13 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
     DATA_TCP_ARGS=()
     [[ -f "$BG_DIR/BENCH_data_tcp.json" ]] && \
       DATA_TCP_ARGS=(--fresh-data-tcp "$BG_DIR/BENCH_data_tcp.json")
+    TRAIN_ARGS=()
+    [[ -f "$BG_DIR/BENCH_train.json" ]] && \
+      TRAIN_ARGS=(--fresh-train "$BG_DIR/BENCH_train.json")
     if (set -o pipefail; python scripts/bench_guard.py \
         --fresh "$BG_DIR/BENCH_core.json" "${SERVE_ARGS[@]}" \
         "${DATA_ARGS[@]}" "${MULTINODE_ARGS[@]}" "${DATA_TCP_ARGS[@]}" \
+        "${TRAIN_ARGS[@]}" \
         | tee -a "$RUN_LOG"); then
       echo "bench guard: ok" | tee -a "$RUN_LOG"
     else

@@ -190,3 +190,30 @@ def test_prefetch_overlaps_transfer_with_compute(rt):
         assert dt < 0.55, f"no overlap: {dt:.3f}s for 10 items"
     finally:
         compiled.teardown()
+
+
+def test_stage_death_surfaces_typed_within_deadline(rt):
+    """A SIGKILLed stage actor can never close its channels; the driver's
+    sliced reads poll the loop refs and surface PipelineStageError well
+    inside the caller's timeout instead of hanging execute()/get()."""
+    import time
+
+    from ray_tpu.graph import InputNode
+    from ray_tpu.graph.compiled import PipelineStageError
+
+    with InputNode() as inp:
+        a = Arith.bind(1).add.bind(inp)
+        dag = Arith.bind(2).add.bind(a)
+    compiled = dag.experimental_compile(channels=True)
+    try:
+        assert compiled.execute(0).get(timeout_s=60) == 3  # warm loops
+        ray_tpu.kill(compiled._owned_actors[0])
+        t0 = time.monotonic()
+        with pytest.raises(PipelineStageError):
+            # the kill may land while the driver still has channel credit,
+            # so drive a few items — the first blocked read must fail typed
+            for i in range(8):
+                compiled.execute(i).get(timeout_s=30)
+        assert time.monotonic() - t0 < 15.0
+    finally:
+        compiled.teardown()

@@ -1,0 +1,240 @@
+"""Quantized collective substrate: block-wise int8 kernels, the XLA
+two-phase quantized allreduce/reducescatter vs the exact path (analytic
+error bounds), and the KVGroup quantized wire (measured bytes-on-wire
+reduction).  Exact path stays the default — flag off must be untouched."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.collective import quantization as q
+from ray_tpu.collective.types import ReduceOp
+from ray_tpu.common.config import GLOBAL_CONFIG
+
+
+@pytest.fixture
+def quantized_on():
+    GLOBAL_CONFIG.set_system_config_value("quantized_collectives", True)
+    yield
+    GLOBAL_CONFIG.set_system_config_value("quantized_collectives", False)
+
+
+# ---------------------------------------------------------------- kernels
+class TestQuantizationKernels:
+    @pytest.mark.parametrize("n", [1, 7, 77, 256, 513, 4096])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_roundtrip_error_bound(self, n, dtype):
+        rng = np.random.RandomState(n)
+        arr = (rng.randn(n) * 3).astype(dtype)
+        codes, scale, offset = q.quantize_blocks_np(arr, 256)
+        back = q.dequantize_blocks_np(codes, scale, offset, n)
+        # per-element error <= scale/2 of the element's block
+        bound = np.repeat(scale / 2, 256)[:n]
+        assert np.all(np.abs(back - arr) <= bound + 1e-12)
+
+    def test_constant_block_exact(self):
+        arr = np.full(300, 2.5, np.float32)  # ptp == 0 -> scale 1, codes 0
+        codes, scale, offset = q.quantize_blocks_np(arr, 256)
+        back = q.dequantize_blocks_np(codes, scale, offset, 300)
+        np.testing.assert_array_equal(back, arr)
+
+    def test_wire_bytes_formula(self):
+        n, itemsize = 1 << 20, 4
+        exact = q.wire_bytes(n, itemsize, 256, quantized=False)
+        quant = q.wire_bytes(n, itemsize, 256, quantized=True)
+        assert exact == n * itemsize
+        # codes are 1 byte/elt + 2 floats per 256-block of overhead
+        assert quant == n + (n // 256) * 2 * itemsize
+        assert exact / quant > 3.0
+
+    def test_simulated_allreduce_within_bound(self):
+        rng = np.random.RandomState(0)
+        members = [(rng.randn(1000) * (i + 1)).astype(np.float32)
+                   for i in range(4)]
+        got = q.simulate_quantized_allreduce_np(members, 256)
+        exact = np.sum(members, axis=0)
+        bound = q.allreduce_error_bound(members, 256)
+        assert np.all(np.abs(got - exact) <= bound + 1e-6)
+
+    def test_payload_codec_roundtrip(self):
+        rng = np.random.RandomState(1)
+        arr = rng.randn(3, 77).astype(np.float32)
+        msg = q.encode_payload(arr, 256)
+        assert q.is_quantized_payload(msg)
+        back = q.decode_payload(msg)
+        assert back.shape == arr.shape and back.dtype == arr.dtype
+        assert np.abs(back - arr).max() <= np.ptp(arr) / 255 / 2 + 1e-6
+
+
+# ------------------------------------------------------- XLA quantized ops
+class TestXlaQuantized:
+    def _group(self, world=8):
+        from ray_tpu.collective.xla_group import XlaGroup
+
+        return XlaGroup(world_size=world)
+
+    @pytest.mark.parametrize("n", [77, 513, 4096])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_allreduce_quant_vs_exact(self, quantized_on, n, dtype):
+        g = self._group()
+        rng = np.random.RandomState(n)
+        stacked = (rng.randn(8, n) * 2).astype(dtype)
+        got = np.asarray(g.allreduce(stacked))
+        exact = stacked.sum(axis=0)
+        bound = q.allreduce_error_bound(list(stacked), 256)
+        err = np.abs(got.astype(np.float64) - exact.astype(np.float64))
+        assert err.max() <= bound.max() + 1e-5
+        assert err.max() > 0 or n < 8  # quantized path actually engaged
+
+    def test_exact_is_default(self):
+        assert GLOBAL_CONFIG.get("quantized_collectives") is False
+        g = self._group()
+        stacked = np.random.RandomState(0).randn(8, 513).astype(np.float32)
+        got = np.asarray(g.allreduce(stacked))
+        # flag off -> the untouched psum path: exact to float addition
+        np.testing.assert_allclose(got, stacked.sum(axis=0), rtol=1e-6)
+
+    def test_non_sum_falls_back_exact(self, quantized_on):
+        g = self._group()
+        stacked = np.random.RandomState(2).randn(8, 64).astype(np.float32)
+        got = np.asarray(g.allreduce(stacked, ReduceOp.MAX))
+        np.testing.assert_allclose(got, stacked.max(axis=0), rtol=1e-6)
+
+    def test_int_falls_back_exact(self, quantized_on):
+        g = self._group()
+        stacked = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+        got = np.asarray(g.allreduce(stacked))
+        np.testing.assert_array_equal(got, stacked.sum(axis=0))
+
+    def test_reducescatter_quant_vs_exact(self, quantized_on):
+        g = self._group()
+        rng = np.random.RandomState(5)
+        stacked = (rng.randn(8, 16, 5) * 3).astype(np.float32)
+        got = np.asarray(g.reducescatter(stacked))
+        assert got.shape == (8, 2, 5)
+        exact = stacked.sum(axis=0).reshape(8, 2, 5)
+        # single-phase bound: member m's contribution to output row k is
+        # quantized with scale = ptp(row)/255 -> error <= scale/2 each
+        rows = stacked.reshape(8, 8, -1)  # member, dest, payload
+        bound = sum(np.ptp(rows[m], axis=1) / 255 / 2 for m in range(8))
+        err = np.abs(got - exact).reshape(8, -1).max(axis=1)
+        assert np.all(err <= bound + 1e-5)
+
+
+# ------------------------------------------------------ KV quantized wire
+class _FakeKV:
+    """In-process stand-in for the GCS KV client (thread-shared dict)."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _k(ns, key):
+        key = key.decode() if isinstance(key, bytes) else key
+        return (ns, key)
+
+    def kv_put(self, ns, key, val, overwrite=True):
+        with self._lock:
+            self._d[self._k(ns, key)] = val
+
+    def kv_get(self, ns, key):
+        with self._lock:
+            return self._d.get(self._k(ns, key))
+
+    def kv_keys(self, ns, prefix=b""):
+        prefix = prefix.decode() if isinstance(prefix, bytes) else prefix
+        with self._lock:
+            return [k.encode() for (n, k) in self._d if n == ns
+                    and k.startswith(prefix)]
+
+    def kv_del(self, ns, key):
+        with self._lock:
+            self._d.pop(self._k(ns, key), None)
+
+
+def _run_members(world, fn):
+    """Run fn(rank) in `world` threads; return results, re-raise errors."""
+    results, errors = [None] * world, []
+
+    def runner(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    if errors:
+        raise errors[0]
+    return results
+
+
+class TestKVQuantizedWire:
+    def _allreduce_groups(self, quantized, payload):
+        from ray_tpu.collective.kv_group import KVGroup
+
+        kv = _FakeKV()
+        groups = {}
+
+        def member(rank):
+            g = KVGroup(kv, 2, rank, "g", quantized=quantized)
+            groups[rank] = g
+            return np.asarray(g.allreduce(payload[rank].copy()))
+
+        outs = _run_members(2, member)
+        return outs, groups
+
+    def test_parity_and_wire_reduction(self):
+        rng = np.random.RandomState(9)
+        payload = [(rng.randn(1 << 18) * 2).astype(np.float32)
+                   for _ in range(2)]
+        exact_out, exact_g = self._allreduce_groups(False, payload)
+        quant_out, quant_g = self._allreduce_groups(True, payload)
+        exact = payload[0] + payload[1]
+        np.testing.assert_allclose(exact_out[0], exact, rtol=1e-6)
+        bound = q.allreduce_error_bound(payload, 256)
+        for out in quant_out:
+            assert np.all(np.abs(out - exact) <= bound + 1e-5)
+        # measured (not computed) serialized bytes: >= 3x reduction
+        eb = exact_g[0].wire_put_bytes
+        qb = quant_g[0].wire_put_bytes
+        assert eb / qb >= 3.0, (eb, qb)
+
+    def test_broadcast_stays_exact(self):
+        from ray_tpu.collective.kv_group import KVGroup
+
+        kv = _FakeKV()
+        src = np.random.RandomState(3).randn(1000).astype(np.float32)
+
+        def member(rank):
+            g = KVGroup(kv, 2, rank, "b", quantized=True)
+            return np.asarray(g.broadcast(
+                src if rank == 0 else np.zeros_like(src), src_rank=0))
+
+        outs = _run_members(2, member)
+        np.testing.assert_array_equal(outs[0], src)
+        np.testing.assert_array_equal(outs[1], src)
+
+    def test_reducescatter_quantized_parity(self):
+        from ray_tpu.collective.kv_group import KVGroup
+
+        rng = np.random.RandomState(11)
+        payload = [(rng.randn(512) * 2).astype(np.float32)
+                   for _ in range(2)]
+
+        def member(rank):
+            g = KVGroup(kv, 2, rank, "rs", quantized=True)
+            return np.asarray(g.reducescatter(payload[rank].copy()))
+
+        kv = _FakeKV()
+        outs = _run_members(2, member)
+        exact = payload[0] + payload[1]
+        bound = q.allreduce_error_bound(payload, 256)
+        assert np.all(np.abs(outs[0] - exact[:256]) <= bound[:256] + 1e-5)
+        assert np.all(np.abs(outs[1] - exact[256:]) <= bound[256:] + 1e-5)

@@ -1,0 +1,314 @@
+"""MPMD pipeline-parallel training: 1F1B numerics parity vs a
+single-process SPMD reference, data-parallel + ZeRO folds, typed failure
+contracts (stage death / injected channel faults), and the JaxTrainer
+pipeline mode."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.common import faults
+from ray_tpu.graph.compiled import PipelineStageError
+from ray_tpu.parallel import stage_device_slices
+from ray_tpu.train.collectives import FlatOptimizer, ZeroShardedOptimizer
+from ray_tpu.train.pipeline import PipelineRunner, PipelineSpec, StageSpec
+
+from test_quantized_collective import _FakeKV, _run_members
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------- model helpers
+D_IN, D_H, D_OUT = 4, 8, 2
+
+
+def _make_stage(d_in, d_out, is_last, name):
+    def init(rng):
+        return {"w": jax.random.normal(rng, (d_in, d_out)) * 0.3,
+                "b": jnp.zeros((d_out,))}
+
+    def apply(p, x):
+        h = x @ p["w"] + p["b"]
+        return h if is_last else jnp.tanh(h)
+
+    return StageSpec(init, apply, name=name)
+
+
+def _make_loss():
+    # closure (not a module-level def): cloudpickle ships it BY VALUE, so
+    # stage actor processes never need to import this test module
+    def loss(pred, y):
+        return jnp.mean((pred - y) ** 2)
+
+    return loss
+
+
+_loss = _make_loss()
+
+
+def _stages(n):
+    """n chained dense layers (tanh between, linear last): dims
+    D_IN -> D_H x (n-1) -> D_OUT."""
+    dims = [D_IN] + [D_H] * (n - 1) + [D_OUT]
+    return [_make_stage(dims[i], dims[i + 1], i == n - 1, f"s{i}")
+            for i in range(n)]
+
+
+def _data(rng, count):
+    return [(rng.randn(8, D_IN).astype(np.float32),
+             rng.randn(8, D_OUT).astype(np.float32)) for _ in range(count)]
+
+
+def _reference(stages, data, n_micro, steps, kind, lr, seed=0):
+    """Single-process reference: microbatch-accumulated grads + the same
+    FlatOptimizer over the flat parameter vector."""
+    from jax.flatten_util import ravel_pytree
+
+    params = tuple(
+        jax.tree_util.tree_map(
+            np.asarray, s.init(jax.random.PRNGKey(seed + i)))
+        for i, s in enumerate(stages))
+
+    def full_loss(ps, x, y):
+        h = x
+        for i, s in enumerate(stages):
+            h = s.apply(ps[i], h)
+        return _loss(h, y)
+
+    vg = jax.jit(jax.value_and_grad(full_loss))
+    opt = FlatOptimizer(kind=kind, lr=lr)
+    state, losses = None, []
+    for s in range(steps):
+        gacc, lacc = None, 0.0
+        for m in range(n_micro):
+            x, y = data[s * n_micro + m]
+            l, g = vg(params, x, y)
+            lacc += float(l)
+            gacc = g if gacc is None else jax.tree_util.tree_map(
+                lambda a, b: a + b, gacc, g)
+        grads = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) / n_micro, gacc)
+        pflat, unravel = ravel_pytree(params)
+        gflat = np.asarray(ravel_pytree(grads)[0])
+        if state is None:
+            state = opt.init_state(np.asarray(pflat).size)
+        params = jax.tree_util.tree_map(
+            np.asarray, unravel(opt.update(np.asarray(pflat), gflat, state)))
+        losses.append(lacc / n_micro)
+    return losses, params
+
+
+def _run_pipeline(stages, data, spec_kw, steps):
+    spec = PipelineSpec(stages=stages, loss=_loss, **spec_kw)
+    M, R = spec.num_microbatches, spec.data_parallel
+    runner = PipelineRunner(spec)
+    losses = []
+    try:
+        for s in range(steps):
+            chunk = data[s * M * R:(s + 1) * M * R]
+            losses.append(runner.step([c[0] for c in chunk],
+                                      [c[1] for c in chunk])["loss"])
+        final = runner.finish()
+    finally:
+        runner.shutdown()
+    return losses, tuple(final)
+
+
+def _flat(params):
+    from jax.flatten_util import ravel_pytree
+
+    return np.asarray(ravel_pytree(params)[0])
+
+
+# ----------------------------------------------------------------- parity
+class TestPipelineParity:
+    def test_two_stage_matches_spmd_reference(self, rt):
+        """The acceptance bar: pipelined loss AND gradients (observed
+        through the updated params) match the single-stage SPMD reference
+        within rtol."""
+        stages = _stages(2)
+        data = _data(np.random.RandomState(7), 4 * 4)
+        kw = dict(num_microbatches=4, optimizer="sgd", learning_rate=0.05)
+        ref_l, ref_p = _reference(stages, data, 4, 4, "sgd", 0.05)
+        pipe_l, pipe_p = _run_pipeline(stages, data, kw, 4)
+        np.testing.assert_allclose(pipe_l, ref_l, rtol=1e-5)
+        np.testing.assert_allclose(_flat(pipe_p), _flat(ref_p),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_three_stage_momentum(self, rt):
+        stages = _stages(3)
+        data = _data(np.random.RandomState(3), 6 * 2)
+        kw = dict(num_microbatches=6, optimizer="momentum",
+                  learning_rate=0.05)
+        ref_l, ref_p = _reference(stages, data, 6, 2, "momentum", 0.05)
+        pipe_l, pipe_p = _run_pipeline(stages, data, kw, 2)
+        np.testing.assert_allclose(pipe_l, ref_l, rtol=1e-5)
+        np.testing.assert_allclose(_flat(pipe_p), _flat(ref_p),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_data_parallel_allreduce_fold(self, rt):
+        """R=2 pipeline == reference over the union of both replicas'
+        microbatches (the dp allreduce averages the replica grads)."""
+        stages = _stages(2)
+        data = _data(np.random.RandomState(5), 3 * 2 * 2)
+        kw = dict(num_microbatches=3, data_parallel=2, optimizer="sgd",
+                  learning_rate=0.05)
+        ref_l, ref_p = _reference(stages, data, 6, 2, "sgd", 0.05)
+        pipe_l, pipe_p = _run_pipeline(stages, data, kw, 2)
+        np.testing.assert_allclose(pipe_l, ref_l, rtol=1e-5)
+        np.testing.assert_allclose(_flat(pipe_p), _flat(ref_p),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_zero_sharded_pipeline(self, rt):
+        """ZeRO fold (reducescatter -> shard update -> allgather) matches
+        the replicated-adam reference."""
+        stages = _stages(2)
+        data = _data(np.random.RandomState(8), 3 * 2 * 2)
+        kw = dict(num_microbatches=3, data_parallel=2,
+                  zero_sharded_state=True, optimizer="adam",
+                  learning_rate=0.01)
+        ref_l, ref_p = _reference(stages, data, 6, 2, "adam", 0.01)
+        pipe_l, pipe_p = _run_pipeline(stages, data, kw, 2)
+        np.testing.assert_allclose(pipe_l, ref_l, rtol=1e-5)
+        np.testing.assert_allclose(_flat(pipe_p), _flat(ref_p),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------- ZeRO round-trip (KV)
+class TestZeroShardedRoundTrip:
+    @pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+    def test_matches_replicated_update(self, kind):
+        """Sharded step == replicated full-vector step, bit-exact, with
+        per-member optimizer state 1/W the size."""
+        from ray_tpu.collective.kv_group import KVGroup
+
+        W, n, steps = 2, 1003, 3
+        rng = np.random.RandomState(0)
+        params0 = rng.randn(n).astype(np.float32)
+        grads = [[rng.randn(n).astype(np.float32) for _ in range(W)]
+                 for _ in range(steps)]
+        kv = _FakeKV()
+        zeros = {}
+
+        def member(rank):
+            g = KVGroup(kv, W, rank, f"z_{kind}")
+            zero = ZeroShardedOptimizer(g, FlatOptimizer(kind=kind, lr=0.05))
+            zeros[rank] = zero
+            p = params0.copy()
+            for s in range(steps):
+                p = zero.step(p, grads[s][rank], average=True)
+            return p
+
+        outs = _run_members(W, member)
+
+        # replicated reference on the PADDED vector (state dims match)
+        npad = -(-n // W) * W
+        opt = FlatOptimizer(kind=kind, lr=0.05)
+        state = opt.init_state(npad)
+        ref = np.pad(params0, (0, npad - n))
+        for s in range(steps):
+            gsum = np.pad(sum(grads[s]), (0, npad - n)) / W
+            ref = opt.update(ref, gsum, state)
+        for out in outs:
+            np.testing.assert_array_equal(out, ref[:n])
+        # state really is sharded: 1/W-sized moment vectors
+        if kind != "sgd":
+            assert zeros[0].state["m"].size == npad // W
+
+
+# ----------------------------------------------------------- failure modes
+class TestPipelineFailures:
+    def _spec(self):
+        return PipelineSpec(stages=_stages(2), loss=_loss,
+                            num_microbatches=4, learning_rate=0.05)
+
+    def test_stage_death_surfaces_typed_within_deadline(self, rt):
+        """SIGKILLed stage mid-pipeline -> PipelineStageError from step()
+        well within the caller's deadline; never a hung channel wait."""
+        runner = PipelineRunner(self._spec())
+        data = _data(np.random.RandomState(0), 4)
+        xs, ys = [d[0] for d in data], [d[1] for d in data]
+        assert runner.step(xs, ys)["step"] == 1
+        ray_tpu.kill(runner._actors[1])
+        t0 = time.monotonic()
+        with pytest.raises(PipelineStageError):
+            runner.step(xs, ys, timeout_s=30.0)
+        assert time.monotonic() - t0 < 15.0
+        runner.shutdown()  # idempotent after the error path's teardown
+
+    def test_injected_channel_fault_is_typed(self, rt):
+        """graph.channel.write armed in the driver: the feed write raises
+        the typed ConnectionError subclass instead of wedging."""
+        runner = PipelineRunner(self._spec())
+        data = _data(np.random.RandomState(1), 4)
+        xs, ys = [d[0] for d in data], [d[1] for d in data]
+        assert runner.step(xs, ys)["step"] == 1
+        faults.inject("graph.channel.write", "once")
+        try:
+            with pytest.raises(ConnectionError):
+                runner.step(xs, ys)
+        finally:
+            faults.clear()
+            runner.shutdown()
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            PipelineSpec(stages=[], loss=_loss)
+        with pytest.raises(ValueError):
+            PipelineSpec(stages=_stages(2), loss=_loss, num_microbatches=0)
+        with pytest.raises(ValueError):
+            PipelineSpec(stages=_stages(2), loss=_loss,
+                         zero_sharded_state=True)  # needs dp >= 2
+
+
+# --------------------------------------------------------- placement + API
+class TestStagePlacement:
+    def test_stage_device_slices(self):
+        devs = [f"d{i}" for i in range(8)]
+        slices = stage_device_slices(4, devs)
+        assert slices == [["d0", "d1"], ["d2", "d3"],
+                          ["d4", "d5"], ["d6", "d7"]]
+        with pytest.raises(ValueError):
+            stage_device_slices(3, devs)
+        with pytest.raises(ValueError):
+            stage_device_slices(0, devs)
+
+
+class TestJaxTrainerPipelineMode:
+    def test_fit_pipeline(self, rt, tmp_path):
+        from ray_tpu.train import JaxTrainer, RunConfig
+
+        rng = np.random.RandomState(2)
+
+        def data_fn(step):
+            d = _data(rng, 4)
+            return [x for x, _ in d], [y for _, y in d]
+
+        spec = PipelineSpec(stages=_stages(2), loss=_loss,
+                            num_microbatches=4, num_steps=3,
+                            data_fn=data_fn, learning_rate=0.05)
+        res = JaxTrainer(pipeline_spec=spec, run_config=RunConfig(
+            name="pipe", storage_path=str(tmp_path))).fit(timeout_s=120)
+        assert res.metrics["step"] == 3
+        assert np.isfinite(res.metrics["loss"])
+        assert len(res.metrics["stage_params"]) == 2
+
+    def test_requires_exactly_one_mode(self):
+        from ray_tpu.train import JaxTrainer
+
+        with pytest.raises(ValueError):
+            JaxTrainer()
+        with pytest.raises(ValueError):
+            JaxTrainer(lambda: None,
+                       pipeline_spec=PipelineSpec(
+                           stages=_stages(2), loss=_loss))
