@@ -111,6 +111,14 @@ FAULT_POINTS: Dict[str, str] = {
     "graph.channel.read": (
         "ShmChannel.read, before waiting on the segment's version bump — "
         "the reading end of a pipeline hop dies / loses the segment"),
+    "rl.fragment.push": (
+        "Podracer Sebulba runner, after sealing a fragment batch and "
+        "before pushing its ref into the runner's fragment channel — "
+        "the handoff dies; the runner counts the drop and keeps acting"),
+    "rl.params.broadcast": (
+        "Podracer Sebulba learner, before writing a weights broadcast "
+        "to one runner's param channel — that runner misses the version "
+        "(policy lag grows) and catches up on the next broadcast"),
     "spill.write": (
         "ShmObjectStore spill engine, before writing a spill file — "
         "disk full / IO error on the spill path"),

@@ -245,6 +245,10 @@ class CoreWorker:
         self._actor_has_async = False
         self._async_call_sem: Optional[asyncio.Semaphore] = None
         self._fetch_inflight: Dict[ObjectID, asyncio.Future] = {}
+        # owners our raylet confirmed dead: later fetches of their objects
+        # skip the reconnect budget entirely (one liveness RPC per owner,
+        # not one per object)
+        self._dead_owners: set = set()
         # multi-node object plane (object_store/transfer.py): coalesced
         # owner→GCS location reporting plus an in-process locality cache
         # ({oid bytes: {"node_id", "size"}}) that feeds the submitter's
@@ -731,6 +735,36 @@ class CoreWorker:
 
         self._io.spawn_threadsafe(fetch())
 
+    def _owner_dead_check(self, ref: ObjectRef):
+        """``abort_check`` for owner-fetch retries: after a connection
+        failure, ask the local raylet whether the owner worker is a
+        process it reaped — a SIGKILLed owner then fails the fetch in one
+        local round trip instead of the full reconnect budget.  "Unknown"
+        (foreign-node or driver owner, raylet unreachable) keeps the
+        patient retry path."""
+        async def check(_exc) -> bool:
+            wid = ref.owner_id
+            if wid is None or wid == self.worker_id \
+                    or not self.raylet_address:
+                return False
+            key = wid.binary()
+            if key in self._dead_owners:
+                return True
+            try:
+                probe = RpcClient(self.raylet_address)
+                try:
+                    r = await probe.call_async(
+                        "worker_alive", worker_id=key, timeout=5.0)
+                finally:
+                    probe.close()
+            except Exception:  # noqa: BLE001 — raylet unreachable
+                return False
+            if r.get("known") and not r.get("alive"):
+                self._dead_owners.add(key)
+                return True
+            return False
+        return check
+
     async def _fetch_async(self, ref: ObjectRef, allow_reconstruct: bool = True) -> bytes:
         """Ask the owner for value-or-location; chase the location; on holder
         death ask the owner to reconstruct from lineage."""
@@ -748,10 +782,25 @@ class CoreWorker:
             None, self._transfer_pull_blocking, ref.object_id)
         if blob is not None:
             return blob
-        owner = RetryableRpcClient(ref.owner_address, deadline_s=30.0)
+        if (ref.owner_id is not None
+                and ref.owner_id.binary() in self._dead_owners):
+            raise ObjectLostError(ref.object_id, "owner worker died")
+        owner = RetryableRpcClient(
+            ref.owner_address, deadline_s=30.0,
+            abort_check=self._owner_dead_check(ref))
         try:
-            reply = await owner.call_async(
-                "get_object", object_id=ref.object_id.binary(), timeout=None)
+            try:
+                reply = await owner.call_async(
+                    "get_object", object_id=ref.object_id.binary(),
+                    timeout=None)
+            except Exception as e:  # noqa: BLE001 — owner unreachable
+                if (ref.owner_id is not None
+                        and ref.owner_id.binary() in self._dead_owners):
+                    # the abort_check confirmed death mid-retry: surface it
+                    # typed instead of as a generic connection failure
+                    raise ObjectLostError(
+                        ref.object_id, f"owner worker died: {e}") from e
+                raise
             if reply.get("error") is not None:
                 return _RemoteError(reply["error"])
             if reply.get("value") is not None:

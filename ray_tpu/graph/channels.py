@@ -73,6 +73,12 @@ class ChannelClosed(Exception):
     pass
 
 
+# read_nowait()'s "nothing new" sentinel: distinguishes an empty poll
+# from a channel legitimately carrying None. Identity-compared, so it is
+# meaningful only within one process (polling loops, not wire payloads).
+NO_MESSAGE = object()
+
+
 class ShmChannel:
     """One named mutable shm channel end; both ends are the same object,
     distinguished by which methods you call. Picklable by name."""
@@ -126,6 +132,16 @@ class ShmChannel:
         self._last_version = int(v)
         # zero-copy view into the scratch buffer (raw[:n] would copy again)
         return pickle.loads(memoryview(self._buf)[:out_len.value])
+
+    def read_nowait(self) -> Any:
+        """Non-blocking poll: the latest unseen value, or
+        :data:`NO_MESSAGE` when the writer hasn't published a new
+        version since our last read. ``ChannelClosed`` still raises —
+        a poller must see the closure cascade, not spin on it."""
+        try:
+            return self.read(timeout_s=0.0)
+        except TimeoutError:
+            return NO_MESSAGE
 
     def close(self):
         if self._h is not None:

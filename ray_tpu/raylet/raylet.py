@@ -165,6 +165,10 @@ class Raylet:
         self.view = ClusterView()  # replica of the cluster view
         self.gcs = GcsClient(self.gcs_address, client_id=f"raylet-{self.node_id.hex()[:8]}")
         self._workers: Dict[WorkerID, WorkerHandle] = {}
+        # Worker IDs this raylet has seen die, kept (bounded) so the
+        # liveness probe can distinguish "confirmed dead" from "never
+        # hosted here" — owner-fetch fail-fast depends on that answer
+        self._dead_workers: Dict[WorkerID, None] = {}
         self._leases: Dict[bytes, WorkerID] = {}
         self._bundles: Dict[PlacementGroupID, Dict[int, Bundle]] = {}
         self._pending_leases: List[dict] = []  # queued lease requests (waiters)
@@ -222,7 +226,8 @@ class Raylet:
         for name in (
             "health_check", "request_worker_lease", "request_worker_leases",
             "return_worker", "start_actor",
-            "kill_worker", "register_worker", "prepare_bundles", "commit_bundles",
+            "kill_worker", "worker_alive", "register_worker",
+            "prepare_bundles", "commit_bundles",
             "return_bundles", "get_node_info", "debug_state", "notify_actor_dead",
         ):
             s.register(name, getattr(self, f"h_{name}"))
@@ -678,9 +683,15 @@ class Raylet:
         except subprocess.TimeoutExpired:
             pass
 
+    def _record_worker_dead(self, worker_id: WorkerID):
+        self._dead_workers[worker_id] = None
+        while len(self._dead_workers) > 4096:
+            self._dead_workers.pop(next(iter(self._dead_workers)))
+
     async def _on_worker_dead(self, w: WorkerHandle, reason: str):
         if w.state == "DEAD":
             return
+        self._record_worker_dead(w.worker_id)
         prev_state = w.state
         w.state = "DEAD"
         w.close_client()
@@ -708,6 +719,7 @@ class Raylet:
         self._replenish_pool()
 
     def _kill_worker_proc(self, w: WorkerHandle):
+        self._record_worker_dead(w.worker_id)
         if w.state != "DEAD":
             self.runtime_env_agent.release(w.env_key)
             # killing a live worker MUST return its held resources: this
@@ -1315,6 +1327,17 @@ class Raylet:
             return False
         self._kill_worker_proc(w)
         return True
+
+    async def h_worker_alive(self, worker_id: bytes):
+        """Three-valued liveness probe for object-owner fail-fast
+        (core_worker fetch): ``known`` is False for a worker this raylet
+        never hosted (foreign node, driver) — the caller must keep its
+        patient retry path for those."""
+        wid = WorkerID(worker_id)
+        w = self._workers.get(wid)
+        if w is not None:
+            return {"known": True, "alive": w.state != "DEAD"}
+        return {"known": wid in self._dead_workers, "alive": False}
 
     async def h_notify_actor_dead(self, worker_id: bytes):
         """Worker-side graceful actor exit (e.g. __rt_terminate__)."""

@@ -22,7 +22,19 @@ from ray_tpu.rl.dqn import (  # noqa: F401
     DQNLearner,
     ReplayBuffer,
 )
-from ray_tpu.rl.envs import CartPoleEnv, make_env  # noqa: F401
+from ray_tpu.rl.envs import (  # noqa: F401
+    CartPoleEnv,
+    JaxCartPole,
+    make_env,
+    register_jax_env,
+)
+from ray_tpu.rl.podracer import (  # noqa: F401
+    Anakin,
+    FragmentBatch,
+    PodracerConfig,
+    PodracerError,
+    SebulbaHandle,
+)
 from ray_tpu.rl.impala import (  # noqa: F401,E402
     IMPALA,
     IMPALAConfig,

@@ -16,7 +16,7 @@ import numpy as np
 
 from ray_tpu.rl.actor_manager import FaultTolerantActorManager
 from ray_tpu.rl.env_runner import EnvRunner
-from ray_tpu.rl.learner import PPOLearner, compute_gae
+from ray_tpu.rl.learner import PPOLearner, build_ppo_batch, compute_gae  # noqa: F401 — compute_gae re-exported for existing importers
 from ray_tpu.rl.module import init_lstm_policy_params, init_policy_params
 
 
@@ -217,6 +217,18 @@ class Algorithm:
         for i in list(self.env_runner_group.actors):
             self.env_runner_group.remove_actor(i)
 
+    # ------------------------------------------------------------ scale-out
+    def scale_out(self, podracer: "Any"):
+        """Podracer scale-out (rl/podracer.py): Sebulba mode returns a
+        live :class:`~ray_tpu.rl.podracer.SebulbaHandle` streaming
+        fragments from dedicated runner actors into a learner actor;
+        Anakin mode returns an :class:`~ray_tpu.rl.podracer.Anakin`
+        running fully-jitted in-graph updates.  ``stop()``/``train()``
+        fold the trained weights back into this algorithm."""
+        from ray_tpu.rl.podracer import scale_out as _scale_out
+
+        return _scale_out(self, podracer)
+
 
 class PPO(Algorithm):
     def __init__(self, config: "PPOConfig"):
@@ -246,45 +258,9 @@ class PPO(Algorithm):
         fragments = self._sample_fragments()
         if not fragments:
             raise RuntimeError("no healthy env runners produced samples")
-        advs, targets, returns = [], [], []
-        for f in fragments:
-            a, vt = compute_gae(
-                f["rewards"], f["values"], f["dones"], f["last_value"],
-                gamma=self.config.gamma, lam=self.config.lam)
-            advs.append(a)
-            targets.append(vt)
-            returns.extend(f["episode_returns"])
-        stateful = "state_in" in fragments[0]
-        if stateful:
-            # keep time structure: (F, T, ...) columns, GAE per fragment
-            # as above, then cut into (B, L) windows with the recorded
-            # state at window starts (burn-in-free injection)
-            batch = {
-                "obs": np.stack([f["obs"] for f in fragments]),
-                "actions": np.stack([f["actions"] for f in fragments]),
-                "logp_old": np.stack([f["logp"] for f in fragments]),
-                "advantages": np.stack(advs),
-                "value_targets": np.stack(targets),
-                "is_first": np.stack([f["is_first"] for f in fragments]),
-            }
-            for k in fragments[0]["state_in"]:
-                batch["state_in_" + k] = np.stack(
-                    [f["state_in"][k] for f in fragments])
-        else:
-            batch = {
-                "obs": np.concatenate([f["obs"] for f in fragments]),
-                "actions": np.concatenate(
-                    [f["actions"] for f in fragments]),
-                "logp_old": np.concatenate([f["logp"] for f in fragments]),
-                "advantages": np.concatenate(advs),
-                "value_targets": np.concatenate(targets),
-            }
-        adv = batch["advantages"]
-        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
-        if stateful:
-            from ray_tpu.rl.connectors import window_sequences
-
-            batch = window_sequences(batch, self.config.seq_len)
+        batch, returns, env_steps = build_ppo_batch(
+            fragments, gamma=self.config.gamma, lam=self.config.lam,
+            seq_len=self.config.seq_len)
         batch = self._learner_pipeline(batch)
         metrics = self.learner.update(batch)
         self._weights_version += 1
@@ -293,8 +269,7 @@ class PPO(Algorithm):
             "env_runners": {
                 "episode_return_mean": self.episode_return_mean(),
                 "num_episodes": len(returns),
-                "num_env_steps_sampled": sum(
-                    len(f["obs"]) for f in fragments),
+                "num_env_steps_sampled": env_steps,
                 "num_healthy_workers":
                     self.env_runner_group.num_healthy_actors(),
             },

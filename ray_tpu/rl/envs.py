@@ -149,6 +149,107 @@ class PendulumEnv:
         return self._obs(), -float(cost), False, truncated, {}
 
 
+class JaxCartPole:
+    """Functional, batched, jittable CartPole for in-graph (Anakin)
+    training: ``reset``/``step`` are pure functions over a state pytree,
+    traceable under ``jax.jit``/``lax.scan``.  Dynamics, termination
+    bounds, and the reset distribution mirror :class:`CartPoleEnv`
+    exactly (tests/test_podracer.py pins numpy parity); ``step``
+    auto-resets done envs in-graph (the returned obs is the NEXT policy
+    input, so a fresh episode starts without leaving the compiled
+    program).  jax imports stay inside methods — this module must stay
+    importable in numpy-only rollout workers."""
+
+    observation_size = 4
+    num_actions = 2
+    max_episode_steps = 500
+
+    @staticmethod
+    def reset(key, batch_size: int):
+        """-> (state, obs): state {"s": (B, 4), "steps": (B,) int32}."""
+        import jax
+        import jax.numpy as jnp
+
+        s = jax.random.uniform(key, (batch_size, 4),
+                               minval=-0.05, maxval=0.05)
+        return ({"s": s, "steps": jnp.zeros(batch_size, jnp.int32)},
+                s.astype(jnp.float32))
+
+    @staticmethod
+    def physics(s, action):
+        """One Euler step of the cart-pole dynamics, batched: ``s``
+        (B, 4), ``action`` (B,) in {0, 1} -> next (B, 4).  Same
+        equations, same constants as ``CartPoleEnv.step``."""
+        import jax.numpy as jnp
+
+        E = CartPoleEnv
+        x, x_dot, theta, theta_dot = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        force = jnp.where(action == 1, E.FORCE, -E.FORCE)
+        total_mass = E.CART_MASS + E.POLE_MASS
+        pole_ml = E.POLE_MASS * E.POLE_HALF_LEN
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + pole_ml * theta_dot**2 * sin_t) / total_mass
+        theta_acc = (E.GRAVITY * sin_t - cos_t * temp) / (
+            E.POLE_HALF_LEN
+            * (4.0 / 3.0 - E.POLE_MASS * cos_t**2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        return jnp.stack([
+            x + E.DT * x_dot,
+            x_dot + E.DT * x_acc,
+            theta + E.DT * theta_dot,
+            theta_dot + E.DT * theta_acc,
+        ], axis=1)
+
+    @staticmethod
+    def step(state, action, key):
+        """-> (state', obs, reward, done); done envs are re-drawn from
+        the reset distribution in-graph (their obs is the new episode's
+        first observation)."""
+        import jax
+        import jax.numpy as jnp
+
+        E = CartPoleEnv
+        s2 = JaxCartPole.physics(state["s"], action)
+        steps = state["steps"] + 1
+        terminated = ((jnp.abs(s2[:, 0]) > E.X_LIMIT)
+                      | (jnp.abs(s2[:, 2]) > E.THETA_LIMIT))
+        truncated = steps >= JaxCartPole.max_episode_steps
+        done = terminated | truncated
+        fresh = jax.random.uniform(key, s2.shape, minval=-0.05,
+                                   maxval=0.05)
+        s_next = jnp.where(done[:, None], fresh, s2)
+        steps = jnp.where(done, 0, steps)
+        reward = jnp.ones(s2.shape[0], jnp.float32)
+        return ({"s": s_next, "steps": steps},
+                s_next.astype(jnp.float32), reward, done)
+
+
+_JAX_REGISTRY: Dict[str, Any] = {
+    "CartPole-v1": JaxCartPole,
+}
+
+
+def register_jax_env(name: str, env_cls: Any) -> None:
+    """Register a functional in-graph env (JaxCartPole-shaped
+    ``reset(key, batch)`` / ``step(state, action, key)``) for Anakin."""
+    _JAX_REGISTRY[name] = env_cls
+
+
+def get_jax_env(spec: Union[str, Any]):
+    """Resolve an Anakin in-graph env: registered name, or any object
+    already exposing the functional reset/step surface."""
+    if isinstance(spec, str):
+        if spec not in _JAX_REGISTRY:
+            raise KeyError(
+                f"no in-graph (jittable) env registered for {spec!r}; "
+                "register one with register_jax_env() or use Sebulba "
+                f"mode. Known: {sorted(_JAX_REGISTRY)}")
+        return _JAX_REGISTRY[spec]
+    if hasattr(spec, "reset") and hasattr(spec, "step"):
+        return spec
+    raise TypeError(f"{spec!r} is not an in-graph env")
+
+
 def _coordination_factory(seed=None):
     from ray_tpu.rl.multi_agent import CoordinationGameEnv
 

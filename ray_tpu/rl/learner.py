@@ -199,6 +199,61 @@ class PPOLearner:
         self._params = jax.tree.map(jax.numpy.asarray, dict(params))
 
 
+def build_ppo_batch(fragments, *, gamma: float = 0.99, lam: float = 0.95,
+                    seq_len: int = None):
+    """Assemble the PPO train batch from rollout fragments: per-fragment
+    GAE, column stacking ((F, T, ...) + ``window_sequences`` for
+    stateful modules, flat concatenation otherwise), and advantage
+    normalization.  ONE implementation shared by ``PPO.training_step``
+    and the Podracer Sebulba learner actor, so the asynchronous path
+    trains on byte-identical batches to the synchronous parity oracle.
+
+    Returns ``(batch, episode_returns, env_steps)``.
+    """
+    advs, targets, returns = [], [], []
+    for f in fragments:
+        a, vt = compute_gae(
+            f["rewards"], f["values"], f["dones"], f["last_value"],
+            gamma=gamma, lam=lam)
+        advs.append(a)
+        targets.append(vt)
+        returns.extend(f["episode_returns"])
+    stateful = "state_in" in fragments[0]
+    if stateful:
+        # keep time structure: (F, T, ...) columns, GAE per fragment as
+        # above, then cut into (B, L) windows with the recorded state at
+        # window starts (burn-in-free injection)
+        batch = {
+            "obs": np.stack([f["obs"] for f in fragments]),
+            "actions": np.stack([f["actions"] for f in fragments]),
+            "logp_old": np.stack([f["logp"] for f in fragments]),
+            "advantages": np.stack(advs),
+            "value_targets": np.stack(targets),
+            "is_first": np.stack([f["is_first"] for f in fragments]),
+        }
+        for k in fragments[0]["state_in"]:
+            batch["state_in_" + k] = np.stack(
+                [f["state_in"][k] for f in fragments])
+    else:
+        batch = {
+            "obs": np.concatenate([f["obs"] for f in fragments]),
+            "actions": np.concatenate([f["actions"] for f in fragments]),
+            "logp_old": np.concatenate([f["logp"] for f in fragments]),
+            "advantages": np.concatenate(advs),
+            "value_targets": np.concatenate(targets),
+        }
+    adv = batch["advantages"]
+    batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+    if stateful:
+        from ray_tpu.rl.connectors import window_sequences
+
+        if seq_len is None:
+            raise ValueError("stateful fragments need seq_len")
+        batch = window_sequences(batch, seq_len)
+    env_steps = sum(len(f["obs"]) for f in fragments)
+    return batch, returns, env_steps
+
+
 def compute_gae(rewards, values, dones, last_value, *,
                 gamma: float = 0.99, lam: float = 0.95
                 ) -> Tuple[np.ndarray, np.ndarray]:

@@ -525,7 +525,8 @@ class RetryableRpcClient:
     deadline (reference: retryable_grpc_client.cc).  Handler-raised exceptions
     are NOT retried — they are application errors."""
 
-    def __init__(self, address: Address, max_attempts: int = 1 << 30, deadline_s: Optional[float] = None):
+    def __init__(self, address: Address, max_attempts: int = 1 << 30, deadline_s: Optional[float] = None,
+                 abort_check=None):
         self.address = tuple(address)
         self._client = RpcClient(address)
         self._max_attempts = max_attempts
@@ -535,6 +536,11 @@ class RetryableRpcClient:
         if deadline_s is None:
             deadline_s = float(GLOBAL_CONFIG.get("gcs_rpc_server_reconnect_timeout_s"))
         self._deadline_s = deadline_s
+        # Optional async predicate consulted after each connection-level
+        # failure: True = the peer is confirmed permanently gone (e.g. its
+        # raylet reaped the process), so reconnecting cannot help — fail
+        # now instead of burning the remaining deadline.
+        self._abort_check = abort_check
 
     async def call_async(self, method: str, timeout: Optional[float] = None, **kwargs):
         policy = RetryPolicy(
@@ -550,6 +556,8 @@ class RetryableRpcClient:
             except (RpcError, chaos.RpcChaosError) as e:
                 attempt += 1
                 if attempt >= self._max_attempts:
+                    raise
+                if self._abort_check is not None and await self._abort_check(e):
                     raise
                 if not await policy.asleep(attempt):
                     # per-address reconnect budget spent: typed so failover

@@ -159,6 +159,7 @@ RPC_SCHEMAS: Dict[str, Message] = {
                            opt("cwd", str)),
     "start_actor": _m("start_actor", req("creation_spec", bytes)),
     "kill_worker": _m("kill_worker", req("worker_id", bytes)),
+    "worker_alive": _m("worker_alive", req("worker_id", bytes)),
     # ---- GCS service (reference gcs_service.proto) ----
     "register_node": _m("register_node", req("node_id", bytes),
                         req("address", (tuple, list)),

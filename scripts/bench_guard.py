@@ -19,6 +19,9 @@ Guarded artifacts:
   diff against (and capture into) the committed BENCH_core.json.
 - ``BENCH_data.json`` TCP row (``--fresh-data-tcp``): the round-13
   shuffle-over-TCP row written by ``python bench_data.py --tcp``.
+- ``BENCH_rl.json`` (``--fresh-rl``): the round-17 Podracer rows
+  (Sebulba acting throughput + its ratio over the sync loop, Anakin
+  jitted step rate) written by ``python bench_rl.py --out <dir>/...``.
 
 The checked-in files are the committed performance record (their values
 were measured on the box named in their captions); a fresh run on the
@@ -105,6 +108,19 @@ GUARDED_DATA_TCP_ROWS = (
 # BENCH_core.json's shape.
 GUARDED_TRAIN_ROWS = (
     "pipeline_steps_per_second",
+)
+
+# The round-17 RL rows (ISSUE 17 acceptance): Sebulba split-fleet acting
+# throughput and its ratio over the synchronous train() loop
+# (acceptance >= 2x), plus the Anakin fully-jitted step rate
+# (``python bench_rl.py --out <dir>/BENCH_rl.json``); committed in
+# BENCH_rl.json, which shares BENCH_core.json's shape.  The ratio row is
+# guarded alongside the absolute row because it self-normalizes box
+# load: both sides slow down together on a busy host.
+GUARDED_RL_ROWS = (
+    "rl_sebulba_env_steps_per_second",
+    "rl_sebulba_vs_sync_env_steps_speedup",
+    "rl_anakin_env_steps_per_second",
 )
 
 
@@ -280,6 +296,15 @@ def main(argv=None) -> int:
                    default=os.path.join(repo_root, "BENCH_train.json"),
                    help="committed train reference (default: repo "
                         "BENCH_train.json)")
+    p.add_argument("--fresh-rl",
+                   help="BENCH_rl.json from the run under test "
+                        "(python bench_rl.py --out <dir>/...); the "
+                        "Sebulba/Anakin rows diff against — and capture "
+                        "into — the committed BENCH_rl.json")
+    p.add_argument("--checked-in-rl",
+                   default=os.path.join(repo_root, "BENCH_rl.json"),
+                   help="committed RL reference (default: repo "
+                        "BENCH_rl.json)")
     p.add_argument("--threshold", type=float, default=0.15,
                    help="max tolerated fractional regression (default 0.15)")
     p.add_argument("--capture", action="store_true",
@@ -290,10 +315,10 @@ def main(argv=None) -> int:
 
     if not (args.fresh or args.fresh_serve or args.fresh_data
             or args.fresh_multinode or args.fresh_data_tcp
-            or args.fresh_train):
+            or args.fresh_train or args.fresh_rl):
         print("bench_guard: pass --fresh, --fresh-serve, --fresh-data, "
-              "--fresh-multinode, --fresh-data-tcp and/or --fresh-train",
-              file=sys.stderr)
+              "--fresh-multinode, --fresh-data-tcp, --fresh-train "
+              "and/or --fresh-rl", file=sys.stderr)
         return 2
     legs = []  # (label, fresh_rows, ref_rows, guarded, capture_fn)
     if args.fresh:
@@ -385,6 +410,21 @@ def main(argv=None) -> int:
                      GUARDED_TRAIN_ROWS,
                      lambda r: _capture_core(args.fresh_train,
                                              args.checked_in_train, r)))
+
+    if args.fresh_rl:
+        if not os.path.exists(args.fresh_rl):
+            print(f"bench_guard: missing {args.fresh_rl}", file=sys.stderr)
+            return 2
+        ref = _core_rows(args.checked_in_rl) \
+            if os.path.exists(args.checked_in_rl) else {}
+        if not ref and not args.capture:
+            print(f"bench_guard: missing {args.checked_in_rl}",
+                  file=sys.stderr)
+            return 2
+        legs.append(("rl", _core_rows(args.fresh_rl), ref,
+                     GUARDED_RL_ROWS,
+                     lambda r: _capture_core(args.fresh_rl,
+                                             args.checked_in_rl, r)))
 
     if args.capture:
         for label, fresh, _ref, guarded, _cap in legs:

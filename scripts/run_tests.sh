@@ -149,6 +149,55 @@ PYEOF
     echo "chaos gate(pipeline): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
     fail=$((fail+1))
   fi
+  # Podracer leg: a Sebulba session with BOTH RL fault points armed in
+  # the environment (they fire inside the runner/learner ACTOR
+  # processes) AND a runner SIGKILLed mid-stream.  The injected push
+  # drop and broadcast skip must be absorbed as counters, the dead
+  # runner must surface as typed events + an in-place respawn, and the
+  # learner must keep stepping to a clean stop — never a hang (ISSUE 17
+  # resilience bar).
+  echo "chaos gate: podracer runner kill under injected RL faults..." \
+    | tee -a "$RUN_LOG"
+  if timeout 300 env JAX_PLATFORMS=cpu \
+      RT_FAULTS="rl.fragment.push=nth:2,rl.params.broadcast=nth:2" \
+      python - >> "$RUN_LOG" 2>&1 <<'PYEOF'
+import os
+import signal
+
+import ray_tpu
+from ray_tpu.common import faults
+from ray_tpu.rl.algorithm import PPOConfig
+from ray_tpu.rl.podracer import PodracerConfig
+
+assert "rl.fragment.push" in faults.active_points(), \
+    "RT_FAULTS did not arm the RL fault points at import"
+ray_tpu.init(num_cpus=4, num_tpus=0)
+algo = (PPOConfig().environment("CartPole-v1").env_runners(2, 2)
+        .training(rollout_fragment_length=32, minibatch_size=64,
+                  num_epochs=1).build())
+h = algo.scale_out(PodracerConfig(mode="sebulba", num_runners=2,
+                                  queue_capacity=4))
+h.wait_updates(1, timeout_s=120)
+os.kill(h.runner_pids[0], signal.SIGKILL)
+h.wait_updates(3, timeout_s=180)
+kinds = [e["type"] for e in h.events]
+assert "runner_died" in kinds, h.events
+assert "runner_respawned" in kinds, h.events
+s = h.stop(timeout_s=120)
+drops = sum(r["push_drops"] for r in s["runners"].values())
+assert s["learner"]["updates"] >= 4, s["learner"]
+ray_tpu.shutdown()
+print("chaos gate(podracer): typed runner recovery + clean stop through"
+      f" injected faults (push_drops={drops},"
+      f" broadcast_faults={s['learner']['broadcast_faults']},"
+      f" restarts={h.restarts}, updates={s['learner']['updates']})")
+PYEOF
+  then
+    echo "chaos gate(podracer): ok" | tee -a "$RUN_LOG"
+  else
+    echo "chaos gate(podracer): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
 fi
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
@@ -191,13 +240,15 @@ if [[ $fail -gt 0 && "$TRIAGE_RUNS" -gt 0 ]]; then
 fi
 # Opt-in bench regression stage (RT_BENCH_GUARD=1): run the core bench,
 # the Serve data-plane bench, the GB-scale data shuffle bench, the
-# 2-node object-plane bench, the shuffle-over-TCP bench, and the
-# train-plane bench fresh and diff the guarded rows (round-8 core
-# targets + round-11 proxy rows + round-12 groupby shuffle row +
-# round-13 multi-node rows + round-16 compiled-chain and pipeline rows)
+# 2-node object-plane bench, the shuffle-over-TCP bench, the
+# train-plane bench, and the RL Podracer bench fresh and diff the
+# guarded rows (round-8 core targets + round-11 proxy rows + round-12
+# groupby shuffle row + round-13 multi-node rows + round-16
+# compiled-chain and pipeline rows + round-17 Sebulba/Anakin rows)
 # against the committed BENCH_core.json / BENCH_serve.json /
-# BENCH_data.json / BENCH_train.json (>15% same-box regression fails
-# the run). Off by default — the benches need minutes and quiet CPUs.
+# BENCH_data.json / BENCH_train.json / BENCH_rl.json (>15% same-box
+# regression fails the run). Off by default — the benches need minutes
+# and quiet CPUs.
 if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
   echo "bench guard: running bench_core.py (this takes minutes)..." \
     | tee -a "$RUN_LOG"
@@ -252,6 +303,16 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
            "(log: $BG_DIR/bench_train.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
+    echo "bench guard: running bench_rl.py (Sebulba/Anakin vs sync)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          env JAX_PLATFORMS=cpu python "$OLDPWD/bench_rl.py" \
+          --out "$BG_DIR/BENCH_rl.json" > bench_rl.log 2>&1)
+    then
+      echo "bench guard: rl bench run failed" \
+           "(log: $BG_DIR/bench_rl.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
     # subshell pipefail: the verdict must be bench_guard's exit status,
     # not tee's
     SERVE_ARGS=()
@@ -269,10 +330,13 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
     TRAIN_ARGS=()
     [[ -f "$BG_DIR/BENCH_train.json" ]] && \
       TRAIN_ARGS=(--fresh-train "$BG_DIR/BENCH_train.json")
+    RL_ARGS=()
+    [[ -f "$BG_DIR/BENCH_rl.json" ]] && \
+      RL_ARGS=(--fresh-rl "$BG_DIR/BENCH_rl.json")
     if (set -o pipefail; python scripts/bench_guard.py \
         --fresh "$BG_DIR/BENCH_core.json" "${SERVE_ARGS[@]}" \
         "${DATA_ARGS[@]}" "${MULTINODE_ARGS[@]}" "${DATA_TCP_ARGS[@]}" \
-        "${TRAIN_ARGS[@]}" \
+        "${TRAIN_ARGS[@]}" "${RL_ARGS[@]}" \
         | tee -a "$RUN_LOG"); then
       echo "bench guard: ok" | tee -a "$RUN_LOG"
     else

@@ -1,0 +1,408 @@
+"""Podracer RL scale-out (rl/podracer.py): Sebulba split acting/learning
+and the Anakin in-graph path.
+
+The synchronous ``Algorithm.train()`` loop is the parity oracle: a
+Sebulba session with ``sync_weights=True`` runs the same lock-step
+schedule over the channel substrate and must land on the SAME weights
+as running the sync loop for the same number of updates — including for
+stateful (LSTM) modules, whose per-env recurrent state must thread
+across fragment boundaries inside the runner actors exactly as
+``EnvRunner.sample()`` threads it in-process.
+
+Chaos contracts pinned here: a SIGKILLed runner mid-stream surfaces as
+typed events and is respawned onto the same channels while the learner
+keeps stepping; a SIGKILLed learner raises typed PodracerError from the
+driver's watched wait (never a hang); an injected ``rl.fragment.push``
+fault drops exactly the faulted handoff and the runner keeps acting.
+"""
+
+import os
+import signal
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.common import faults
+from ray_tpu.graph.channels import ChannelClosed, ShmChannel
+from ray_tpu.rl.algorithm import PPOConfig
+from ray_tpu.rl.envs import CartPoleEnv, JaxCartPole
+from ray_tpu.rl.podracer import (FragmentBatch, PodracerConfig,
+                                 PodracerError, _SebulbaRunner, scale_out)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _algo(num_runners=2, envs=2, **training):
+    cfg = PPOConfig().environment("CartPole-v1")
+    cfg = cfg.env_runners(num_runners, envs)
+    if training:
+        cfg = cfg.training(**training)
+    return cfg.build()
+
+
+def _assert_weights_close(w1, w2, **tol):
+    assert set(w1) == set(w2)
+    for k in w1:
+        np.testing.assert_allclose(w1[k], w2[k], err_msg=k, **tol)
+
+
+# ---------------------------------------------------------------------------
+# FragmentBatch: the sealed fused object
+# ---------------------------------------------------------------------------
+
+class TestFragmentBatch:
+    def _fragments(self, n_envs=3, T=5, with_state=False):
+        rng = np.random.default_rng(0)
+        frags = []
+        for _ in range(n_envs):
+            f = {
+                "obs": rng.normal(size=(T, 4)).astype(np.float32),
+                "actions": rng.integers(0, 2, T).astype(np.int64),
+                "rewards": np.ones(T, np.float32),
+                "dones": np.zeros(T, np.float32),
+                "terminated": np.zeros(T, np.float32),
+                "logp": rng.normal(size=T).astype(np.float32),
+                "values": rng.normal(size=T).astype(np.float32),
+                "last_value": float(rng.normal()),
+                "episode_returns": [12.0, 7.5],
+                "weights_version": 3,
+            }
+            if with_state:
+                f["state_in"] = {
+                    "h": rng.normal(size=8).astype(np.float32),
+                    "c": rng.normal(size=8).astype(np.float32)}
+            frags.append(f)
+        return frags
+
+    def test_roundtrip(self):
+        frags = self._fragments()
+        fb = FragmentBatch.from_fragments(
+            frags, runner=1, counters={"env_steps": 15})
+        assert fb.num_fragments == 3
+        assert fb.meta["version"] == 3
+        assert fb.meta["runner"] == 1
+        assert fb.meta["counters"] == {"env_steps": 15}
+        out = fb.to_fragments()
+        assert len(out) == len(frags)
+        for a, b in zip(frags, out):
+            for k in ("obs", "actions", "rewards", "logp", "values"):
+                np.testing.assert_array_equal(a[k], b[k])
+            assert b["last_value"] == pytest.approx(a["last_value"])
+            assert b["episode_returns"] == a["episode_returns"]
+            assert b["weights_version"] == 3
+
+    def test_recurrent_state_rides_the_fused_object(self):
+        frags = self._fragments(with_state=True)
+        out = FragmentBatch.from_fragments(
+            frags, runner=0, counters={}).to_fragments()
+        for a, b in zip(frags, out):
+            for k in ("h", "c"):
+                np.testing.assert_array_equal(a["state_in"][k],
+                                              b["state_in"][k])
+
+    def test_zero_copy_views(self):
+        # to_fragments() must alias the fused columns, not copy them —
+        # that aliasing is the whole point of one sealed object per batch
+        fb = FragmentBatch.from_fragments(
+            self._fragments(), runner=0, counters={})
+        frag = fb.to_fragments()[1]
+        assert frag["obs"].base is fb.columns["obs"]
+
+
+# ---------------------------------------------------------------------------
+# JaxCartPole: in-graph env vs the numpy reference
+# ---------------------------------------------------------------------------
+
+class TestJaxCartPole:
+    def test_physics_matches_numpy_env(self):
+        rng = np.random.default_rng(7)
+        states = rng.uniform(-0.2, 0.2, size=(16, 4))
+        actions = rng.integers(0, 2, 16)
+        jax_next = np.asarray(
+            JaxCartPole.physics(states.astype(np.float32),
+                                actions.astype(np.int32)))
+        env = CartPoleEnv(seed=0)
+        for i in range(16):
+            env._state = states[i].copy()
+            env._steps = 0
+            env.step(int(actions[i]))
+            np.testing.assert_allclose(env._state, jax_next[i],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_step_terminates_and_autoresets_in_graph(self):
+        import jax
+        import jax.numpy as jnp
+
+        state, _ = JaxCartPole.reset(jax.random.PRNGKey(0), 4)
+        # push env 0 past the position limit; env 1 past the angle limit
+        s = np.asarray(state["s"]).copy()
+        s[0, 0] = CartPoleEnv.X_LIMIT + 0.5
+        s[1, 2] = CartPoleEnv.THETA_LIMIT + 0.1
+        state = {"s": jnp.asarray(s), "steps": state["steps"] + 10}
+        state2, obs, reward, done = JaxCartPole.step(
+            state, jnp.zeros(4, jnp.int32), jax.random.PRNGKey(1))
+        done = np.asarray(done)
+        assert done[0] and done[1] and not done[2] and not done[3]
+        np.testing.assert_array_equal(np.asarray(reward), np.ones(4))
+        s2 = np.asarray(state2["s"])
+        steps2 = np.asarray(state2["steps"])
+        # done envs re-enter the reset distribution with a fresh episode
+        assert np.all(np.abs(s2[:2]) <= 0.05) and np.all(steps2[:2] == 0)
+        assert np.all(steps2[2:] == 11)
+
+    def test_reset_distribution_matches_numpy_env(self):
+        import jax
+
+        _, obs = JaxCartPole.reset(jax.random.PRNGKey(3), 256)
+        obs = np.asarray(obs)
+        assert obs.shape == (256, 4)
+        assert np.all(np.abs(obs) <= 0.05)
+
+
+# ---------------------------------------------------------------------------
+# Sebulba: parity, lag bound, clean stop, chaos
+# ---------------------------------------------------------------------------
+
+class TestSebulba:
+    def test_sync_parity_and_clean_stop(self, rt):
+        """Lock-step Sebulba == the sync train() loop, weight for weight;
+        a clean stop drains the queue (every produced fragment is
+        accounted consumed, dropped, or counted)."""
+        training = dict(rollout_fragment_length=16, minibatch_size=64,
+                        num_epochs=2)
+        algo = _algo(2, 2, **training)
+        h = scale_out(algo, PodracerConfig(mode="sebulba", num_runners=2,
+                                           sync_weights=True))
+        try:
+            recs = h.wait_updates(3, timeout_s=120)
+        except BaseException:
+            h.shutdown()
+            raise
+        assert all(r["policy_lag"] == 0 for r in recs)  # lock-step
+        state = h.debug_state()
+        assert state["mode"] == "sebulba"
+        assert state["totals"]["updates"] >= 3
+        for metric in ("rt_rl_env_steps_total", "rt_rl_learner_updates_total",
+                       "rt_rl_fragments_consumed_total"):
+            assert metric in state["metrics"], state["metrics"].keys()
+        s = h.stop(timeout_s=120)
+        learner = s["learner"]
+        produced = sum(r["fragments_produced"] for r in s["runners"].values())
+        drops = sum(r["push_drops"] for r in s["runners"].values())
+        assert s["queue"]["undelivered"] == 0
+        assert learner["lost_batches"] == 0 and learner["lag_dropped"] == 0
+        assert produced - drops == learner["consumed"]
+        # parity oracle: the sync loop, run for the same number of
+        # updates from the same init, lands on the same weights
+        v = learner["version"]
+        assert v >= 3
+        oracle = _algo(2, 2, **training)
+        for _ in range(v):
+            oracle.train()
+        _assert_weights_close(algo.get_weights(), oracle.get_weights(),
+                              rtol=1e-5, atol=1e-6)
+
+    def test_lstm_state_threads_across_fragments(self, rt):
+        """Stateful-module parity: runner-side recurrent state must carry
+        across fragment boundaries exactly as EnvRunner.sample() carries
+        it in the sync loop — any reset/copy drift lands on different
+        weights within a couple of updates."""
+        training = dict(rollout_fragment_length=16, minibatch_size=32,
+                        num_epochs=1, module="lstm", seq_len=8)
+        algo = _algo(1, 2, **training)
+        h = scale_out(algo, PodracerConfig(mode="sebulba", num_runners=1,
+                                           sync_weights=True))
+        try:
+            h.wait_updates(2, timeout_s=120)
+        except BaseException:
+            h.shutdown()
+            raise
+        s = h.stop(timeout_s=120)
+        v = s["learner"]["version"]
+        assert v >= 2
+        oracle = _algo(1, 2, **training)
+        for _ in range(v):
+            oracle.train()
+        _assert_weights_close(algo.get_weights(), oracle.get_weights(),
+                              rtol=1e-5, atol=1e-6)
+
+    def test_policy_lag_is_bounded(self, rt):
+        """Async acting with max_policy_lag=1: every update trained on
+        fragments at most one weight version stale; staler ones are
+        counted dropped, and the learner still makes progress."""
+        algo = _algo(2, 2, rollout_fragment_length=16, minibatch_size=64,
+                     num_epochs=1)
+        h = scale_out(algo, PodracerConfig(mode="sebulba", num_runners=2,
+                                           max_policy_lag=1))
+        try:
+            recs = h.wait_updates(4, timeout_s=120)
+        except BaseException:
+            h.shutdown()
+            raise
+        assert all(r["policy_lag"] <= 1 for r in recs)
+        assert recs[-1]["version"] >= 4
+        s = h.stop(timeout_s=120)
+        assert s["learner"]["lag_dropped"] >= 0
+        assert s["learner"]["updates"] >= 4
+
+    def test_runner_sigkill_recovers_typed(self, rt):
+        """SIGKILL a runner mid-stream: the driver surfaces typed
+        runner_died/runner_respawned events, respawns onto the SAME
+        channels, and the learner keeps stepping (remaining runner plus
+        the respawn feed it) — never a hang, never a corrupted update."""
+        algo = _algo(2, 2, rollout_fragment_length=32, minibatch_size=64,
+                     num_epochs=1)
+        h = scale_out(algo, PodracerConfig(mode="sebulba", num_runners=2,
+                                           fragment_length=32,
+                                           queue_capacity=4))
+        try:
+            h.wait_updates(1, timeout_s=120)
+            os.kill(h.runner_pids[0], signal.SIGKILL)
+            h.wait_updates(3, timeout_s=180)
+        except BaseException:
+            h.shutdown()
+            raise
+        kinds = [e["type"] for e in h.events]
+        assert "runner_died" in kinds and "runner_respawned" in kinds
+        died = next(e for e in h.events if e["type"] == "runner_died")
+        assert "ActorDiedError" in died["error"]
+        assert h.restarts >= 1
+        assert h.debug_state()["live_runner_loops"] == 2
+        s = h.stop(timeout_s=120)
+        assert s["learner"]["updates"] >= 4
+
+    def test_learner_sigkill_raises_typed(self, rt):
+        """A dead learner must surface as PodracerError from the watched
+        wait well inside the deadline — not hang the result-channel
+        read."""
+        algo = _algo(1, 1, rollout_fragment_length=16, minibatch_size=16,
+                     num_epochs=1)
+        h = scale_out(algo, PodracerConfig(mode="sebulba", num_runners=1))
+        try:
+            h.wait_updates(1, timeout_s=120)
+            os.kill(h.learner_pid, signal.SIGKILL)
+            t0 = time.monotonic()
+            with pytest.raises(PodracerError, match="learner"):
+                h.wait_updates(10, timeout_s=90)
+            assert time.monotonic() - t0 < 60
+        finally:
+            h.shutdown()
+
+    def test_fragment_push_fault_drops_and_continues(self, rt):
+        """Deterministic chaos on the push handoff, in-process: with
+        ``rl.fragment.push`` armed nth:2 the second batch is dropped and
+        counted; acting continues and later batches still arrive."""
+        import cloudpickle
+
+        algo = _algo(1, 1, rollout_fragment_length=8, minibatch_size=16,
+                     num_epochs=1)
+        ac = algo.config
+        blob = cloudpickle.dumps({
+            "env_spec": ac.env, "seed": ac.seed, "num_envs": 1,
+            "connectors": list(ac.connectors),
+            "module_to_env_connectors": list(ac.module_to_env_connectors),
+            "record_next_obs": getattr(ac, "record_next_obs", False),
+            "fragment_length": 8, "sync_weights": False,
+            "io_timeout_s": 20.0,
+        })
+        tag = uuid.uuid4().hex[:8]
+        param_ch = ShmChannel(f"/rtrl_t{tag}_p", capacity=1 << 20,
+                              num_readers=1)
+        frag_ch = ShmChannel(f"/rtrl_t{tag}_f", capacity=1 << 20,
+                             num_readers=1)
+        param_ch._handle()
+        frag_ch._handle()
+        faults.clear()
+        faults.inject("rl.fragment.push", "nth:2")
+        runner = _SebulbaRunner(blob, 0)
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(
+                stats=runner.run_acting(param_ch, frag_ch)),
+            daemon=True)
+        t.start()
+        try:
+            param_ch.write(
+                {"version": 0, "ref": ray_tpu.put(algo.get_weights())},
+                timeout_s=20.0)
+            delivered = []
+            for _ in range(3):
+                msg = frag_ch.read(timeout_s=60.0)
+                delivered.append(ray_tpu.get(msg["ref"], timeout=30.0))
+            param_ch.close()  # clean stop: runner exits its acting loop
+            try:
+                while True:
+                    frag_ch.read(timeout_s=20.0)
+            except (ChannelClosed, TimeoutError):
+                pass
+            t.join(timeout=60)
+            assert not t.is_alive(), "runner loop failed to stop"
+            stats = out["stats"]
+            assert faults.fired("rl.fragment.push") == 1
+            assert stats["push_drops"] == 1  # exactly the faulted batch
+            assert stats["fragments_produced"] >= 4
+            assert all(isinstance(fb, FragmentBatch) for fb in delivered)
+        finally:
+            faults.clear()
+            for ch in (param_ch, frag_ch):
+                ch.close()
+                ch.unlink()
+
+
+# ---------------------------------------------------------------------------
+# Anakin: fully-jitted act+learn
+# ---------------------------------------------------------------------------
+
+class TestAnakin:
+    def _anakin(self, **training):
+        algo = _algo(1, 1, rollout_fragment_length=8, minibatch_size=32,
+                     num_epochs=2, **training)
+        return algo, scale_out(algo, PodracerConfig(
+            mode="anakin", batch_envs=4, fragment_length=8))
+
+    def test_jit_step_matches_eager(self, rt):
+        """The compiled act+learn step must equal its eager evaluation —
+        pins that nothing in the scan/update depends on tracing side
+        effects."""
+        _, an = self._anakin()
+        carry = an._carry
+        *out_jit, m_jit = an._step(*carry)
+        *out_eager, m_eager = an._raw_step(*carry)
+        for k in out_jit[0]:
+            np.testing.assert_allclose(
+                np.asarray(out_jit[0][k]), np.asarray(out_eager[0][k]),
+                rtol=1e-4, atol=1e-6, err_msg=k)
+        for k in m_jit:
+            np.testing.assert_allclose(
+                float(m_jit[k]), float(m_eager[k]), rtol=1e-4, atol=1e-6,
+                err_msg=k)
+
+    def test_train_progresses_and_folds_weights(self, rt):
+        algo, an = self._anakin()
+        before = {k: v.copy() for k, v in algo.get_weights().items()}
+        v0 = algo._weights_version
+        out = an.train(2)
+        assert an.updates == 2 and out["updates"] == 2
+        assert an.env_steps == 2 * 4 * 8  # updates x batch_envs x unroll
+        assert out["env_steps_per_s"] > 0
+        assert algo._weights_version == v0 + 2
+        after = algo.get_weights()
+        assert any(not np.allclose(before[k], after[k]) for k in before)
+        state = an.debug_state()
+        assert state["mode"] == "anakin"
+        assert "rt_rl_env_steps_total" in state["metrics"]
+
+    def test_rejects_stateful_modules(self, rt):
+        algo = _algo(1, 1, rollout_fragment_length=8, minibatch_size=16,
+                     num_epochs=1, module="lstm", seq_len=4)
+        with pytest.raises(PodracerError, match="feedforward"):
+            scale_out(algo, PodracerConfig(mode="anakin"))
