@@ -463,6 +463,231 @@ def run_proxy_bench(conns: int = 8, requests_per_conn: int = 250,
     return {"results": rows, "proxy_debug_state": dbg}
 
 
+# ---------------------------------------------------------- overload/chaos
+def _typed_fire(url: str, out: list, lock) -> None:
+    """One request on its own connection; append (status, latency_s).
+    Typed HTTP errors (429/503) are answers; anything untyped records
+    status 0 — the caller fails the bench on those."""
+    import urllib.error
+    import urllib.request
+
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url, data=b"x"), timeout=60) as resp:
+            resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    except Exception:  # noqa: BLE001 — untyped answer: counted, then fatal
+        status = 0
+    with lock:
+        out.append((status, time.perf_counter() - t0))
+
+
+def run_overload_bench(burst_factor: float = 3.0, burst_s: float = 3.0,
+                       service_s: float = 0.3,
+                       failover_window_s: float = 8.0) -> dict:
+    """Overload + failover rows (ISSUE 18): the robustness claims as
+    guarded numbers.
+
+    - ``proxy_overload_accepted_rps``: open-loop burst at ~burst_factor×
+      replica capacity against a fixed-service-time app.  Admission
+      control must answer EVERY request — 200 for the capacity's worth,
+      typed 503/429 before dispatch for the excess — and accepted
+      requests keep their latency profile (p99_accepted vs p99_unloaded).
+    - ``proxy_failover_rps_recovered``: steady closed-loop load over two
+      replicas, one SIGKILLed mid-window with ``serve.replica.call``
+      armed (nth:40) in the replica workers, so the row is measured
+      THROUGH an injected transport fault, not just a clean kill.  Pins
+      post-recovery RPS plus the typed error window and respawn time.
+
+    An unanswered or untyped (non-200/429/503) response raises — these
+    rows exist so 'never hang, never an untyped 5xx' is a regression the
+    guard can catch."""
+    import os
+    import signal
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    os.environ.setdefault("RT_FAULTS", "serve.replica.call=nth:40")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    addr = serve.start(http_port=0, grpc_port=None)
+    host, port = addr["http_host"], addr["http_port"]
+    rows = []
+    lock = threading.Lock()
+    try:
+        @serve.deployment(name="bench_overload", num_replicas=2,
+                          max_ongoing_requests=4)
+        class Work:
+            def __call__(self, request):
+                time.sleep(service_s)
+                return "ok"
+
+        serve.run(Work.bind())
+        url = f"http://{host}:{port}/bench_overload"
+
+        # unloaded profile: sequential requests, zero contention
+        unloaded: list = []
+        for _ in range(12):
+            _typed_fire(url, unloaded, lock)
+        bad = [s for s, _ in unloaded if s != 200]
+        if bad:
+            raise RuntimeError(f"unloaded warmup saw non-200s: {bad}")
+        p99_unloaded = float(np.percentile([l for _, l in unloaded], 99))
+
+        # open-loop burst at ~burst_factor × capacity: fire on the
+        # schedule, never wait for responses — overload by construction
+        capacity_rps = (2 * 4) / service_s  # replicas × slots / service
+        offered_rps = burst_factor * capacity_rps
+        n_total = int(offered_rps * burst_s)
+        results: list = []
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(n_total):
+            delay = (t0 + i / offered_rps) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=_typed_fire,
+                                 args=(url, results, lock))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+        if len(results) != n_total:
+            raise RuntimeError(
+                f"overload burst: {n_total - len(results)} of {n_total} "
+                "requests never answered — the proxy hung under overload")
+        untyped = [s for s, _ in results if s not in (200, 429, 503)]
+        if untyped:
+            raise RuntimeError(
+                f"overload burst: untyped responses {untyped[:5]} — "
+                "every shed must be a typed 429/503")
+        accepted = [l for s, l in results if s == 200]
+        if not accepted:
+            raise RuntimeError("overload burst: nothing accepted")
+        rows.append({
+            "metric": "proxy_overload_accepted_rps",
+            "value": round(len(accepted) / wall, 1),
+            "unit": "requests/s",
+            "offered_rps": round(offered_rps, 1),
+            "burst_s": round(wall, 2),
+            "requests": n_total,
+            "shed_pct": round(
+                100.0 * (n_total - len(accepted)) / n_total, 1),
+            "p99_accepted_ms": round(
+                float(np.percentile(accepted, 99)) * 1000, 1),
+            "p99_unloaded_ms": round(p99_unloaded * 1000, 1),
+            "service_time_ms": service_s * 1000,
+        })
+        serve.delete("bench_overload")
+
+        # failover: SIGKILL one of two replicas under steady load
+        @serve.deployment(name="bench_failover", num_replicas=2,
+                          max_ongoing_requests=8)
+        class Fast:
+            def __call__(self, request):
+                return "ok"
+
+        serve.run(Fast.bind())
+        furl = f"http://{host}:{port}/bench_failover"
+        warm: list = []
+        for _ in range(10):
+            _typed_fire(furl, warm, lock)
+        samples: list = []  # (t_rel, status)
+        stop = threading.Event()
+        slock = threading.Lock()
+        bench_t0 = time.perf_counter()
+
+        def steady_client():
+            while not stop.is_set():
+                one: list = []
+                olock = threading.Lock()
+                t_sent = time.perf_counter() - bench_t0
+                _typed_fire(furl, one, olock)
+                with slock:
+                    samples.append((t_sent, one[0][0]))
+
+        clients = [threading.Thread(target=steady_client)
+                   for _ in range(4)]
+        for c in clients:
+            c.start()
+        time.sleep(failover_window_s * 0.3)
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+        _, replicas, _, _ = ray_tpu.get(
+            [ctrl.get_replicas.remote("bench_failover")], timeout=10)[0]
+        victim_pid = ray_tpu.get([replicas[0].pid.remote()], timeout=10)[0]
+        if victim_pid in (os.getpid(), os.getppid()):
+            raise RuntimeError("refusing to SIGKILL the driver")
+        from ray_tpu.common.status import ActorDiedError
+
+        t_kill = time.perf_counter() - bench_t0
+        os.kill(victim_pid, signal.SIGKILL)
+        recovery_s = None
+        deadline = time.perf_counter() + 60
+        try:
+            while time.perf_counter() < deadline:
+                # the controller's view holds the corpse until its next
+                # probe cycle: pinging it raises — keep polling
+                try:
+                    _, reps, _, _ = ray_tpu.get(
+                        [ctrl.get_replicas.remote("bench_failover")],
+                        timeout=10)[0]
+                    pids = (ray_tpu.get([r.pid.remote() for r in reps],
+                                        timeout=5)
+                            if len(reps) == 2 else [])
+                except (ActorDiedError, ConnectionError, TimeoutError):
+                    pids = []
+                if pids and victim_pid not in pids:
+                    recovery_s = time.perf_counter() - bench_t0 - t_kill
+                    break
+                time.sleep(0.1)
+            remaining = failover_window_s - (time.perf_counter() - bench_t0)
+            if remaining > 0:
+                time.sleep(remaining)
+        finally:
+            stop.set()  # clients must stop even when the poll raises
+        for c in clients:
+            c.join(timeout=120)
+        if recovery_s is None:
+            raise RuntimeError("failover: replica never respawned")
+        with slock:
+            data = list(samples)
+        untyped = [(t, s) for t, s in data if s not in (200, 429, 503)]
+        if untyped:
+            raise RuntimeError(f"failover: untyped responses "
+                               f"{untyped[:5]} — replica death must "
+                               "surface as retry-to-200 or typed shed")
+        errs = [t for t, s in data if s != 200]
+        pre = [t for t, s in data if s == 200 and t < t_kill]
+        post_start = t_kill + recovery_s
+        post = [t for t, s in data if s == 200 and t >= post_start]
+        post_span = (time.perf_counter() - bench_t0) - post_start
+        rows.append({
+            "metric": "proxy_failover_rps_recovered",
+            "value": round(len(post) / post_span, 1)
+            if post_span > 0 else 0.0,
+            "unit": "requests/s",
+            "pre_kill_rps": round(len(pre) / t_kill, 1),
+            "error_window_s": round(max(errs) - min(errs), 3)
+            if errs else 0.0,
+            "recovery_s": round(recovery_s, 2),
+            "typed_errors": len(errs),
+            "untyped_errors": 0,
+            "clients": 4,
+            "rt_faults": os.environ.get("RT_FAULTS"),
+        })
+        serve.delete("bench_failover")
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    return {"results": rows}
+
+
 PROXY_CAPTION = (
     "proxy rows are CPU orchestration cost by design (PERF_PLAN round-11): "
     "they measure the proxy→handle→replica→response path end to end — "
@@ -474,7 +699,17 @@ PROXY_CAPTION = (
     "the async-native path (get_async + micro-batched dispatch + "
     "push-based SSE). sse_tokens_per_second is engine-rate-bound on this "
     "1-core CPU box — the round-11 win there is protocol shape (push, "
-    "no poll RPCs), not throughput.")
+    "no poll RPCs), not throughput. "
+    "proxy_overload_accepted_rps (round-18, --overload) drives an "
+    "open-loop burst at ~3x replica capacity: value is the RPS of "
+    "ACCEPTED (200) requests, shed_pct the fraction answered with a "
+    "typed 503/429 BEFORE dispatch, p99_accepted_ms vs p99_unloaded_ms "
+    "the latency-protection claim. proxy_failover_rps_recovered "
+    "SIGKILLs one of two replicas under steady load with "
+    "serve.replica.call armed (nth:40) in the replica workers: value is "
+    "post-recovery RPS; error_window_s / recovery_s bound the typed "
+    "error window and respawn. both chaos rows raise on any unanswered "
+    "or untyped (non-200/429/503) response.")
 
 
 def _merge_proxy_section(proxy: dict) -> None:
@@ -496,11 +731,18 @@ def _merge_proxy_section(proxy: dict) -> None:
     if os.path.exists("BENCH_serve.json"):
         with open("BENCH_serve.json") as f:
             doc = json.load(f)
-    old_rows = {r.get("metric"): r
-                for r in doc.get("proxy", {}).get("results", [])}
+    old_proxy = doc.get("proxy", {})
+    old_rows = {r.get("metric"): r for r in old_proxy.get("results", [])}
     proxy = dict(proxy)
-    proxy["results"] = bench_guard._merge_rows(proxy.get("results", []),
-                                               old_rows)
+    fresh_rows = proxy.get("results", [])
+    fresh_metrics = {r.get("metric") for r in fresh_rows}
+    merged = bench_guard._merge_rows(fresh_rows, old_rows)
+    # --proxy and --overload write DISJOINT row sets into one section:
+    # rows this invocation never measures must survive the merge
+    merged += [row for m, row in old_rows.items() if m not in fresh_metrics]
+    proxy["results"] = merged
+    for k, v in old_proxy.items():  # section keys this run lacks
+        proxy.setdefault(k, v)
     proxy["caption"] = PROXY_CAPTION
     doc["proxy"] = proxy
     with open("BENCH_serve.json", "w") as f:
@@ -518,6 +760,15 @@ def main():
         proxy = run_proxy_bench()
         _merge_proxy_section(proxy)
         print(json.dumps(proxy["results"], indent=1))
+        return 0
+
+    if "--overload" in sys.argv:
+        # overload shed + SIGKILL failover chaos rows: answered-typed is
+        # asserted inside; merged into the proxy section next to the
+        # plain RPS rows
+        section = run_overload_bench()
+        _merge_proxy_section(section)
+        print(json.dumps(section["results"], indent=1))
         return 0
 
     tpu_ok, reason = _tpu_responsive()

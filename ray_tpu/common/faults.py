@@ -125,6 +125,22 @@ FAULT_POINTS: Dict[str, str] = {
     "pubsub.publish": (
         "Publisher.publish — the message is silently DROPPED (not "
         "raised) to model a lost control-plane event"),
+    "serve.replica.call": (
+        "Serve replica harness, before invoking the user callable for a "
+        "unary or micro-batched request — the whole call fails like a "
+        "torn transport; the proxy re-routes to a fresh replica"),
+    "serve.replica.stream": (
+        "Serve replica harness, before the streaming generator yields "
+        "its first item — mid-stream replica death; the proxy surfaces "
+        "a clean `event: error` SSE frame"),
+    "serve.proxy.write": (
+        "ProxyActor HTTP write path, before response/chunk bytes hit "
+        "the socket — the client connection tears mid-write; the "
+        "listener and other connections stay healthy"),
+    "serve.controller.probe": (
+        "ServeController health probe, before pinging a replica — a "
+        "lost/slow probe; flap damping requires failure_threshold "
+        "consecutive misses before ejecting the replica"),
 }
 
 # --------------------------------------------------------------------------

@@ -11,10 +11,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional
+
+from ray_tpu.common import faults
 
 logger = logging.getLogger(__name__)
 
@@ -34,7 +37,7 @@ class Replica:
     (reference ``python/ray/serve/_private/replica.py``)."""
 
     def __init__(self, cls_blob: bytes, init_args, init_kwargs,
-                 max_ongoing: int = 8):
+                 max_ongoing: int = 8, version: int = 0):
         import cloudpickle
 
         cls = cloudpickle.loads(cls_blob)
@@ -43,10 +46,24 @@ class Replica:
         self._total = 0
         self._lock = threading.Lock()
         self._max_ongoing = max(1, int(max_ongoing))
+        self._version = int(version)
         self._batch_pool = None  # lazy: only batched callers pay for it
 
     def ping(self) -> bool:
+        # A user-defined check_health() makes the controller's probe see
+        # application health, not just process liveness (reference:
+        # Serve replica health checks call the user's check_health).
+        check = getattr(self._user, "check_health", None)
+        if callable(check):
+            check()
         return True
+
+    def pid(self) -> int:
+        """Worker process pid — chaos tests SIGKILL a replica through this."""
+        return os.getpid()
+
+    def version(self) -> int:
+        return self._version
 
     def get_metrics(self) -> Dict[str, Any]:
         from ray_tpu.serve import multiplex
@@ -54,6 +71,7 @@ class Replica:
         with self._lock:
             return {"ongoing": float(self._ongoing),
                     "total": float(self._total),
+                    "version": self._version,
                     "model_ids": multiplex.loaded_model_ids(self._user)}
 
     def supports_generator_stream(self) -> bool:
@@ -67,6 +85,7 @@ class Replica:
         items push to the caller via ``num_returns="streaming"`` —
         per-item delivery with owner-side backpressure, no poll RPCs
         (reference: Serve response streaming over ObjectRefGenerator)."""
+        faults.fault_point("serve.replica.stream")
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -83,11 +102,18 @@ class Replica:
         harness pool (sized to ``max_ongoing_requests``) so a batch of
         blocking handlers keeps the latency profile of independent calls;
         per-item exceptions come back as :class:`_ItemError` so one bad
-        request cannot fail its batchmates."""
+        request cannot fail its batchmates.  Transport-typed failures
+        (``ConnectionError``, which includes injected faults) are the
+        exception to per-item isolation: they mean THIS replica's
+        transport is suspect, so the whole call raises and the proxy
+        re-routes the entire batch to a fresh replica instead of handing
+        batchmates a 500."""
         if len(calls) == 1:
             args, kwargs = calls[0]
             try:
                 return [self.handle_request(method, args, kwargs)]
+            except ConnectionError:
+                raise  # whole-call failure: proxy retries on a fresh replica
             except Exception as e:  # noqa: BLE001 — per-item isolation
                 return [_ItemError(e)]
         if self._batch_pool is None:
@@ -104,11 +130,17 @@ class Replica:
                 return _ItemError(e)
 
         futures = [self._batch_pool.submit(run, a, k) for a, k in calls]
-        return [f.result() for f in futures]
+        results = [f.result() for f in futures]
+        for res in results:
+            if isinstance(res, _ItemError) and isinstance(
+                    res.error, ConnectionError):
+                raise res.error
+        return results
 
     def handle_request(self, method: str, args, kwargs):
         from ray_tpu.serve import multiplex
 
+        faults.fault_point("serve.replica.call")
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -131,6 +163,7 @@ class ServeController:
 
     RECONCILE_INTERVAL_S = 0.25
     PING_FAILURE_THRESHOLD = 3
+    PING_TIMEOUT_S = 10.0
 
     def __init__(self):
         # name -> {"deployment": Deployment, "blob": bytes, "args", "kwargs",
@@ -169,45 +202,65 @@ class ServeController:
                init_args, init_kwargs) -> bool:
         import cloudpickle
 
-        import ray_tpu
-
-        del ray_tpu  # draining handles teardown; no direct kills here
         dep = cloudpickle.loads(deployment_blob)
+        target = (dep.autoscaling_config.min_replicas
+                  if dep.autoscaling_config else dep.num_replicas)
+        abandoned: List[Any] = []
         with self._lock:
             prev = self._apps.get(name)
-            self._apps[name] = {
-                "deployment": dep,
-                "cls_blob": cls_blob,
-                "args": init_args,
-                "kwargs": init_kwargs,
-                # Redeploy REPLACES replicas: old ones run old code until
-                # their in-flight requests finish (graceful drain,
-                # reference: deployment_state.py graceful_shutdown).
-                "replicas": [],
-                "target": (dep.autoscaling_config.min_replicas
-                           if dep.autoscaling_config else dep.num_replicas),
-            }
-            if prev:
-                for r in prev["replicas"]:
-                    self._draining.append(
-                        {"replica": r, "since": time.monotonic()})
+            if prev is None:
+                self._apps[name] = {
+                    "deployment": dep,
+                    "cls_blob": cls_blob,
+                    "args": init_args,
+                    "kwargs": init_kwargs,
+                    "replicas": [],
+                    "target": target,
+                    "version": 1,
+                    "next": None,
+                }
+            else:
+                # Rolling upgrade (reference: deployment_state.py rolling
+                # update): the OLD replica set keeps serving while the new
+                # version's replicas start and warm; the reconcile thread
+                # swaps serving sets only once every new replica answers a
+                # ping, then drains the old set.  Requests arriving
+                # mid-roll therefore always land on a live, warm replica.
+                old_next = prev.get("next")
+                if old_next:
+                    abandoned = list(old_next["replicas"])
+                prev["next"] = {
+                    "deployment": dep,
+                    "cls_blob": cls_blob,
+                    "args": init_args,
+                    "kwargs": init_kwargs,
+                    "replicas": [],
+                    "target": target,
+                    "version": prev.get("version", 1) + 1,
+                }
             self._version += 1
             self._route_version += 1
+        self._kill_replicas(abandoned)
         return True
 
-    def delete_app(self, name: str) -> bool:
+    def _kill_replicas(self, replicas) -> None:
         import ray_tpu
 
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def delete_app(self, name: str) -> bool:
         with self._lock:
             app = self._apps.pop(name, None)
             self._version += 1
             self._route_version += 1
         if app:
-            for r in app["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+            self._kill_replicas(app["replicas"])
+            if app.get("next"):
+                self._kill_replicas(app["next"]["replicas"])
         return True
 
     def shutdown(self) -> bool:
@@ -258,6 +311,8 @@ class ServeController:
                     "running_replicas": len(app["replicas"]),
                     "autoscaling": app["deployment"].autoscaling_config
                     is not None,
+                    "version": app.get("version", 1),
+                    "rolling": app.get("next") is not None,
                 }
                 for name, app in self._apps.items()
             }
@@ -366,7 +421,16 @@ class ServeController:
         except Exception:  # noqa: BLE001 — dashboarding must never
             pass           # interfere with reconciliation
 
-    DRAIN_TIMEOUT_S = 10.0
+    def _enqueue_drain(self, replica, dep) -> None:
+        """Must be called with self._lock held.  The drain deadline is the
+        deployment's own graceful_shutdown_timeout_s — in-flight work
+        (including SSE streams, which hold ``ongoing`` > 0 for their whole
+        lifetime) gets that long to finish before the replica is killed."""
+        self._draining.append({
+            "replica": replica,
+            "since": time.monotonic(),
+            "timeout": getattr(dep, "graceful_shutdown_timeout_s", 10.0),
+        })
 
     def _drain_old_replicas(self):
         import ray_tpu
@@ -382,7 +446,7 @@ class ServeController:
                 done = m["ongoing"] <= 0
             except Exception:  # noqa: BLE001 — dead already
                 done = True
-            if done or time.monotonic() - since > self.DRAIN_TIMEOUT_S:
+            if done or time.monotonic() - since > d.get("timeout", 10.0):
                 try:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
@@ -392,22 +456,99 @@ class ServeController:
         with self._lock:
             self._draining = still
 
+    def _advance_rollouts(self):
+        """Drive in-progress rolling upgrades: start the next version's
+        replicas, wait for every one to answer a ping (warm), then swap
+        the serving set atomically and drain the old one.  A next-version
+        replica that fails ``PING_FAILURE_THRESHOLD`` consecutive probes
+        is replaced; while a roll cannot complete the OLD set keeps
+        serving, so a broken new version degrades to a stalled roll —
+        never to 5xx."""
+        import ray_tpu
+
+        with self._lock:
+            rolling = [(name, app) for name, app in self._apps.items()
+                       if app.get("next")]
+        for name, app in rolling:
+            nxt = app["next"]
+            while True:
+                with self._lock:
+                    if app.get("next") is not nxt:  # restaged mid-start
+                        break
+                    need = nxt["target"] - len(nxt["replicas"])
+                if need <= 0:
+                    break
+                r = self._start_replica(name, nxt)
+                with self._lock:
+                    if app.get("next") is nxt:
+                        nxt["replicas"].append(r)
+                    else:
+                        self._kill_replicas([r])
+                        break
+            ready = 0
+            for i, r in enumerate(list(nxt["replicas"])):
+                key = r._actor_id.hex()
+                try:
+                    faults.fault_point("serve.controller.probe")
+                    ray_tpu.get([r.ping.remote()],
+                                timeout=self.PING_TIMEOUT_S)
+                    self._ping_failures.pop(key, None)
+                    ready += 1
+                except Exception:  # noqa: BLE001 — still warming or dead
+                    fails = self._ping_failures.get(key, 0) + 1
+                    self._ping_failures[key] = fails
+                    if fails >= self.PING_FAILURE_THRESHOLD:
+                        logger.warning(
+                            "next-version replica of %s failed %d probes "
+                            "during rollout; replacing", name, fails)
+                        self._ping_failures.pop(key, None)
+                        self._kill_replicas([r])
+                        nxt["replicas"][i] = self._start_replica(name, nxt)
+            if ready < nxt["target"]:
+                continue
+            with self._lock:
+                if self._apps.get(name) is not app or app.get("next") is not nxt:
+                    continue  # app deleted or roll restaged meanwhile
+                old_replicas = app["replicas"]
+                old_dep = app["deployment"]
+                app.update(
+                    deployment=nxt["deployment"],
+                    cls_blob=nxt["cls_blob"],
+                    args=nxt["args"],
+                    kwargs=nxt["kwargs"],
+                    replicas=nxt["replicas"],
+                    target=nxt["target"],
+                    version=nxt["version"],
+                    next=None,
+                )
+                for r in old_replicas:
+                    self._enqueue_drain(r, old_dep)
+                self._version += 1
+                self._route_version += 1
+            logger.info("rolled %s to version %d (%d replicas warm)",
+                        name, app["version"], len(app["replicas"]))
+
     def _reconcile_once(self):
         import ray_tpu
 
         self._drain_old_replicas()
+        self._advance_rollouts()
         with self._lock:
             apps = list(self._apps.items())
         for name, app in apps:
             dep = app["deployment"]
             # Health check with a consecutive-failure threshold (reference
             # gcs_health_check_manager failure_threshold): one slow ping
-            # under load must not get a busy replica killed.
+            # under load must not get a busy replica killed.  Ejection
+            # bumps self._version, so every handle's next refresh (≤
+            # REFRESH_INTERVAL_S) stops routing to the unhealthy replica.
             alive = []
             for r in app["replicas"]:
                 key = r._actor_id.hex()
                 try:
-                    ray_tpu.get([r.ping.remote()], timeout=10.0)
+                    faults.fault_point("serve.controller.probe")
+                    ray_tpu.get([r.ping.remote()],
+                                timeout=self.PING_TIMEOUT_S)
                     self._ping_failures.pop(key, None)
                     alive.append(r)
                 except Exception:  # noqa: BLE001 — slow or dead
@@ -424,11 +565,13 @@ class ServeController:
                         # on a long request it finishes then dies; the
                         # drain timeout bounds a truly-hung one
                         with self._lock:
-                            self._draining.append(
-                                {"replica": r, "since": time.monotonic()})
+                            self._enqueue_drain(r, dep)
             changed = len(alive) != len(app["replicas"])
 
-            if dep.autoscaling_config is not None and alive:
+            # Mid-roll, the serving target is frozen: autoscale decisions
+            # would fight the swap that is about to replace the set.
+            if (dep.autoscaling_config is not None and alive
+                    and not app.get("next")):
                 app["target"] = self._autoscale_target(dep, alive,
                                                        app["target"])
 
@@ -440,8 +583,7 @@ class ServeController:
                 # (reference deployment_state graceful_shutdown).
                 victim = alive.pop()
                 with self._lock:
-                    self._draining.append(
-                        {"replica": victim, "since": time.monotonic()})
+                    self._enqueue_drain(victim, dep)
                 changed = True
             with self._lock:
                 if name in self._apps:
@@ -449,10 +591,12 @@ class ServeController:
                     if changed:
                         self._version += 1
 
-    def _start_replica(self, name: str, app: dict):
+    def _start_replica(self, name: str, spec: dict):
+        """``spec`` is either an app dict or its staged ``next`` dict —
+        both carry deployment/cls_blob/args/kwargs/version."""
         import ray_tpu
 
-        dep = app["deployment"]
+        dep = spec["deployment"]
         opts = dict(dep.ray_actor_options)
         opts.setdefault("max_concurrency", dep.max_ongoing_requests)
         # Deployment scheduler (reference
@@ -462,10 +606,12 @@ class ServeController:
         # a local replica to route to. Explicit strategies win.
         opts.setdefault("scheduling_strategy", "SPREAD")
         remote_cls = ray_tpu.remote(Replica)
-        logger.info("starting replica of %s", name)
+        logger.info("starting replica of %s (version %d)",
+                    name, spec.get("version", 1))
         return remote_cls.options(**opts).remote(
-            app["cls_blob"], app["args"], app["kwargs"],
-            max_ongoing=dep.max_ongoing_requests)
+            spec["cls_blob"], spec["args"], spec["kwargs"],
+            max_ongoing=dep.max_ongoing_requests,
+            version=spec.get("version", 1))
 
     def _autoscale_target(self, dep, replicas: List[Any],
                           current: int) -> int:

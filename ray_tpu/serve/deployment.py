@@ -38,6 +38,10 @@ class Deployment:
     # engine-side prefix cache hits (reference: serve request_router/
     # prefix-aware router over vLLM's prefix caching).
     request_router: str = "pow2"
+    # How long a draining replica (redeploy, downscale, health ejection)
+    # may finish in-flight work — including open SSE streams — before the
+    # controller kills it (reference: graceful_shutdown_timeout_s).
+    graceful_shutdown_timeout_s: float = 10.0
 
     def options(self, **kwargs) -> "Deployment":
         return dataclasses.replace(self, **kwargs)
@@ -68,7 +72,8 @@ def make_deployment(func_or_class=None, *, name: Optional[str] = None,
                     ray_actor_options: Optional[dict] = None,
                     autoscaling_config: Optional[dict] = None,
                     route_prefix: Optional[str] = None,
-                    request_router: str = "pow2") -> Any:
+                    request_router: str = "pow2",
+                    graceful_shutdown_timeout_s: float = 10.0) -> Any:
     def wrap(target):
         import functools
 
@@ -88,6 +93,7 @@ def make_deployment(func_or_class=None, *, name: Optional[str] = None,
             autoscaling_config=asc,
             route_prefix=route_prefix,
             request_router=request_router,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
         )
 
     if func_or_class is not None:

@@ -232,6 +232,30 @@ class _RouterState:
             self.outstanding[idx] = max(
                 0, self.outstanding.get(idx, count) - count)
 
+    def mark_dead(self, actor_id) -> None:
+        """Router-local health view: drop a replica the data plane just
+        watched die, WITHOUT waiting for the controller's health probes to
+        notice.  The controller's own ejection bumps the replica-set
+        version, so the next refresh re-syncs; until then this keeps
+        retries off the corpse.  (``_apply_refresh`` only rewrites the
+        set on a version change, so the local removal is not resurrected
+        by a same-version refresh.)"""
+        try:
+            dead_hex = actor_id.hex()
+        except AttributeError:
+            dead_hex = str(actor_id)
+        with self.lock:
+            keep = [r for r in self.replicas
+                    if r._actor_id.hex() != dead_hex]
+            if len(keep) == len(self.replicas):
+                return
+            self.replicas = keep
+            # indices changed meaning: reset load + affinity tables (the
+            # blip in load accounting is noise next to a replica death)
+            self.outstanding = {i: 0 for i in range(len(keep))}
+            self._prefix_owner.clear()
+            self._model_owner.clear()
+
 
 def _rebuild_handle(name, controller, method, model_id=None):
     return DeploymentHandle(name, controller, _method=method,

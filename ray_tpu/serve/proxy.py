@@ -35,6 +35,7 @@ import collections
 import dataclasses
 import json
 import logging
+import math
 import pickle
 import time
 import urllib.parse
@@ -44,7 +45,8 @@ from typing import Any, Dict, List, Optional, Tuple
 # inside the handler costs ~10µs of import machinery per call at proxy
 # request rates (no cycle: ray_tpu.api never imports serve)
 from ray_tpu.api import get_async
-from ray_tpu.common.status import ActorDiedError
+from ray_tpu.common import faults
+from ray_tpu.common.status import ActorDiedError, TaskError
 from ray_tpu.serve.controller import _ItemError
 
 logger = logging.getLogger(__name__)
@@ -188,12 +190,17 @@ class _StageStats:
             tag_keys=("stage",))
         self._requests_total = Counter(
             "rt_serve_requests_total", "requests dispatched by the proxy")
+        self._shed_counter = Counter(
+            "rt_serve_shed_total",
+            "requests shed by admission control before dispatch",
+            tag_keys=("status",))
         self._hops_counter = Counter(
             "rt_serve_executor_hops_total",
             "run_in_executor hops taken on the proxy request path "
             "(async-native contract: zero)")
         self.requests = 0
         self.executor_hops = 0
+        self.shed: Dict[int, int] = collections.Counter()
         self.stream_protocols: Dict[str, int] = collections.Counter()
         self.batch_sizes: Dict[int, int] = collections.Counter()
         self._samples: Dict[str, collections.deque] = {
@@ -214,6 +221,10 @@ class _StageStats:
         self.executor_hops += 1
         self._hops_counter.inc()
 
+    def count_shed(self, status: int) -> None:
+        self.shed[status] += 1
+        self._shed_counter.inc(tags={"status": str(status)})
+
     def snapshot(self) -> Dict[str, Any]:
         stages = {}
         for stage, buf in self._samples.items():
@@ -229,6 +240,7 @@ class _StageStats:
             }
         return {"requests": self.requests,
                 "executor_hops": self.executor_hops,
+                "shed": {str(k): v for k, v in sorted(self.shed.items())},
                 "stream_protocols": dict(self.stream_protocols),
                 "batch_sizes": {str(k): v
                                 for k, v in sorted(self.batch_sizes.items())},
@@ -263,6 +275,86 @@ class _RouteMatcher:
             if path.startswith(pref):
                 return (norm, handle)
         return self.root
+
+
+class _Admission:
+    """Per-route admission control + load shedding.
+
+    The proxy answers overload BEFORE dispatch, so excess traffic never
+    reaches a replica and accepted-traffic p99 stays flat.  The budget is
+    ``capacity + queue``: capacity is ``max_ongoing_requests × healthy
+    replicas`` from the handle's router view (which the controller's
+    health probes and the data plane's ``mark_dead`` keep current), and
+    queue is sized from the route's replica-latency EWMA (the batcher's)
+    so admitted-but-queued work clears within ``QUEUE_WAIT_BUDGET_S`` —
+    bounding how far past an unloaded p99 an accepted request can land.
+
+    Past the budget: a typed ``503`` with ``Retry-After`` derived from
+    the same EWMA, or ``429`` when at least two clients compete and this
+    one already holds its fair share of the budget (single-client
+    overload is plain overload, not a fairness violation).  All counters
+    live on the proxy's event loop — no lock.
+    """
+
+    __slots__ = ("handle", "inflight", "per_client", "shed_503", "shed_429")
+
+    QUEUE_WAIT_BUDGET_S = 0.2
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.inflight = 0
+        self.per_client: Dict[str, int] = {}
+        self.shed_503 = 0
+        self.shed_429 = 0
+
+    def budget(self) -> Tuple[int, int, float]:
+        """(budget, capacity, ewma_s) from the live router view."""
+        state = self.handle._state
+        with state.lock:
+            n = len(state.replicas)
+            max_ongoing = state.max_ongoing
+        capacity = max(1, max_ongoing) * max(1, n)
+        batcher = getattr(self.handle, "_proxy_batcher", None)
+        ewma = batcher.ewma if batcher is not None else 0.0
+        if ewma <= self.QUEUE_WAIT_BUDGET_S:  # fast (or cold) route
+            queue = capacity
+        else:  # slow route: only as much queue as clears in the budget
+            queue = max(1, int(capacity * self.QUEUE_WAIT_BUDGET_S / ewma))
+        return capacity + queue, capacity, ewma
+
+    def try_admit(self, client: str):
+        """``None`` admits (and counts) the request; otherwise returns
+        ``(status, retry_after_s, body)`` to answer without dispatching."""
+        budget, capacity, ewma = self.budget()
+        if self.inflight < budget:
+            self.inflight += 1
+            self.per_client[client] = self.per_client.get(client, 0) + 1
+            return None
+        retry_after = max(1, math.ceil(ewma * self.inflight / capacity))
+        n_clients = len(self.per_client)
+        if n_clients >= 2:
+            fair = max(1, budget // n_clients)
+            if self.per_client.get(client, 0) >= fair:
+                self.shed_429 += 1
+                return (429, retry_after,
+                        b"over per-client fair share; retry later")
+        self.shed_503 += 1
+        return (503, retry_after, b"deployment over capacity; retry later")
+
+    def release(self, client: str) -> None:
+        self.inflight = max(0, self.inflight - 1)
+        left = self.per_client.get(client, 0) - 1
+        if left <= 0:
+            self.per_client.pop(client, None)
+        else:
+            self.per_client[client] = left
+
+    def snapshot(self) -> Dict[str, Any]:
+        budget, capacity, ewma = self.budget()
+        return {"inflight": self.inflight, "budget": budget,
+                "capacity": capacity, "ewma_ms": round(ewma * 1000, 3),
+                "clients": len(self.per_client),
+                "shed_503": self.shed_503, "shed_429": self.shed_429}
 
 
 class _Batcher:
@@ -381,8 +473,10 @@ class _Batcher:
         handle = self.handle
         for attempt in range(3):
             # A replica can die between routing and execution (downscale
-            # drain timeout, crash): retry on a fresh replica like the
-            # reference router does before surfacing an error.
+            # drain timeout, crash) or fail with a transport-typed error
+            # (ConnectionError — injected faults included): re-route the
+            # WHOLE batch to a fresh replica like the reference router
+            # does, so one dead replica never fails batchmates.
             if len(reqs) == 1:
                 ref = await handle.remote_async(reqs[0])
             else:
@@ -392,9 +486,22 @@ class _Batcher:
             try:
                 out = await get_async(ref, timeout=120.0)
                 return (out if len(reqs) > 1 else [out]), submit_t
-            except ActorDiedError:
+            except (ActorDiedError, ConnectionError, TaskError) as e:
+                if isinstance(e, TaskError) and not isinstance(
+                        getattr(e, "cause", None), ConnectionError):
+                    raise  # a user exception — 500 is correct, no retry
+                # a ConnectionError raised INSIDE the replica harness
+                # (injected faults included) crosses the object plane
+                # wrapped as TaskError(cause=ConnectionError): transport
+                # is suspect either way, so re-route like a dead replica
                 if attempt == 2:
                     raise
+                dead = getattr(e, "actor_id", None)
+                if dead is not None:
+                    # the data plane saw the corpse before the controller
+                    # did: update the router-local health view so the
+                    # retry cannot land on the same dead replica
+                    handle._state.mark_dead(dead)
                 await handle._state.refresh_async(force=True)
 
 
@@ -497,6 +604,10 @@ class ProxyActor:
         state = self._stats.snapshot()
         state["route_version"] = self._route_version
         state["routes"] = {p: h._name for p, h in self._routes.items()}
+        state["admission"] = {
+            p: h._proxy_admission.snapshot()
+            for p, h in self._routes.items()
+            if getattr(h, "_proxy_admission", None) is not None}
         return state
 
     async def stop(self) -> bool:
@@ -656,33 +767,70 @@ class ProxyActor:
             clock.finish()  # failed requests must not vanish from 'total'
             return
         prefix, handle = match
-        if req.headers.get("accept") == "text/event-stream":
-            await self._dispatch_stream(req, handle, writer, clock)
-            return
-        batcher = getattr(handle, "_proxy_batcher", None)
-        if batcher is None:
-            batcher = _Batcher(handle, self._stats)
-            handle._proxy_batcher = batcher
+        admission = getattr(handle, "_proxy_admission", None)
+        if admission is None:
+            admission = _Admission(handle)
+            handle._proxy_admission = admission
+        client = req.headers.get("x-client-id") or self._peer_key(writer)
         try:
-            # Dispatch + reply wait are awaits on THIS loop — no thread
-            # hop, no blocking get; concurrent arrivals coalesce into one
-            # batched actor call (the batcher records queue/replica laps).
-            result = await batcher.call(req)
-        except Exception as e:  # noqa: BLE001 — replica/user error → 500
-            await self._write_response(
-                writer, 500, "text/plain",
-                f"deployment error: {e}".encode()[:4096])
-            # tail latency during incidents must include the failures —
-            # a 'total' computed only from successes understates exactly
-            # when it matters
+            # the budget reads capacity off the router view; refresh it
+            # first (a cached no-op within REFRESH_INTERVAL_S) so
+            # admission tracks `max_ongoing × healthy replicas`, not the
+            # cold-handle default
+            await handle._state.refresh_async()
+        except Exception:  # noqa: BLE001 — stale view beats failing closed
+            pass
+        shed = admission.try_admit(client)
+        if shed is not None:
+            # Load shedding happens HERE, before any dispatch work: the
+            # replica never sees the request, so accepted traffic keeps
+            # its latency profile while excess gets a typed answer.
+            status, retry_after, msg = shed
+            self._stats.count_shed(status)
+            await self._write_response(writer, status, "text/plain", msg,
+                                       {"retry-after": str(retry_after)})
             clock.finish()
             return
-        clock.skip()
-        status, ctype, body, extra = _render(result)
-        clock.lap("render")
-        await self._write_response(writer, status, ctype, body, extra)
-        clock.lap("write")
-        clock.finish()
+        try:
+            if req.headers.get("accept") == "text/event-stream":
+                await self._dispatch_stream(req, handle, writer, clock)
+                return
+            batcher = getattr(handle, "_proxy_batcher", None)
+            if batcher is None:
+                batcher = _Batcher(handle, self._stats)
+                handle._proxy_batcher = batcher
+            try:
+                # Dispatch + reply wait are awaits on THIS loop — no thread
+                # hop, no blocking get; concurrent arrivals coalesce into one
+                # batched actor call (the batcher records queue/replica laps).
+                result = await batcher.call(req)
+            except Exception as e:  # noqa: BLE001 — replica/user error → 500
+                await self._write_response(
+                    writer, 500, "text/plain",
+                    f"deployment error: {e}".encode()[:4096])
+                # tail latency during incidents must include the failures —
+                # a 'total' computed only from successes understates exactly
+                # when it matters
+                clock.finish()
+                return
+            clock.skip()
+            status, ctype, body, extra = _render(result)
+            clock.lap("render")
+            await self._write_response(writer, status, ctype, body, extra)
+            clock.lap("write")
+            clock.finish()
+        finally:
+            # SSE streams hold their admission slot for the whole stream
+            # life (they run inside this try), so long streams count
+            # toward the route budget exactly like in-flight unary calls.
+            admission.release(client)
+
+    @staticmethod
+    def _peer_key(writer: asyncio.StreamWriter) -> str:
+        """Fair-share client identity: explicit ``x-client-id`` header
+        wins (set by trusted edge LBs); otherwise the peer address."""
+        peer = writer.get_extra_info("peername")
+        return peer[0] if isinstance(peer, tuple) else str(peer)
 
     # --------------------------------------------------------------- sse
     async def _replica_supports_generator(self, replica) -> bool:
@@ -823,17 +971,23 @@ class ProxyActor:
 
     @staticmethod
     async def _write_chunk(writer: asyncio.StreamWriter, data: bytes):
+        faults.fault_point("serve.proxy.write")
         writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
         await writer.drain()
 
     _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                413: "Payload Too Large", 500: "Internal Server Error",
-                501: "Not Implemented"}
+                413: "Payload Too Large", 429: "Too Many Requests",
+                500: "Internal Server Error", 501: "Not Implemented",
+                503: "Service Unavailable"}
 
     @classmethod
     async def _write_response(cls, writer: asyncio.StreamWriter, status: int,
                               ctype: str, body: bytes,
                               extra: Optional[Dict[str, str]] = None):
+        # FaultInjected is a ConnectionError: an injected write fault
+        # tears THIS connection (the conn loop's handler closes it) and
+        # nothing else — the listener and other connections stay healthy.
+        faults.fault_point("serve.proxy.write")
         # ONE coalesced write per response (head + body in a single
         # buffer hand-off); drain is a no-op below the transport
         # high-water mark, so pipelined small responses never stall here

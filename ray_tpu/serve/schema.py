@@ -34,7 +34,7 @@ KV_APPLY_STATUS_KEY = b"declarative_apply_status"
 # deployment-level fields an operator may override without touching code
 _DEPLOYMENT_OVERRIDES = (
     "num_replicas", "max_ongoing_requests", "route_prefix",
-    "request_router",
+    "request_router", "graceful_shutdown_timeout_s",
 )
 
 

@@ -9,7 +9,9 @@ Guarded artifacts:
   native-dispatch + warm-pool + control-plane work is graded on.
 - ``BENCH_serve.json`` proxy section (``--fresh-serve``): the round-11
   Serve data-plane rows (proxy RPS, handle-only calls/s, SSE tokens/s)
-  written by ``python bench_serve.py --proxy``.
+  written by ``python bench_serve.py --proxy``, plus the round-18 chaos
+  rows (overload-shed accepted RPS, SIGKILL-failover recovered RPS)
+  written by ``python bench_serve.py --overload`` into the same section.
 - ``BENCH_data.json`` (``--fresh-data``): the round-12 GB-scale groupby
   shuffle row (streaming shuffle engine + async spill path) written by
   ``python bench_data.py --out <dir>/BENCH_data.json``.
@@ -74,6 +76,13 @@ GUARDED_SERVE_ROWS = (
     "proxy_rps_plain",
     "handle_calls_per_second",
     "sse_tokens_per_second",
+    # round-18 (ISSUE 18) chaos rows, written by ``python bench_serve.py
+    # --overload`` into the same proxy section: accepted throughput
+    # under a ~3x open-loop burst (admission control sheds the rest as
+    # typed 503/429) and post-recovery throughput after a replica
+    # SIGKILL under load with serve.replica.call armed in the workers.
+    "proxy_overload_accepted_rps",
+    "proxy_failover_rps_recovered",
 )
 
 # The round-12 Data-plane row (ISSUE 10 acceptance): GB-scale groupby
@@ -245,7 +254,13 @@ def _capture_serve(fresh_path: str, checked_in: str, ref: dict) -> None:
         with open(checked_in) as f:
             doc = json.load(f)
     proxy = dict(fresh_doc.get("proxy", {}))
-    proxy["results"] = _merge_rows(proxy.get("results", []), ref)
+    fresh_rows = proxy.get("results", [])
+    fresh_metrics = {r.get("metric") for r in fresh_rows}
+    merged = _merge_rows(fresh_rows, ref)
+    # --proxy and --overload write disjoint row sets into one section:
+    # rows the fresh run never measures survive the capture
+    merged += [row for m, row in ref.items() if m not in fresh_metrics]
+    proxy["results"] = merged
     old_proxy = doc.get("proxy", {})
     for k, v in old_proxy.items():  # keep captions the fresh run lacks
         proxy.setdefault(k, v)

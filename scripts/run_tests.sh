@@ -198,6 +198,84 @@ PYEOF
     echo "chaos gate(podracer): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
     fail=$((fail+1))
   fi
+  # Serve leg: a live proxy with serve.replica.call armed in the
+  # ENVIRONMENT (it fires inside each replica worker on its 2nd
+  # request) plus a replica SIGKILLed mid-load.  Every one of the 20
+  # concurrent requests must come back TYPED — 200 after a transparent
+  # re-route, or an admission 429/503 — and the run must never hang
+  # (ISSUE 18 resilience bar).
+  echo "chaos gate: serve overload + replica kill under injected faults..." \
+    | tee -a "$RUN_LOG"
+  if timeout 300 env JAX_PLATFORMS=cpu \
+      RT_FAULTS="serve.replica.call=nth:2" \
+      python - >> "$RUN_LOG" 2>&1 <<'PYEOF'
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.common import faults
+
+assert "serve.replica.call" in faults.active_points(), \
+    "RT_FAULTS did not arm the serve fault point at import"
+ray_tpu.init(num_cpus=4, num_tpus=0)
+addr = serve.start(http_port=0, grpc_port=None)
+
+
+@serve.deployment(name="chaos", num_replicas=2, max_ongoing_requests=4)
+class App:
+    def __call__(self, request):
+        time.sleep(0.05)
+        return "ok"
+
+
+serve.run(App.bind())
+url = f"http://{addr['http_host']}:{addr['http_port']}/chaos"
+codes, lock = [], threading.Lock()
+
+
+def fire():
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url, data=b"x"), timeout=60) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    with lock:
+        codes.append(code)
+
+
+threads = [threading.Thread(target=fire) for _ in range(20)]
+for t in threads:
+    t.start()
+time.sleep(0.1)
+ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+_, replicas, _, _ = ray_tpu.get(
+    [ctrl.get_replicas.remote("chaos")], timeout=10)[0]
+pid = ray_tpu.get([replicas[0].pid.remote()], timeout=10)[0]
+assert pid not in (os.getpid(), os.getppid()), "refusing to kill driver"
+os.kill(pid, signal.SIGKILL)
+for t in threads:
+    t.join(timeout=120)
+assert len(codes) == 20, f"only {len(codes)}/20 answered — a hang"
+assert set(codes) <= {200, 429, 503}, codes
+assert codes.count(200) >= 1, codes
+serve.shutdown()
+ray_tpu.shutdown()
+print("chaos gate(serve): 20/20 requests answered typed through replica"
+      f" kill + injected call faults: {sorted(set(codes))},"
+      f" 200s={codes.count(200)}")
+PYEOF
+  then
+    echo "chaos gate(serve): ok" | tee -a "$RUN_LOG"
+  else
+    echo "chaos gate(serve): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
 fi
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
@@ -244,7 +322,8 @@ fi
 # train-plane bench, and the RL Podracer bench fresh and diff the
 # guarded rows (round-8 core targets + round-11 proxy rows + round-12
 # groupby shuffle row + round-13 multi-node rows + round-16
-# compiled-chain and pipeline rows + round-17 Sebulba/Anakin rows)
+# compiled-chain and pipeline rows + round-17 Sebulba/Anakin rows +
+# round-18 overload-shed / SIGKILL-failover chaos rows)
 # against the committed BENCH_core.json / BENCH_serve.json /
 # BENCH_data.json / BENCH_train.json / BENCH_rl.json (>15% same-box
 # regression fails the run). Off by default — the benches need minutes
@@ -261,6 +340,16 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
     then
       echo "bench guard: serve bench run failed" \
            "(log: $BG_DIR/bench_serve.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
+    echo "bench guard: running bench_serve.py --overload (chaos rows)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          python "$OLDPWD/bench_serve.py" --overload \
+          > bench_overload.log 2>&1)
+    then
+      echo "bench guard: serve --overload bench run failed" \
+           "(log: $BG_DIR/bench_overload.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
     echo "bench guard: running bench_data.py (GB-scale shuffle)..." \
