@@ -688,6 +688,135 @@ def run_overload_bench(burst_factor: float = 3.0, burst_s: float = 3.0,
     return {"results": rows}
 
 
+def run_prefix_bench(model: str = "tiny", num_slots: int = 4,
+                     n_requests: int = 20, shared_frac: float = 0.8,
+                     prefix_len: int = 448, tail_len: int = 16,
+                     max_tokens: int = 8, kv_block_size: int = 64,
+                     max_seq: int = 1024) -> dict:
+    """Shared-prefix traffic (ISSUE 19 acceptance shape): 80% of the
+    requests agree on a ``prefix_len``-token system prompt and diverge
+    only in a ``tail_len``-token tail; the other 20% are unrelated.
+    The same sequential closed loop runs twice — ``prefix_cache="off"``
+    (every request pays the full monolithic prefill) vs
+    ``prefix_cache="radix"`` (a hit adopts the cached blocks and
+    prefills ONLY the suffix) — and the rows report the TTFT ratio and
+    decode throughput. Sequential on purpose: one request in flight
+    isolates the prefill term of TTFT, which is the thing radix reuse
+    changes; under concurrency TTFT is queueing-dominated and the same
+    compute saving hides in scheduling noise. Greedy parity is asserted
+    in-bench: the radix engine must emit byte-identical token streams,
+    or the bench raises instead of reporting a number."""
+    import numpy as np
+
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    rng = np.random.default_rng(7)
+    vocab = llama.CONFIGS[model].vocab_size
+    prefix = [int(t) for t in rng.integers(1, vocab, size=prefix_len)]
+    n_shared = int(n_requests * shared_frac)
+    prompts = []
+    for i in range(n_requests):
+        tail = [int(t) for t in rng.integers(1, vocab, size=tail_len)]
+        if i < n_shared:
+            prompts.append(prefix + tail)
+        else:
+            prompts.append([int(t) for t in rng.integers(
+                1, vocab, size=prefix_len)] + tail)
+    order = [int(i) for i in rng.permutation(n_requests)]
+    # fixed warmup tails (drawn outside the per-engine loop so both
+    # engines see identical token streams): wt1 compiles the monolithic
+    # prefill + decode programs, wt2 hits the radix tree wt1 populated
+    # and compiles the suffix-chunk kernel — all compile cost off the
+    # clock, and the timed radix hits measure steady state
+    wt1 = [max(1, vocab - 2)] * tail_len
+    wt2 = [max(1, vocab - 3)] * tail_len
+
+    out = {}
+    for label, kw in (("cold", {"prefix_cache": "off"}),
+                      ("radix", {"prefix_cache": "radix"})):
+        eng = LLMEngine(model=model, num_slots=num_slots, max_seq=max_seq,
+                        kv_block_size=kv_block_size, seed=0, **kw)
+        for wt in (wt1, wt2):
+            eng.generate(prefix + wt, max_tokens=2)
+        ttfts: list = [None] * n_requests
+        outs: list = [None] * n_requests
+
+        t0 = time.perf_counter()
+        for i in order:
+            tr = time.perf_counter()
+            rid = eng.submit(prompts[i], max_tokens=max_tokens)
+            first, chunks = None, []
+            while True:
+                st = eng.poll(rid)
+                chunks.extend(st["chunks"])
+                if first is None and chunks:
+                    first = time.perf_counter() - tr
+                if st["done"]:
+                    break
+                time.sleep(0.0005)
+            ttfts[i] = (first if first is not None
+                        else time.perf_counter() - tr)
+            outs[i] = chunks
+        wall = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.shutdown()
+        pc = stats.get("prefix_cache", {})
+        out[label] = {
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1000,
+                                 1),
+            "ttft_p95_ms": round(float(np.percentile(ttfts, 95)) * 1000,
+                                 1),
+            "tokens_per_s": round(sum(len(o) for o in outs) / wall, 1),
+            "wall_s": round(wall, 2),
+            "outputs": outs,
+            "prefix_hits": stats.get("prefix_hits", 0),
+            "hit_tokens": pc.get("hit_tokens", 0),
+            "cow_hits": pc.get("cow_hits", 0),
+        }
+
+    bad = [i for i in range(n_requests)
+           if out["radix"]["outputs"][i] != out["cold"]["outputs"][i]]
+    if bad:
+        raise RuntimeError(
+            f"greedy parity violated on requests {bad[:5]}: radix reuse "
+            "must be bit-identical to cold prefill")
+    cold, radix = out["cold"], out["radix"]
+    speedup = (round(cold["ttft_p50_ms"] / radix["ttft_p50_ms"], 2)
+               if radix["ttft_p50_ms"] > 0 else float("inf"))
+    if speedup < 2.0:
+        raise RuntimeError(
+            f"prefix-cache TTFT speedup {speedup}x < 2x acceptance "
+            f"(cold p50 {cold['ttft_p50_ms']}ms, radix p50 "
+            f"{radix['ttft_p50_ms']}ms)")
+    common = {
+        "model": model, "num_slots": num_slots, "n_requests": n_requests,
+        "shared_frac": shared_frac, "prefix_len": prefix_len,
+        "tail_len": tail_len, "max_tokens": max_tokens,
+        "greedy_parity": True,
+        "device": jax.devices()[0].platform,
+    }
+    rows = [
+        dict(common,
+             metric="llm_prefix_ttft_speedup", value=speedup, unit="x",
+             ttft_p50_cold_ms=cold["ttft_p50_ms"],
+             ttft_p50_radix_ms=radix["ttft_p50_ms"],
+             ttft_p95_cold_ms=cold["ttft_p95_ms"],
+             ttft_p95_radix_ms=radix["ttft_p95_ms"],
+             prefix_hits=radix["prefix_hits"],
+             hit_tokens=radix["hit_tokens"],
+             cow_hits=radix["cow_hits"]),
+        dict(common,
+             metric="llm_prefix_decode_tokens_per_s",
+             value=radix["tokens_per_s"], unit="tokens/s",
+             cold_tokens_per_s=cold["tokens_per_s"],
+             wall_radix_s=radix["wall_s"], wall_cold_s=cold["wall_s"]),
+    ]
+    return {"results": rows}
+
+
 PROXY_CAPTION = (
     "proxy rows are CPU orchestration cost by design (PERF_PLAN round-11): "
     "they measure the proxy→handle→replica→response path end to end — "
@@ -709,7 +838,15 @@ PROXY_CAPTION = (
     "serve.replica.call armed (nth:40) in the replica workers: value is "
     "post-recovery RPS; error_window_s / recovery_s bound the typed "
     "error window and respawn. both chaos rows raise on any unanswered "
-    "or untyped (non-200/429/503) response.")
+    "or untyped (non-200/429/503) response. "
+    "llm_prefix_ttft_speedup / llm_prefix_decode_tokens_per_s "
+    "(round-19, --prefix) drive 80%-shared-prefix traffic at the engine "
+    "twice — prefix_cache=off vs radix block reuse — on the same "
+    "sequential closed loop (one request in flight isolates the prefill "
+    "term of TTFT, the thing radix reuse changes): value is cold/radix "
+    "TTFT p50 (acceptance >= 2x, asserted in-bench) and radix tokens/s; "
+    "greedy parity (radix streams bit-identical to cold) is asserted "
+    "before any row is written.")
 
 
 def _merge_proxy_section(proxy: dict) -> None:
@@ -760,6 +897,18 @@ def main():
         proxy = run_proxy_bench()
         _merge_proxy_section(proxy)
         print(json.dumps(proxy["results"], indent=1))
+        return 0
+
+    if "--prefix" in sys.argv:
+        # shared-prefix radix-reuse rows: engine-level (no HTTP), greedy
+        # parity + the >=2x TTFT acceptance asserted inside; merged into
+        # the proxy section so bench_guard's --fresh-serve diff sees them
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        section = run_prefix_bench()
+        _merge_proxy_section(section)
+        print(json.dumps(section["results"], indent=1))
         return 0
 
     if "--overload" in sys.argv:

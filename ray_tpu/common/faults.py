@@ -141,6 +141,16 @@ FAULT_POINTS: Dict[str, str] = {
         "ServeController health probe, before pinging a replica — a "
         "lost/slow probe; flap damping requires failure_threshold "
         "consecutive misses before ejecting the replica"),
+    "serve.llm.prefix_match": (
+        "LLM engine admission, before walking the radix prefix cache — "
+        "the lookup is skipped and the request degrades to a COLD "
+        "prefill with a typed counter bump (prefix_match_faults); no "
+        "shared block is touched and admission never hangs"),
+    "serve.llm.prefix_insert": (
+        "LLM engine, before sharing a finished prefill's blocks into "
+        "the radix tree — the insert is skipped whole with a typed "
+        "counter bump (prefix_insert_faults); the blocks stay owned by "
+        "the slot, so nothing is ever half-inserted or corrupted"),
 }
 
 # --------------------------------------------------------------------------

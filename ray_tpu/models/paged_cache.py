@@ -77,7 +77,15 @@ def init_paged_cache(config: LlamaConfig, page: PagedConfig,
 
 class BlockAllocator:
     """Host-side free-list allocator + block tables. Not thread-safe:
-    owned by the single engine loop, like the rest of the engine state."""
+    owned by the single engine loop, like the rest of the engine state.
+
+    Blocks are ref-counted so the radix prefix cache
+    (:mod:`ray_tpu.models.prefix_cache`) can share one physical block
+    between the tree and any number of slot tables: ``ensure`` hands out
+    private blocks at refcount 1, ``adopt`` aliases already-populated
+    shared blocks into a slot's table (incref), and ``release`` only
+    returns a block to the free list when its last reference drops.
+    A block on the free list always has refcount 0."""
 
     def __init__(self, page: PagedConfig, num_slots: int):
         self.page = page
@@ -86,6 +94,8 @@ class BlockAllocator:
         self.tables = np.zeros((num_slots, page.max_blocks_per_seq),
                                np.int32)
         self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        self._ref = np.zeros(page.num_blocks, np.int32)
+        self._ref[0] = 1             # null block: pinned forever
         self._device_tables = None   # cache: re-upload only after changes
 
     def free_blocks(self) -> int:
@@ -93,6 +103,9 @@ class BlockAllocator:
 
     def blocks_for(self, tokens: int) -> int:
         return -(-tokens // self.page.block_size)
+
+    def refcount(self, block: int) -> int:
+        return int(self._ref[block])
 
     def ensure(self, slot: int, tokens: int) -> bool:
         """Grow ``slot``'s table to cover ``tokens`` cached tokens.
@@ -105,16 +118,85 @@ class BlockAllocator:
             return False
         for _ in range(need):
             b = self._free.pop()
+            self._ref[b] = 1
             self.tables[slot, len(self._owned[slot])] = b
             self._owned[slot].append(b)
         self._device_tables = None
         return True
 
+    def adopt(self, slot: int, blocks: List[int]) -> None:
+        """Alias already-populated shared blocks (a cached prefix) into
+        the next table positions of ``slot``. Each block's refcount is
+        bumped; the slot releases them like its own, but the pool only
+        reclaims a block when every reference is gone."""
+        base = len(self._owned[slot])
+        if base + len(blocks) > self.page.max_blocks_per_seq:
+            raise ValueError("adopt exceeds max_blocks_per_seq")
+        for i, b in enumerate(blocks):
+            self._ref[b] += 1
+            self.tables[slot, base + i] = b
+            self._owned[slot].append(b)
+        self._device_tables = None
+
+    def cow(self, slot: int, idx: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write: swap the shared block at table position
+        ``idx`` of ``slot`` for a fresh private block. Returns
+        (src, dst) so the caller can device-copy the cached rows, or
+        None when the pool has no free block. The shared source keeps
+        its other references."""
+        if not self._free:
+            return None
+        src = self._owned[slot][idx]
+        dst = self._free.pop()
+        self._ref[dst] = 1
+        self._ref[src] -= 1
+        self._owned[slot][idx] = dst
+        self.tables[slot, idx] = dst
+        self._device_tables = None
+        return src, dst
+
+    def ref_blocks(self, blocks: List[int]) -> None:
+        """External holder (the radix tree) takes a reference."""
+        for b in blocks:
+            self._ref[b] += 1
+
+    def unref_blocks(self, blocks: List[int]) -> List[int]:
+        """Drop external references; blocks whose last reference dropped
+        go back on the free list (returned for accounting)."""
+        freed: List[int] = []
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
     def release(self, slot: int) -> None:
-        self._free.extend(reversed(self._owned[slot]))
+        for b in reversed(self._owned[slot]):
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
         self._owned[slot] = []
         self.tables[slot, :] = 0
         self._device_tables = None
+
+    def check_invariants(self) -> None:
+        """Debug/chaos-test oracle: a block is on the free list iff its
+        refcount is 0; no block is freed while any table or the radix
+        tree still references it."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate blocks on free list"
+        assert 0 not in free, "null block leaked onto free list"
+        for b in free:
+            assert self._ref[b] == 0, f"free block {b} has refcount " \
+                f"{int(self._ref[b])}"
+        for b in range(1, self.page.num_blocks):
+            if self._ref[b] == 0:
+                assert b in free, f"refcount-0 block {b} not on free list"
+        for slot, owned in enumerate(self._owned):
+            for b in owned:
+                assert self._ref[b] > 0, f"slot {slot} references " \
+                    f"refcount-0 block {b}"
 
     def device_tables(self) -> jax.Array:
         """Device copy of the tables, re-uploaded only after an
@@ -155,10 +237,14 @@ def make_chunked_paged_prefill(params: Params, config: LlamaConfig,
     chunk(cache, table_row (MBS,), tokens (1, C), true_len-in-chunk,
           start_pos, slot) → (cache, last_logits)
 
-    C and start_pos must be multiples of block_size (the engine enforces
-    prefill_chunk % block_size == 0); the block budget for the WHOLE
-    prompt is ensured at admission, so chunking here only splits the
-    compute, never the allocation.
+    C must be a multiple of block_size; ``start_pos`` may be ANY
+    position (the k/v scatter is row-level, not block-level), which is
+    what lets a radix-prefix-cache hit resume mid-block after a
+    copy-on-write of the divergence block: cached rows before
+    ``start_pos`` stay untouched, new rows land at their exact
+    (block, offset) targets. The block budget for the WHOLE prompt is
+    ensured at admission, so chunking here only splits the compute,
+    never the allocation.
     """
     c = config
     bs = page.block_size
@@ -169,17 +255,18 @@ def make_chunked_paged_prefill(params: Params, config: LlamaConfig,
                        static_argnames=("pad_len",))
     def chunk(cache: PagedCache, table_row, tokens, true_len, start_pos,
               slot, pad_len: int):
-        nblk = pad_len // bs
         x = params["embed"].astype(c.dtype)[tokens]           # (1, C, E)
         rel = jnp.arange(pad_len)
         positions = (start_pos + rel)[None, :]
         mask_valid = rel < true_len                           # (C,)
-        start_blk = start_pos // bs
-        # destination blocks for this chunk; fully-invalid blocks write
-        # into the null block
-        blk_ids = start_blk + jnp.arange(nblk)
-        dest = jnp.where(jnp.arange(nblk) * bs < true_len,
-                         table_row[blk_ids], 0)               # (nblk,)
+        # row-level scatter target: each chunk row lands at its exact
+        # (block, offset); invalid rows write into the null block. This
+        # supports a non-block-aligned start_pos (radix prefix hit with
+        # a copy-on-write divergence block) — rows cached before
+        # start_pos are never touched.
+        row_abs = start_pos + rel
+        row_blk = jnp.where(mask_valid, table_row[row_abs // bs], 0)
+        row_off = row_abs % bs                                # (C,)
 
         def body(x, scanned):
             layer, kc, vc = scanned            # (NB, bs, KV, D)
@@ -189,12 +276,10 @@ def make_chunked_paged_prefill(params: Params, config: LlamaConfig,
             v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(h.dtype))
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-            kb = jnp.where(mask_valid[:, None, None], k[0],
-                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
-            vb = jnp.where(mask_valid[:, None, None], v[0],
-                           0.0).reshape(nblk, bs, c.n_kv_heads, c.head_dim)
-            kc = kc.at[dest].set(kb.astype(kc.dtype))
-            vc = vc.at[dest].set(vb.astype(vc.dtype))
+            kb = jnp.where(mask_valid[:, None, None], k[0], 0.0)  # (C,KV,D)
+            vb = jnp.where(mask_valid[:, None, None], v[0], 0.0)
+            kc = kc.at[row_blk, row_off].set(kb.astype(kc.dtype))
+            vc = vc.at[row_blk, row_off].set(vb.astype(vc.dtype))
             # gather the slot's full row set (prefix + this chunk) and
             # attend with absolute-position causal visibility
             ks = kc[table_row].reshape(MBS * bs, c.n_kv_heads, c.head_dim)
@@ -403,6 +488,26 @@ def make_paged_inject(config: LlamaConfig, page: PagedConfig):
                       jnp.asarray(k), jnp.asarray(v),
                       jnp.asarray(true_len, jnp.int32),
                       jnp.asarray(slot, jnp.int32), pad_len=pad_len)
+
+    return call
+
+
+def make_block_copy(config: LlamaConfig, page: PagedConfig):
+    """copy(cache, src_block, dst_block) → cache. Device-side copy of
+    one pool block's k/v rows across all layers: the copy-on-write
+    primitive behind radix prefix sharing — a slot that must write into
+    a shared block first duplicates it, so the cached original stays
+    read-only for every other reference."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def copy(cache: PagedCache, src, dst):
+        kc = cache["k"].at[:, dst].set(cache["k"][:, src])
+        vc = cache["v"].at[:, dst].set(cache["v"][:, src])
+        return {"k": kc, "v": vc, "length": cache["length"]}
+
+    def call(cache, src: int, dst: int):
+        return copy(cache, jnp.asarray(src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32))
 
     return call
 

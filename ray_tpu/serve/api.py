@@ -210,7 +210,13 @@ def llm_app(model: str = "tiny", *, name: str = "llm",
     {method: draft, draft_model: ..., k: ...}}``). ``speculation`` is
     validated eagerly (SpeculationConfig.parse — the same rules the
     config schema applies, minus its JSON-only restriction), so a bad
-    spec fails at deploy time."""
+    spec fails at deploy time.
+
+    Prefix caching: pass ``prefix_cache="radix"`` (with optional
+    ``prefix_cache_bytes``) through ``engine_kwargs`` and set the
+    deployment override ``request_router: prefix_aware`` so the handle
+    routes shared-prefix traffic at the replica whose radix tree
+    already holds it."""
     from ray_tpu.models.speculation import SpeculationConfig
     from ray_tpu.serve.llm import LLMServer
 

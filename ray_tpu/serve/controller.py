@@ -74,6 +74,22 @@ class Replica:
                     "version": self._version,
                     "model_ids": multiplex.loaded_model_ids(self._user)}
 
+    def get_prefix_digest(self) -> List[int]:
+        """Compact prefix-cache advertisement for prefix-aware routing.
+
+        Delegates to the user object's ``prefix_digest()`` when it has
+        one (the LLM server exposes its radix tree's chunk hashes);
+        anything else — no method, or a digest that fails mid-walk —
+        degrades to an empty hint, never an error: the digest is purely
+        a routing optimization."""
+        fn = getattr(self._user, "prefix_digest", None)
+        if not callable(fn):
+            return []
+        try:
+            return [int(h) for h in fn()]
+        except Exception:  # noqa: BLE001 — hint only
+            return []
+
     def supports_generator_stream(self) -> bool:
         import inspect
 
@@ -192,6 +208,9 @@ class ServeController:
         # declares them) — with a floor between attempts so a persistent
         # import error doesn't spam every reconcile tick
         self._declarative_retry_at = 0.0
+        # {app name: (monotonic stamp, {replica idx: digest})} — see
+        # get_prefix_digests
+        self._digest_cache: Dict[str, tuple] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True, name="serve-reconcile")
@@ -255,6 +274,7 @@ class ServeController:
     def delete_app(self, name: str) -> bool:
         with self._lock:
             app = self._apps.pop(name, None)
+            self._digest_cache.pop(name, None)
             self._version += 1
             self._route_version += 1
         if app:
@@ -280,6 +300,38 @@ class ServeController:
             return (self._version, list(app["replicas"]),
                     app["deployment"].max_ongoing_requests,
                     getattr(app["deployment"], "request_router", "pow2"))
+
+    def get_prefix_digests(self, name: str) -> Dict[int, List[int]]:
+        """{replica index -> prefix digest} for prefix-aware routing.
+
+        Fanned out to the app's replicas with a short timeout and cached
+        briefly: handles refresh on a poll loop, and the digest is a
+        routing *hint* — a couple seconds of staleness just means a
+        request lands on the second-best replica and warms it instead.
+        Indices line up with the replica list ``get_replicas`` returns
+        at the same version; dead/slow replicas simply contribute no
+        entry."""
+        import ray_tpu
+
+        now = time.monotonic()
+        with self._lock:
+            cached = self._digest_cache.get(name)
+            if cached is not None and now - cached[0] < 2.0:
+                return cached[1]
+            app = self._apps.get(name)
+            replicas = list(app["replicas"]) if app else []
+        out: Dict[int, List[int]] = {}
+        for i, r in enumerate(replicas):
+            try:
+                d = ray_tpu.get([r.get_prefix_digest.remote()],
+                                timeout=3.0)[0]
+                if d:
+                    out[i] = [int(h) for h in d]
+            except Exception:  # noqa: BLE001 — hint only
+                continue
+        with self._lock:
+            self._digest_cache[name] = (now, out)
+        return out
 
     def get_route_table(self):
         """(version, {route_prefix: app_name}) for the ingress proxies."""

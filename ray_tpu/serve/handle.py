@@ -96,6 +96,13 @@ class _RouterState:
         # cumulative-prefix hash -> replica index that last served it
         self._prefix_owner: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # replica index -> frozenset of prefix hashes the replica's
+        # engine ADVERTISES as cached (radix-tree digest fetched through
+        # the controller on refresh).  Second-tier routing signal: the
+        # owner table knows what this handle sent; the digest knows what
+        # the replica actually holds — including prefixes warmed by
+        # OTHER handles/proxies.
+        self.replica_digests: Dict[int, frozenset] = {}
         # multiplexed model id -> replica index that last loaded it
         # (reference: multiplexed model routing in request_router/)
         self._model_owner: "collections.OrderedDict" = \
@@ -116,9 +123,16 @@ class _RouterState:
                 self.outstanding = {i: 0 for i in range(len(replicas))}
                 self._prefix_owner.clear()  # indices changed meaning
                 self._model_owner.clear()
+                self.replica_digests = {}
             self.max_ongoing = max_ongoing
             self.router = router
             self.last_refresh = time.monotonic()
+
+    def _apply_digests(self, digests) -> None:
+        with self.lock:
+            self.replica_digests = {
+                int(i): frozenset(int(h) for h in d)
+                for i, d in dict(digests).items()}
 
     def refresh(self, force: bool = False):
         import ray_tpu
@@ -128,6 +142,16 @@ class _RouterState:
         version, replicas, max_ongoing, router = ray_tpu.get(
             [self.controller.get_replicas.remote(self.name)], timeout=30.0)[0]
         self._apply_refresh(version, replicas, max_ongoing, router)
+        if router == "prefix_aware":
+            # What each replica's engine actually caches (vs the local
+            # owner table's what-I-sent view).  Best-effort: a missed
+            # fetch only costs routing quality, never availability.
+            try:
+                self._apply_digests(ray_tpu.get(
+                    [self.controller.get_prefix_digests.remote(self.name)],
+                    timeout=5.0)[0])
+            except Exception:  # noqa: BLE001 — hint only
+                pass
 
     async def refresh_async(self, force: bool = False):
         """Loop-native refresh: awaits the controller reply instead of
@@ -140,6 +164,13 @@ class _RouterState:
         version, replicas, max_ongoing, router = await ray_tpu.get_async(
             self.controller.get_replicas.remote(self.name), timeout=30.0)
         self._apply_refresh(version, replicas, max_ongoing, router)
+        if router == "prefix_aware":
+            try:
+                self._apply_digests(await ray_tpu.get_async(
+                    self.controller.get_prefix_digests.remote(self.name),
+                    timeout=5.0))
+            except Exception:  # noqa: BLE001 — hint only
+                pass
 
     @classmethod
     def _prefix_hashes(cls, key) -> List[int]:
@@ -212,6 +243,19 @@ class _RouterState:
                             self.outstanding.get(owner, 0) < self.max_ongoing:
                         idx = owner
                         break
+                if idx is None and self.replica_digests:
+                    # owner table missed — consult the replicas' own
+                    # advertisements (prefixes warmed through other
+                    # handles still route hot)
+                    for h in hashes:
+                        for cand, dig in self.replica_digests.items():
+                            if h in dig and cand < n and \
+                                    self.outstanding.get(cand, 0) \
+                                    < self.max_ongoing:
+                                idx = cand
+                                break
+                        if idx is not None:
+                            break
             if idx is None:
                 idx = self._pick_pow2()
             for h in hashes:  # adopt/refresh ownership
@@ -255,6 +299,7 @@ class _RouterState:
             self.outstanding = {i: 0 for i in range(len(keep))}
             self._prefix_owner.clear()
             self._model_owner.clear()
+            self.replica_digests = {}
 
 
 def _rebuild_handle(name, controller, method, model_id=None):

@@ -38,6 +38,12 @@ class _Request:
     # per-request speculation override: None = engine default;
     # {"enabled": bool, "k": Optional[int]} normalized by _parse_req_spec
     spec: Optional[dict] = None
+    # multi-tenant identity: per-tenant fair-share admission on decode
+    # slots and on the radix-cache insert budget key off this
+    tenant: Optional[str] = None
+    # set by LLMEngine.cancel (replica-side abort): the engine thread
+    # notices at its next finish check and frees the slot + blocks
+    cancelled: bool = False
 
 
 def _parse_req_spec(speculation) -> Optional[dict]:
@@ -82,6 +88,8 @@ class LLMEngine:
     def __init__(self, config=None, params=None, *, num_slots: int = 8,
                  max_seq: Optional[int] = None, model: str = "tiny",
                  seed: int = 0, prefix_cache_size: int = 0,
+                 prefix_cache: Optional[str] = None,
+                 prefix_cache_bytes: Optional[int] = None,
                  kv_cache: str = "paged",
                  kv_pool_tokens: Optional[int] = None,
                  kv_block_size: int = 64,
@@ -89,6 +97,7 @@ class LLMEngine:
                  speculation=None,
                  spec_k: int = 4):
         import collections
+        import os
 
         import jax
 
@@ -140,6 +149,7 @@ class LLMEngine:
         # chunk prefill one fixed-size chunk per engine iteration,
         # interleaved with decode steps of the other slots — a long
         # prompt no longer stalls everyone's TTFT for its whole prefill.
+        self._chunk_prefill = None
         if prefill_chunk is not None:
             if prefill_chunk <= 0:
                 raise ValueError("prefill_chunk must be positive")
@@ -198,18 +208,75 @@ class LLMEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._key = jax.random.key(seed)
-        # Exact-prompt KV cache (host LRU), OFF by default: storing pays
-        # a device->host copy of the prompt KV per admission, worth it
-        # only for repeat-prompt workloads (enable via prefix_cache_size,
-        # pair with the handle's prefix_aware router). Repeat prompts
-        # skip prefill entirely: KV + last logits are re-injected into a
-        # free slot (reference: prefix-aware routing leans on vLLM's
-        # automatic prefix caching; here the engine owns the cache).
+        # Prefix reuse across requests, OFF by default. Two modes behind
+        # one knob (prefix_cache / RT_prefix_cache env):
+        #   "radix"  — default when enabled on a paged engine: the radix
+        #              tree of ray_tpu.models.prefix_cache shares the
+        #              prompt's pool blocks read-only between requests
+        #              (block-level, zero-copy, copy-on-write divergence)
+        #              so a shared-system-prompt request prefills ONLY
+        #              its new tokens.
+        #   "legacy" — the old exact-match full-prompt host cache, kept
+        #              as a parity oracle: hits re-inject a device->host
+        #              KV copy. Only an identical prompt can ever hit.
+        # Both modes share ONE byte budget (prefix_cache_bytes); the
+        # legacy count cap (prefix_cache_size) additionally applies so
+        # old configs keep their behavior.
+        mode = prefix_cache
+        if mode is None:
+            mode = os.environ.get("RT_prefix_cache")
+        if mode is None:
+            if prefix_cache_size > 0 or (prefix_cache_bytes or 0) > 0:
+                mode = "radix" if kv_cache == "paged" else "legacy"
+            else:
+                mode = "off"
+        if mode not in ("radix", "legacy", "off"):
+            raise ValueError(
+                f"prefix_cache={mode!r}: 'radix', 'legacy' or 'off'")
+        if mode == "radix" and kv_cache != "paged":
+            raise ValueError("prefix_cache='radix' requires "
+                             "kv_cache='paged' (it shares pool blocks)")
+        self._prefix_mode = mode
         self._prefix_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._prefix_cache_size = prefix_cache_size
+        self._prefix_cache_hostbytes = 0
         self._prefix_hits = 0
         self._prefix_misses = 0
+        self._prefix_match_faults = 0
+        self._prefix_insert_faults = 0
+        self._fair_share_skips = 0
+        self._radix = None
+        if mode == "radix":
+            from ray_tpu.models.paged_cache import (make_block_copy,
+                                                    make_chunked_paged_prefill)
+            from ray_tpu.models.prefix_cache import RadixPrefixCache
+
+            c = self.config
+            itemsize = self._cache["k"].dtype.itemsize
+            bytes_per_block = (2 * c.n_layers * kv_block_size
+                               * c.n_kv_heads * c.head_dim * itemsize)
+            if prefix_cache_bytes is None:
+                # default: the tree may cache up to half the pool —
+                # pool-pressure eviction reclaims cold blocks anyway,
+                # the budget just bounds steady-state residency
+                prefix_cache_bytes = ((self._page.num_blocks - 1) // 2
+                                      * bytes_per_block)
+            self._radix = RadixPrefixCache(
+                self._alloc, bytes_per_block=bytes_per_block,
+                budget_bytes=prefix_cache_bytes)
+            self._block_copy = make_block_copy(self.config, self._page)
+            if self._chunk_prefill is None:
+                # suffix-only prefill after a radix hit rides the chunked
+                # kernel (row-level scatter, arbitrary start) even when
+                # the engine wasn't configured for chunked prefill
+                self._chunk_prefill = make_chunked_paged_prefill(
+                    params, self.config, self._page)
+        elif mode == "legacy" and prefix_cache_bytes is None:
+            prefix_cache_bytes = 64 << 20   # footgun fix: bytes, not
+            # just entry count — a handful of long prompts used to pin
+            # unbounded full k/v host arrays
+        self._prefix_cache_bytes = prefix_cache_bytes or 0
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._waiting: "collections.deque[_Request]" = collections.deque()
@@ -246,7 +313,8 @@ class LLMEngine:
                  temperature: float = 0.0,
                  eos_token: Optional[int] = None,
                  timeout_s: float = 300.0,
-                 speculation=None) -> List[int]:
+                 speculation=None, tenant: Optional[str] = None
+                 ) -> List[int]:
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + max_tokens > self.max_seq:
@@ -255,7 +323,7 @@ class LLMEngine:
                 f"exceeds max_seq {self.max_seq}")
         self._check_vocab(prompt)
         req = _Request(list(prompt), max_tokens, temperature, eos_token,
-                       spec=_parse_req_spec(speculation))
+                       spec=_parse_req_spec(speculation), tenant=tenant)
         self._queue.put(req)
         if not req.done.wait(timeout_s):
             raise TimeoutError("generation timed out")
@@ -266,7 +334,7 @@ class LLMEngine:
     def submit(self, prompt: List[int], max_tokens: int = 64,
                temperature: float = 0.0,
                eos_token: Optional[int] = None,
-               speculation=None) -> str:
+               speculation=None, tenant: Optional[str] = None) -> str:
         """Enqueue without blocking; poll with :meth:`poll` (drives the
         proxy's SSE token streaming)."""
         import uuid
@@ -277,12 +345,25 @@ class LLMEngine:
             raise ValueError("prompt + max_tokens exceeds max_seq")
         self._check_vocab(prompt)
         req = _Request(list(prompt), max_tokens, temperature, eos_token,
-                       spec=_parse_req_spec(speculation))
+                       spec=_parse_req_spec(speculation), tenant=tenant)
         rid = uuid.uuid4().hex
         with self._pending_lock:
             self._pending[rid] = {"req": req, "sent": 0}
         self._queue.put(req)
         return rid
+
+    def cancel(self, request_id: str) -> bool:
+        """Replica-side request abort: mark the request cancelled and
+        drop its poll entry. The engine thread notices at its next
+        finish check and frees the slot — including the refcount drop
+        on any radix-shared blocks, which is why cancellation must
+        never free blocks directly from the caller thread."""
+        with self._pending_lock:
+            ent = self._pending.pop(request_id, None)
+        if ent is None:
+            return False
+        ent["req"].cancelled = True
+        return True
 
     def submit_prefilled(self, prompt: List[int], k, v, logits,
                          max_tokens: int = 64, temperature: float = 0.0,
@@ -357,7 +438,40 @@ class LLMEngine:
                 kv_blocks_free=self._alloc.free_blocks(),
                 kv_blocks_total=self._page.num_blocks - 1,
                 kv_block_size=self._page.block_size)
+        pc = {"mode": self._prefix_mode,
+              "match_faults": self._prefix_match_faults,
+              "insert_faults": self._prefix_insert_faults,
+              "budget_bytes": self._prefix_cache_bytes}
+        if self._radix is not None:
+            pc.update(self._radix.stats())
+            out["prefix_hits"] = pc["hits"]
+            out["prefix_misses"] = pc["misses"]
+        else:
+            pc.update(entries=len(self._prefix_cache),
+                      cached_bytes=self._prefix_cache_hostbytes)
+        out["prefix_cache"] = pc
+        out["fair_share_skips"] = self._fair_share_skips
         return out
+
+    def prefix_digest(self) -> List[int]:
+        """Compact advertisement of cached prefixes for prefix-aware
+        routing: cumulative 16-token-chunk hashes in the handle's
+        ``_RouterState._prefix_hashes`` scheme. Best-effort — the engine
+        thread mutates the tree concurrently, so a torn walk returns a
+        partial digest rather than an error (it is a routing hint)."""
+        try:
+            if self._radix is not None:
+                return self._radix.digest()
+            if self._prefix_mode == "legacy":
+                from ray_tpu.serve.handle import _RouterState
+
+                out = set()
+                for key in list(self._prefix_cache):
+                    out.update(_RouterState._prefix_hashes(list(key)))
+                return sorted(out)[:128]
+        except Exception:  # noqa: BLE001 — hint only, never a failure
+            pass
+        return []
 
     def shutdown(self):
         self._stop.set()
@@ -414,6 +528,105 @@ class LLMEngine:
                 return slot
         return None
 
+    def _pick_waiting(self) -> int:
+        """Index into the waiting deque of the next request to admit.
+        FIFO, with two exceptions: a preempted request (non-empty
+        output) always resumes first, and under multi-tenant contention
+        a tenant already holding its fair share of decode slots yields
+        to the first under-share tenant in the queue — PR 18's
+        per-client proxy fair share, extended down onto slots so one
+        tenant's burst cannot monopolize the engine."""
+        if len(self._waiting) == 1 or self._waiting[0].output:
+            return 0
+        held: Dict[Optional[str], int] = {}
+        for s in range(self.num_slots):
+            r = self._slots[s]
+            if r is not None:
+                held[r.tenant] = held.get(r.tenant, 0) + 1
+        tenants = {r.tenant for r in self._waiting} | set(held)
+        if len(tenants) <= 1:
+            return 0
+        share = max(1, self.num_slots // len(tenants))
+        for i, r in enumerate(self._waiting):
+            if held.get(r.tenant, 0) < share:
+                if i:
+                    self._fair_share_skips += 1
+                return i
+        return 0  # every tenant at/over share: work-conserving FIFO
+
+    def _radix_match(self, full_prompt: List[int]):
+        """Longest cached prefix of the prompt. All but the LAST prompt
+        token is eligible, so the block where the suffix prefill and the
+        first decode write land is always private — a shared block is
+        never written. An injected serve.llm.prefix_match fault degrades
+        to cold prefill with a typed counter, never a failed request."""
+        from ray_tpu.common import faults
+
+        try:
+            faults.fault_point("serve.llm.prefix_match")
+        except ConnectionError:
+            self._prefix_match_faults += 1
+            return None
+        m = self._radix.match(full_prompt[:-1])
+        return m if m.matched else None
+
+    def _radix_insert(self, req: _Request, toks: List[int], slot: int):
+        """Share the slot's full-block prefix into the radix tree —
+        zero-copy: the tree increfs the slot's own blocks. Byte-budget
+        and per-tenant-fair-share gated; an injected
+        serve.llm.prefix_insert fault skips the insert with a typed
+        counter (nothing is ever half-inserted)."""
+        if self._radix is None or self.kv_cache != "paged":
+            return
+        from ray_tpu.common import faults
+
+        try:
+            faults.fault_point("serve.llm.prefix_insert")
+        except ConnectionError:
+            self._prefix_insert_faults += 1
+            return
+        bs = self._page.block_size
+        nfull = min(len(toks) // bs,
+                    int(np.count_nonzero(self._alloc.tables[slot])))
+        if nfull <= 0:
+            return
+        max_new = None
+        tb = self._radix.tenant_blocks
+        tenants = set(tb) | {req.tenant}
+        if len(tenants) > 1:
+            # cache-insert fair share: with several tenants caching,
+            # each may pin at most its share of the byte budget
+            cap = max(1, self._radix.budget_blocks() // len(tenants))
+            max_new = cap - tb.get(req.tenant, 0)
+            if max_new <= 0:
+                self._fair_share_skips += 1
+                return
+        blocks = [int(b) for b in self._alloc.tables[slot, :nfull]]
+        self._radix.insert(toks[:nfull * bs], blocks, tenant=req.tenant,
+                           max_new=max_new)
+
+    def _legacy_insert(self, key, logits_np, slot: int, plen: int,
+                       resumed: bool):
+        """Exact-match host cache insert (legacy parity oracle), now
+        under the SAME byte budget as the radix path: the old cache
+        capped entry count only, so a handful of long prompts could pin
+        unbounded full k/v host arrays."""
+        if self._prefix_mode != "legacy" or resumed:
+            return
+        self._prefix_misses += 1
+        k, v = self._extract_kv(slot, plen)
+        self._prefix_cache[key] = {"k": k, "v": v, "logits": logits_np}
+        self._prefix_cache_hostbytes += k.nbytes + v.nbytes
+        while self._prefix_cache and (
+                (self._prefix_cache_size > 0
+                 and len(self._prefix_cache) > self._prefix_cache_size)
+                or (self._prefix_cache_bytes > 0
+                    and self._prefix_cache_hostbytes
+                    > self._prefix_cache_bytes)):
+            _, old = self._prefix_cache.popitem(last=False)
+            self._prefix_cache_hostbytes -= (old["k"].nbytes
+                                             + old["v"].nbytes)
+
     def _admit(self):
         import jax.numpy as jnp
 
@@ -427,39 +640,67 @@ class LLMEngine:
             slot = self._free_slot()
             if slot is None:
                 return
-            req = self._waiting[0]
+            idx = self._pick_waiting()
+            req = self._waiting[idx]
+            if req.cancelled:
+                del self._waiting[idx]
+                req.done.set()
+                continue
             # preempted requests resume by recomputing prompt+generated
             full_prompt = req.prompt + req.output
             plen = len(full_prompt)
-            # ensure plen + 1: this iteration's decode step writes the
-            # first generated token at position plen, which lives in a
-            # NEW block when the prompt is block-aligned — and
-            # _grow_active_slots already ran this iteration, so nothing
-            # else allocates it before the write (it would silently land
-            # in the null block). Watermark: beyond that, keep one growth
-            # block of headroom per already-active slot, or admission
-            # starves running requests into immediate preemption.
-            if self.kv_cache == "paged" and not (
-                    self._alloc.free_blocks() >=
-                    self._alloc.blocks_for(plen + 1)
-                    + sum(s is not None for s in self._slots)
-                    and self._alloc.ensure(slot, plen + 1)):
-                if self._alloc.blocks_for(plen + 1) > \
-                        min(self._page.num_blocks - 1,
-                            self._page.max_blocks_per_seq):
+            match = None
+            if self.kv_cache == "paged":
+                # ensure plen + 1: this iteration's decode step writes
+                # the first generated token at position plen, which
+                # lives in a NEW block when the prompt is block-aligned.
+                total = self._alloc.blocks_for(plen + 1)
+                if total > min(self._page.num_blocks - 1,
+                               self._page.max_blocks_per_seq):
                     # can never fit, even with the pool idle: fail it
-                    # rather than deadlock the FIFO head
-                    self._waiting.popleft()
+                    # rather than deadlock the queue
+                    del self._waiting[idx]
                     req.error = (f"prompt of {plen} tokens exceeds KV "
                                  "pool capacity")
                     req.done.set()
                     continue
-                return  # head-of-line waits for blocks (FIFO, no bypass)
-            self._waiting.popleft()
+                if self._radix is not None and req.preload is None:
+                    match = self._radix_match(full_prompt)
+                shared = match.blocks if match is not None else []
+                # watermark: beyond this request's blocks, keep one
+                # growth block of headroom per already-active slot, or
+                # admission starves running requests into preemption
+                need = (total - len(shared)
+                        + sum(s is not None for s in self._slots))
+                if shared:
+                    # pin the matched blocks FIRST: the pool-pressure
+                    # eviction below must never reclaim them
+                    self._alloc.adopt(slot, shared)
+                if self._alloc.free_blocks() < need and \
+                        self._radix is not None:
+                    self._radix.evict_for(need - self._alloc.free_blocks())
+                if self._alloc.free_blocks() < need or not \
+                        self._alloc.ensure(slot, plen + 1):
+                    self._alloc.release(slot)  # un-pin the match
+                    return  # picked request waits for blocks (no bypass)
+                if match is not None and match.cow is not None:
+                    # copy-on-write at the divergence block: ensure()
+                    # placed a private block at the first position past
+                    # the shared prefix; device-copy the cached block's
+                    # rows into it, so the suffix prefill can resume
+                    # MID-BLOCK at the divergence offset while the
+                    # cached original stays read-only for its other
+                    # references.
+                    self._cache = self._block_copy(
+                        self._cache, match.cow[0],
+                        int(self._alloc.tables[slot, len(shared)]))
+            del self._waiting[idx]
             resumed = bool(req.output)
+            matched = match.matched if match is not None else 0
             key = tuple(full_prompt)
             cached = None
-            if req.preload is None and not resumed:
+            if (self._prefix_mode == "legacy" and req.preload is None
+                    and not resumed):
                 cached = self._prefix_cache.get(key)
             if req.preload is not None:
                 # PD handoff: prompt KV computed by a prefill replica
@@ -472,6 +713,20 @@ class LLMEngine:
                 self._prefix_cache.move_to_end(key)
                 self._inject_kv(slot, cached["k"], cached["v"], plen)
                 logits_np = cached["logits"]
+            elif matched > 0:
+                # radix hit: the adopted blocks already hold the prefix
+                # KV — prefill ONLY the uncached suffix (TTFT tracks new
+                # tokens, not prompt length). Rides the chunked-prefill
+                # machinery so a long suffix still interleaves with the
+                # other slots' decode.
+                self._slots[slot] = req
+                self._slot_len[slot] = 0
+                self._admit_counter += 1
+                self._admit_seq[slot] = self._admit_counter
+                self._prefilling[slot] = {"req": req,
+                                          "tokens": full_prompt,
+                                          "pos": matched}
+                continue
             elif (self.prefill_chunk is not None
                   and plen > self.prefill_chunk):
                 # chunked prefill: register and let the engine loop
@@ -498,13 +753,9 @@ class LLMEngine:
                     self._cache, logits = self._prefill(
                         self._cache, jnp.asarray(tokens), plen, slot)
                 logits_np = np.asarray(logits)
-                if self._prefix_cache_size > 0 and not resumed:
-                    self._prefix_misses += 1
-                    k, v = self._extract_kv(slot, plen)
-                    self._prefix_cache[key] = {"k": k, "v": v,
-                                               "logits": logits_np}
-                    while len(self._prefix_cache) > self._prefix_cache_size:
-                        self._prefix_cache.popitem(last=False)
+                self._legacy_insert(key, logits_np, slot, plen, resumed)
+                if self._radix is not None:
+                    self._radix_insert(req, full_prompt, slot)
             tok = self._sample(logits_np.reshape(1, -1), req.temperature)[0]
             req.output.append(int(tok))
             self._slots[slot] = req
@@ -627,6 +878,10 @@ class LLMEngine:
         slot = next(iter(self._prefilling))
         st = self._prefilling[slot]
         toks, pos, C = st["tokens"], st["pos"], self.prefill_chunk
+        if C is None:
+            # radix-suffix prefill on an engine without chunked prefill:
+            # one call covering the whole uncached suffix
+            C = self._prompt_pad(len(toks) - pos)
         n = min(C, len(toks) - pos)
         buf = np.zeros((1, C), np.int32)
         buf[0, :n] = toks[pos:pos + n]
@@ -646,13 +901,9 @@ class LLMEngine:
         plen = len(toks)
         logits_np = np.asarray(logits)
         resumed = bool(req.output)
-        if self._prefix_cache_size > 0 and not resumed:
-            self._prefix_misses += 1
-            k, v = self._extract_kv(slot, plen)
-            self._prefix_cache[tuple(toks)] = {"k": k, "v": v,
-                                               "logits": logits_np}
-            while len(self._prefix_cache) > self._prefix_cache_size:
-                self._prefix_cache.popitem(last=False)
+        self._legacy_insert(tuple(toks), logits_np, slot, plen, resumed)
+        if self._radix is not None:
+            self._radix_insert(req, toks, slot)
         tok = self._sample(logits_np.reshape(1, -1), req.temperature)[0]
         req.output.append(int(tok))
         self._last_token[slot] = tok
@@ -676,11 +927,19 @@ class LLMEngine:
         req = self._slots[slot]
         if req is None:
             return
-        done = (len(req.output) >= req.max_tokens
+        done = (req.cancelled
+                or len(req.output) >= req.max_tokens
                 or (req.eos_token is not None and req.output
                     and req.output[-1] == req.eos_token)
                 or len(req.prompt) + len(req.output) >= self.max_seq)
         if done:
+            if self._radix is not None and not req.cancelled:
+                # on completion, offer the whole cached sequence (prompt
+                # + generated) to the tree: multi-turn conversations hit
+                # on their own history. Zero-copy — the tree increfs the
+                # blocks release() is about to drop its slot ref on.
+                seq = (req.prompt + req.output)[:int(self._slot_len[slot])]
+                self._radix_insert(req, seq, slot)
             req.done.set()
             self._slots[slot] = None
             if self._proposer is not None:
@@ -710,6 +969,11 @@ class LLMEngine:
             if self._slots[slot] is None:
                 continue
             while not self._alloc.ensure(slot, int(self._slot_len[slot]) + 1):
+                # pool pressure order: evict cold cached prefixes (LRU,
+                # refcount-0-only — a block any live slot references is
+                # untouchable) BEFORE preempting a running request
+                if self._radix is not None and self._radix.evict_for(1):
+                    continue
                 victims = [s for s in range(self.num_slots)
                            if s != slot and self._slots[s] is not None]
                 if victims:
@@ -838,7 +1102,13 @@ class LLMServer:
             merged = {"max_tokens": body.get("max_tokens", 64),
                       "temperature": body.get("temperature", 0.0),
                       "eos_token": body.get("eos_token"),
-                      "speculation": body.get("speculation")}
+                      "speculation": body.get("speculation"),
+                      # tenant identity for engine-level fair share:
+                      # body field wins, else the same x-client-id
+                      # header the proxy's admission control keys on
+                      "tenant": (body.get("tenant")
+                                 or prompt_or_request.headers.get(
+                                     "x-client-id"))}
             return body.get("prompt", []), merged
         return prompt_or_request, kwargs
 
@@ -846,16 +1116,27 @@ class LLMServer:
         prompt, kw = self._parse(prompt_or_request, kwargs)
         return self.engine.generate(
             prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
-            kw.get("eos_token"), speculation=kw.get("speculation"))
+            kw.get("eos_token"), speculation=kw.get("speculation"),
+            tenant=kw.get("tenant"))
 
     def submit(self, prompt_or_request, **kwargs) -> str:
         prompt, kw = self._parse(prompt_or_request, kwargs)
         return self.engine.submit(
             prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
-            kw.get("eos_token"), speculation=kw.get("speculation"))
+            kw.get("eos_token"), speculation=kw.get("speculation"),
+            tenant=kw.get("tenant"))
 
     def poll(self, request_id: str) -> Dict[str, Any]:
         return self.engine.poll(request_id)
+
+    def cancel(self, request_id: str) -> bool:
+        return self.engine.cancel(request_id)
+
+    def prefix_digest(self) -> List[int]:
+        """Exported through the Replica harness → controller →
+        router-refresh path so prefix-aware handles can route to the
+        replica holding the longest cached prefix."""
+        return self.engine.prefix_digest()
 
     def stream(self, prompt_or_request, **kwargs):
         """Generator-protocol streaming (round 11): tokens yield as the
@@ -867,7 +1148,8 @@ class LLMServer:
         prompt, kw = self._parse(prompt_or_request, kwargs)
         request_id = self.engine.submit(
             prompt, kw.get("max_tokens", 64), kw.get("temperature", 0.0),
-            kw.get("eos_token"), speculation=kw.get("speculation"))
+            kw.get("eos_token"), speculation=kw.get("speculation"),
+            tenant=kw.get("tenant"))
         from ray_tpu.serve.proxy import SSEBatch
 
         while True:

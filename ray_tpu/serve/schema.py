@@ -126,6 +126,26 @@ def validate_config(config: Dict[str, Any]) -> Dict[str, Any]:
             except ServeConfigError as e:
                 # e already reads "speculation: ..." — just add the path
                 raise ServeConfigError(f"{where}.args.{e}") from e
+        if args.get("prefix_cache") is not None:
+            # same reject-at-deploy-time contract as speculation: the
+            # engine enforces these in __init__, but a typo'd mode
+            # should fail the deploy call, not the replica boot
+            pc = args["prefix_cache"]
+            if pc not in ("radix", "legacy", "off"):
+                raise ServeConfigError(
+                    f"{where}.args.prefix_cache must be 'radix', "
+                    f"'legacy' or 'off', got {pc!r}")
+        if args.get("prefix_cache_bytes") is not None:
+            try:
+                pcb = int(args["prefix_cache_bytes"])
+                if pcb < 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise ServeConfigError(
+                    f"{where}.args.prefix_cache_bytes must be a "
+                    f"non-negative integer, got "
+                    f"{args['prefix_cache_bytes']!r}") from None
+            args = dict(args, prefix_cache_bytes=pcb)
         deployments = app.get("deployments") or []
         if not isinstance(deployments, list):
             raise ServeConfigError(f"{where}.deployments must be a list")

@@ -83,6 +83,14 @@ GUARDED_SERVE_ROWS = (
     # SIGKILL under load with serve.replica.call armed in the workers.
     "proxy_overload_accepted_rps",
     "proxy_failover_rps_recovered",
+    # round-19 (ISSUE 19) radix-prefix-cache rows, written by ``python
+    # bench_serve.py --prefix`` into the same proxy section: cold/radix
+    # TTFT p50 ratio on 80%-shared-prefix traffic (>= 2x acceptance,
+    # also asserted inside the bench) and radix decode throughput on
+    # the same closed-loop pool. Greedy parity is a hard in-bench
+    # assert, so a surviving row already implies bit-identical output.
+    "llm_prefix_ttft_speedup",
+    "llm_prefix_decode_tokens_per_s",
 )
 
 # The round-12 Data-plane row (ISSUE 10 acceptance): GB-scale groupby

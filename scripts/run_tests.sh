@@ -276,6 +276,80 @@ PYEOF
     echo "chaos gate(serve): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
     fail=$((fail+1))
   fi
+  # Prefix-cache leg: both radix fault points armed in the ENVIRONMENT
+  # (match dies on every 2nd walk, insert on every 3rd) while a
+  # two-tenant shared-prefix burst runs on one engine.  A fired fault
+  # must DEGRADE to a cold prefill — every request still answers, every
+  # stream equals the cache-off oracle bit-for-bit, the typed counters
+  # record the faults, and the allocator invariants hold after (ISSUE
+  # 19 resilience bar: eviction/faults never corrupt shared blocks).
+  echo "chaos gate: radix prefix cache under injected faults + tenant burst..." \
+    | tee -a "$RUN_LOG"
+  if timeout 300 env JAX_PLATFORMS=cpu \
+      RT_FAULTS="serve.llm.prefix_match=every:2,serve.llm.prefix_insert=every:3" \
+      python - >> "$RUN_LOG" 2>&1 <<'PYEOF'
+import threading
+import time
+
+from ray_tpu.common import faults
+from ray_tpu.serve.llm import LLMEngine
+
+pts = faults.active_points()
+assert "serve.llm.prefix_match" in pts, pts
+assert "serve.llm.prefix_insert" in pts, pts
+
+# the fault points live on the radix path only, so a cache-off engine
+# on the same seed is a clean greedy oracle
+oracle = LLMEngine(model="debug", num_slots=3, max_seq=64,
+                   kv_block_size=8, prefix_cache="off", seed=0)
+eng = LLMEngine(model="debug", num_slots=3, max_seq=64,
+                kv_block_size=8, prefix_cache="radix", seed=0)
+system = list(range(1, 25))                 # 24-token shared prefix
+prompts = [system + [40 + i, 41 + i, 42 + i] for i in range(9)]
+want = [oracle.generate(p, max_tokens=4) for p in prompts]
+outs = [None] * len(prompts)
+
+
+def client(i):
+    tenant = "flood" if i < 6 else "trickle"
+    rid = eng.submit(prompts[i], max_tokens=4, tenant=tenant)
+    chunks, deadline = [], time.monotonic() + 120
+    while True:
+        st = eng.poll(rid)
+        chunks.extend(st["chunks"])
+        if st["done"]:
+            break
+        assert time.monotonic() < deadline, f"request {i} hung"
+        time.sleep(0.005)
+    outs[i] = chunks
+
+
+threads = [threading.Thread(target=client, args=(i,))
+           for i in range(len(prompts))]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=150)
+assert all(o is not None for o in outs), \
+    f"unanswered requests: {[i for i, o in enumerate(outs) if o is None]}"
+bad = [i for i in range(len(prompts)) if outs[i] != want[i]]
+assert not bad, f"fault degraded to WRONG tokens on requests {bad}"
+st = eng.stats()
+pc = st["prefix_cache"]
+assert pc["match_faults"] + pc["insert_faults"] > 0, pc
+eng._alloc.check_invariants()
+eng.shutdown()
+oracle.shutdown()
+print("chaos gate(prefix): 9/9 two-tenant requests answered "
+      f"bit-identical through {pc['match_faults']} match + "
+      f"{pc['insert_faults']} insert faults; allocator invariants hold")
+PYEOF
+  then
+    echo "chaos gate(prefix): ok" | tee -a "$RUN_LOG"
+  else
+    echo "chaos gate(prefix): FAILED (see $RUN_LOG)" | tee -a "$RUN_LOG"
+    fail=$((fail+1))
+  fi
 fi
 for f in tests/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then continue; fi
@@ -323,7 +397,8 @@ fi
 # guarded rows (round-8 core targets + round-11 proxy rows + round-12
 # groupby shuffle row + round-13 multi-node rows + round-16
 # compiled-chain and pipeline rows + round-17 Sebulba/Anakin rows +
-# round-18 overload-shed / SIGKILL-failover chaos rows)
+# round-18 overload-shed / SIGKILL-failover chaos rows + round-19
+# radix-prefix-cache TTFT/throughput rows)
 # against the committed BENCH_core.json / BENCH_serve.json /
 # BENCH_data.json / BENCH_train.json / BENCH_rl.json (>15% same-box
 # regression fails the run). Off by default — the benches need minutes
@@ -350,6 +425,16 @@ if [[ "${RT_BENCH_GUARD:-0}" == "1" ]]; then
     then
       echo "bench guard: serve --overload bench run failed" \
            "(log: $BG_DIR/bench_overload.log)" | tee -a "$RUN_LOG"
+      fail=$((fail+1))
+    fi
+    echo "bench guard: running bench_serve.py --prefix (radix rows)..." \
+      | tee -a "$RUN_LOG"
+    if ! (cd "$BG_DIR" && PYTHONPATH="$OLDPWD" timeout 900 \
+          env JAX_PLATFORMS=cpu python "$OLDPWD/bench_serve.py" --prefix \
+          > bench_prefix.log 2>&1)
+    then
+      echo "bench guard: serve --prefix bench run failed" \
+           "(log: $BG_DIR/bench_prefix.log)" | tee -a "$RUN_LOG"
       fail=$((fail+1))
     fi
     echo "bench guard: running bench_data.py (GB-scale shuffle)..." \

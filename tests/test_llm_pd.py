@@ -68,7 +68,7 @@ class TestPrefixCache:
         from ray_tpu.serve.llm import LLMEngine
 
         eng = LLMEngine(model="debug", num_slots=2, max_seq=64,
-                        prefix_cache_size=4)
+                        prefix_cache_size=4, prefix_cache="legacy")
         try:
             prompt = [5, 17, 99, 3, 42]
             first = eng.generate(prompt, max_tokens=6)
@@ -84,7 +84,7 @@ class TestPrefixCache:
         from ray_tpu.serve.llm import LLMEngine
 
         eng = LLMEngine(model="debug", num_slots=2, max_seq=64,
-                        prefix_cache_size=2)
+                        prefix_cache_size=2, prefix_cache="legacy")
         try:
             for base in range(4):
                 eng.generate([base + 1, base + 2], max_tokens=2)
